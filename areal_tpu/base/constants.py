@@ -1,0 +1,121 @@
+"""Process-global experiment context (reference: realhf/base/constants.py).
+
+Holds experiment/trial names, the per-model mesh registry, and the
+``model_scope`` context manager that the reference uses to switch "the
+current model" (reference :215).  Path helpers mirror :82-118.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import getpass
+import os
+from typing import Dict, Optional
+
+_experiment_name: Optional[str] = None
+_trial_name: Optional[str] = None
+
+_model_scope_stack = []
+_meshes: Dict[str, object] = {}  # model_name -> jax.sharding.Mesh
+_mesh_specs: Dict[str, object] = {}  # model_name -> MeshSpec
+
+
+def set_experiment_trial_names(experiment_name: str, trial_name: str):
+    global _experiment_name, _trial_name
+    if "_" in experiment_name or "_" in trial_name:
+        raise ValueError("experiment/trial names may not contain underscores")
+    _experiment_name = experiment_name
+    _trial_name = trial_name
+
+
+def experiment_name() -> str:
+    if _experiment_name is None:
+        raise RuntimeError("experiment name not set")
+    return _experiment_name
+
+
+def trial_name() -> str:
+    if _trial_name is None:
+        raise RuntimeError("trial name not set")
+    return _trial_name
+
+
+def set_mesh(model_name: str, mesh, spec=None):
+    _meshes[model_name] = mesh
+    if spec is not None:
+        _mesh_specs[model_name] = spec
+
+
+@contextlib.contextmanager
+def model_scope(model_name: str):
+    """Make ``model_name`` the current model within the block."""
+    _model_scope_stack.append(model_name)
+    try:
+        yield
+    finally:
+        _model_scope_stack.pop()
+
+
+def has_model_scope() -> bool:
+    return bool(_model_scope_stack)
+
+
+def current_model_name() -> str:
+    if not _model_scope_stack:
+        raise RuntimeError("not inside a model_scope")
+    return _model_scope_stack[-1]
+
+
+def current_mesh():
+    return _meshes[current_model_name()]
+
+
+def current_mesh_spec():
+    return _mesh_specs[current_model_name()]
+
+
+def get_mesh(model_name: str):
+    return _meshes.get(model_name)
+
+
+# ---------------------------------------------------------------------------
+# Path helpers (reference :82-118).
+# ---------------------------------------------------------------------------
+
+def get_cache_path() -> str:
+    root = os.environ.get("AREAL_CACHE_ROOT", "/tmp/areal_tpu/cache")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _trial_path(root_env: str, default_root: str, *sub) -> str:
+    root = os.environ.get(root_env, default_root)
+    p = os.path.join(root, getpass.getuser(), experiment_name(), trial_name(), *sub)
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_log_path() -> str:
+    return _trial_path("AREAL_LOG_ROOT", "/tmp/areal_tpu/logs")
+
+
+def get_save_path() -> str:
+    return _trial_path("AREAL_SAVE_ROOT", "/tmp/areal_tpu/checkpoints")
+
+
+def get_param_realloc_path() -> str:
+    """Staging dir for train->generation weight sync (disk fallback path)."""
+    return _trial_path("AREAL_SAVE_ROOT", "/tmp/areal_tpu/checkpoints", "param_realloc")
+
+
+def get_recover_path() -> str:
+    return _trial_path("AREAL_SAVE_ROOT", "/tmp/areal_tpu/checkpoints", "recover")
+
+
+def reset():  # for tests
+    global _experiment_name, _trial_name
+    _experiment_name = None
+    _trial_name = None
+    _model_scope_stack.clear()
+    _meshes.clear()
+    _mesh_specs.clear()

@@ -1,0 +1,99 @@
+"""Sequence packing / balancing algorithms
+(reference: realhf/base/datapack.py — flat2d and the balanced-partition
+algorithms used by micro-batch splitting).
+
+These drive ``MicroBatchSpec`` splitting: given per-sequence token counts,
+partition sequences into k groups with near-equal total tokens (order
+preserving for reproducibility) or bounded by a max token budget.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def flat2d(xs: Sequence[Sequence]) -> List:
+    """Flatten one nesting level."""
+    return [x for sub in xs for x in sub]
+
+
+def partition_balanced(nums: Sequence[int], k: int) -> List[List[int]]:
+    """Partition indices 0..n-1 (order preserving, contiguous) into exactly
+    ``k`` non-empty groups minimizing the maximum group sum.
+
+    Classic linear-partition DP; n and k are small (thousands / tens) so the
+    O(n^2 k) DP is fine on host.
+    """
+    n = len(nums)
+    if k > n:
+        raise ValueError(f"cannot partition {n} items into {k} non-empty groups")
+    if k == 1:
+        return [list(range(n))]
+    prefix = np.concatenate([[0], np.cumsum(nums)])
+    INF = float("inf")
+    # dp[j][i]: minimal max-sum partitioning first i items into j groups
+    dp = np.full((k + 1, n + 1), INF)
+    cut = np.zeros((k + 1, n + 1), dtype=int)
+    dp[0][0] = 0.0
+    for j in range(1, k + 1):
+        for i in range(j, n + 1):
+            # last group = items t..i-1
+            for t in range(j - 1, i):
+                cost = max(dp[j - 1][t], prefix[i] - prefix[t])
+                if cost < dp[j][i]:
+                    dp[j][i] = cost
+                    cut[j][i] = t
+    # reconstruct
+    groups: List[List[int]] = []
+    i = n
+    for j in range(k, 0, -1):
+        t = cut[j][i]
+        groups.append(list(range(t, i)))
+        i = t
+    groups.reverse()
+    return groups
+
+
+def partition_by_budget(
+    nums: Sequence[int], max_tokens: int, min_groups: int = 1
+) -> List[List[int]]:
+    """Greedy contiguous partition: each group's total <= max_tokens (single
+    items above the budget get their own group).  Ensures >= min_groups by
+    rebalancing with :func:`partition_balanced` when needed."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_sum = 0
+    for i, x in enumerate(nums):
+        if cur and cur_sum + x > max_tokens:
+            groups.append(cur)
+            cur, cur_sum = [], 0
+        cur.append(i)
+        cur_sum += x
+    if cur:
+        groups.append(cur)
+    if len(groups) < min_groups:
+        groups = partition_balanced(nums, min_groups)
+    return groups
+
+
+def bin_pack_ffd(nums: Sequence[int], capacity: int) -> List[List[int]]:
+    """First-fit-decreasing bin packing (non-contiguous), for packing variable
+    length sequences into fixed token-capacity batches."""
+    order = np.argsort(nums)[::-1]
+    bins: List[List[int]] = []
+    sums: List[int] = []
+    for i in order:
+        x = nums[i]
+        placed = False
+        for b in range(len(bins)):
+            if sums[b] + x <= capacity:
+                bins[b].append(int(i))
+                sums[b] += x
+                placed = True
+                break
+        if not placed:
+            bins.append([int(i)])
+            sums.append(int(x))
+    return bins

@@ -1,0 +1,106 @@
+"""Canonical name-resolve key paths.
+
+Mirrors the key layout of the reference's realhf/base/names.py:1-110 so that
+the discovery/synchronization vocabulary carries over: trial root, request
+reply stream, distributed peers, model versions, generation servers, etc.
+All functions return slash-separated keys rooted at ``/areal_tpu``.
+"""
+
+from __future__ import annotations
+
+USER_NAMESPACE = "areal_tpu"
+
+
+def trial_root(experiment_name: str, trial_name: str) -> str:
+    return f"{USER_NAMESPACE}/{experiment_name}/{trial_name}"
+
+
+def trial_registry(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/registry"
+
+
+def worker_status(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/status/{worker_name}"
+
+
+def worker_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/worker/"
+
+
+def worker(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{worker_root(experiment_name, trial_name)}{worker_name}"
+
+
+def request_reply_stream(
+    experiment_name: str, trial_name: str, stream_name: str
+) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/request_reply_stream/{stream_name}"
+
+
+def request_reply_stream_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/request_reply_stream/"
+
+
+def distributed_peer(
+    experiment_name: str, trial_name: str, model_name: str
+) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/distributed_peer/{model_name}"
+
+
+def distributed_master(
+    experiment_name: str, trial_name: str, model_name: str
+) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/distributed_master/{model_name}"
+
+
+def model_version(
+    experiment_name: str, trial_name: str, model_name: str
+) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/model_version/{model_name}"
+
+
+def gen_servers(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gen_servers/"
+
+def gen_server(experiment_name: str, trial_name: str, server_idx) -> str:
+    return f"{gen_servers(experiment_name, trial_name)}{server_idx}"
+
+
+def gen_server_manager(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gen_server_manager"
+
+
+def training_samples(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/training_samples"
+
+
+def experiment_status(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/experiment_status"
+
+
+def used_ports(experiment_name: str, trial_name: str, host_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/used_ports/{host_name}/"
+
+
+def metric_server(
+    experiment_name: str, trial_name: str, group: str, name: str
+) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/metric_server/{group}/{name}"
+
+
+def metric_server_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/metric_server/"
+
+
+def stream_pullers(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/stream_pullers/"
+
+
+def push_pull_stream(
+    experiment_name: str, trial_name: str, stream_name: str
+) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/push_pull_stream/{stream_name}"
+
+
+def push_pull_stream_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/push_pull_stream/"

@@ -1,0 +1,44 @@
+"""Deterministic seeding (reference: realhf/base/seeding.py:22).
+
+Derives per-key seeds as ``base_seed + stable_hash(key)`` and seeds python,
+numpy, and (for the TPU build) provides the root ``jax.random.PRNGKey``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+import numpy as np
+
+_BASE_SEED: int = 0
+_SEEDED = False
+
+
+def _stable_hash(key: str) -> int:
+    return int.from_bytes(hashlib.sha256(key.encode()).digest()[:4], "little")
+
+
+def set_random_seed(base_seed: int, key: str = "") -> None:
+    """Seed python/numpy deterministically for this process.
+
+    ``key`` should identify the worker (e.g. its name) so different workers get
+    decorrelated but reproducible streams.
+    """
+    global _BASE_SEED, _SEEDED
+    _BASE_SEED = base_seed
+    seed = (base_seed + _stable_hash(key)) % (2**31)
+    random.seed(seed)
+    np.random.seed(seed)
+    _SEEDED = True
+
+
+def get_seed(key: str = "") -> int:
+    return (_BASE_SEED + _stable_hash(key)) % (2**31)
+
+
+def prng_key(key: str = ""):
+    """Root jax PRNG key for the given stream name."""
+    import jax
+
+    return jax.random.PRNGKey(get_seed(key))

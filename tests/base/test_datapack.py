@@ -1,0 +1,57 @@
+import numpy as np
+import pytest
+
+from areal_tpu.base.datapack import (
+    bin_pack_ffd,
+    flat2d,
+    partition_balanced,
+    partition_by_budget,
+)
+
+
+def test_flat2d():
+    assert flat2d([[1, 2], [3], []]) == [1, 2, 3]
+
+
+def test_partition_balanced_exact():
+    nums = [10, 10, 10, 10]
+    groups = partition_balanced(nums, 2)
+    assert groups == [[0, 1], [2, 3]]
+
+
+def test_partition_balanced_minimizes_max():
+    nums = [9, 1, 1, 1, 9]
+    groups = partition_balanced(nums, 3)
+    sums = [sum(nums[i] for i in g) for g in groups]
+    assert max(sums) == 9  # optimal: [9][1,1,1][9]
+    # all indices covered, contiguous, in order
+    assert flat2d(groups) == list(range(5))
+
+
+def test_partition_balanced_errors():
+    with pytest.raises(ValueError):
+        partition_balanced([1, 2], 3)
+
+
+def test_partition_by_budget():
+    nums = [5, 5, 5, 5, 11]
+    groups = partition_by_budget(nums, max_tokens=10)
+    for g in groups[:-1]:
+        pass
+    sums = [sum(nums[i] for i in g) for g in groups]
+    # oversize single item gets its own group
+    assert all(s <= 11 for s in sums)
+    assert flat2d(groups) == list(range(5))
+
+
+def test_partition_by_budget_min_groups():
+    groups = partition_by_budget([1, 1, 1, 1], max_tokens=100, min_groups=2)
+    assert len(groups) == 2
+
+
+def test_bin_pack_ffd():
+    nums = [4, 4, 3, 3, 2]
+    bins = bin_pack_ffd(nums, capacity=7)
+    for b in bins:
+        assert sum(nums[i] for i in b) <= 7
+    assert sorted(flat2d(bins)) == list(range(5))

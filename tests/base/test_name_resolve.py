@@ -1,0 +1,78 @@
+import threading
+import time
+
+import pytest
+
+from areal_tpu.base.name_resolve import (
+    MemoryNameRecordRepository,
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NfsNameRecordRepository,
+)
+
+
+@pytest.fixture(params=["memory", "nfs"])
+def repo(request, tmp_path):
+    if request.param == "memory":
+        r = MemoryNameRecordRepository()
+    else:
+        r = NfsNameRecordRepository(record_root=str(tmp_path))
+    yield r
+    r.reset()
+
+
+def test_add_get_delete(repo):
+    repo.add("a/b/c", "v1")
+    assert repo.get("a/b/c") == "v1"
+    with pytest.raises(NameEntryExistsError):
+        repo.add("a/b/c", "v2")
+    repo.add("a/b/c", "v2", replace=True)
+    assert repo.get("a/b/c") == "v2"
+    repo.delete("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.delete("a/b/c")
+
+
+def test_subtree(repo):
+    repo.add("root/x/1", "a")
+    repo.add("root/x/2", "b")
+    repo.add("root/y", "c")
+    repo.add("other", "d")
+    assert repo.get_subtree("root") == ["a", "b", "c"]
+    assert repo.find_subtree("root/x") == ["root/x/1", "root/x/2"]
+    repo.clear_subtree("root/x")
+    assert repo.get_subtree("root") == ["c"]
+    repo.clear_subtree("root")
+    assert repo.get_subtree("root") == []
+
+
+def test_add_subentry(repo):
+    n1 = repo.add_subentry("servers", "addr1")
+    n2 = repo.add_subentry("servers", "addr2")
+    assert n1 != n2
+    assert sorted(repo.get_subtree("servers")) == ["addr1", "addr2"]
+
+
+def test_wait(repo):
+    def _delayed_add():
+        time.sleep(0.2)
+        repo.add("late/key", "val")
+
+    t = threading.Thread(target=_delayed_add)
+    t.start()
+    assert repo.wait("late/key", timeout=5) == "val"
+    t.join()
+    with pytest.raises(TimeoutError):
+        repo.wait("never", timeout=0.2)
+
+
+def test_watch_names(repo):
+    repo.add("w/1", "x")
+    fired = threading.Event()
+    repo.watch_names(["w/1"], fired.set, poll_frequency=0.05)
+    time.sleep(0.2)
+    assert not fired.is_set()
+    repo.delete("w/1")
+    assert fired.wait(timeout=2)

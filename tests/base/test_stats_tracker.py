@@ -1,0 +1,71 @@
+import numpy as np
+import pytest
+
+from areal_tpu.base import stats_tracker
+from areal_tpu.base.stats_tracker import DistributedStatsTracker, ReduceType
+
+
+def test_masked_avg():
+    t = DistributedStatsTracker()
+    mask = np.array([1, 1, 0, 0], dtype=bool)
+    vals = np.array([1.0, 3.0, 100.0, 100.0])
+    t.denominator(m=mask)
+    t.stat(denominator="m", loss=vals)
+    out = t.export()
+    assert out["loss"] == pytest.approx(2.0)
+    assert out["m/count"] == 2
+
+
+def test_sum_min_max():
+    t = DistributedStatsTracker()
+    mask = np.array([1, 0, 1], dtype=bool)
+    v = np.array([2.0, -50.0, 4.0])
+    t.denominator(m=mask)
+    t.stat(denominator="m", reduce_type=ReduceType.SUM, s=v)
+    t.denominator(m=mask)
+    t.stat(denominator="m", reduce_type=ReduceType.MIN, lo=v)
+    t.denominator(m=mask)
+    t.stat(denominator="m", reduce_type=ReduceType.MAX, hi=v)
+    out = t.export()
+    assert out["s"] == pytest.approx(6.0)
+    assert out["lo"] == pytest.approx(2.0)
+    assert out["hi"] == pytest.approx(4.0)
+
+
+def test_scopes_and_scalar():
+    t = DistributedStatsTracker()
+    with t.scope("ppo"):
+        t.scalar(lr=1e-3)
+        with t.scope("actor"):
+            m = np.ones(3, dtype=bool)
+            t.denominator(n=m)
+            t.stat(denominator="n", adv=np.array([1.0, 2.0, 3.0]))
+    out = t.export()
+    assert out["ppo/lr"] == pytest.approx(1e-3)
+    assert out["ppo/actor/adv"] == pytest.approx(2.0)
+
+
+def test_multiple_records_accumulate():
+    t = DistributedStatsTracker()
+    for i in range(3):
+        m = np.ones(2, dtype=bool)
+        t.denominator(m=m)
+        t.stat(denominator="m", x=np.full(2, float(i)))
+    out = t.export()
+    assert out["x"] == pytest.approx(1.0)  # mean of 0,0,1,1,2,2
+
+
+def test_module_level_api():
+    with stats_tracker.scope("a"):
+        stats_tracker.scalar(v=2.0)
+    out = stats_tracker.export()
+    assert out["a/v"] == 2.0
+
+
+def test_shape_mismatch_raises():
+    t = DistributedStatsTracker()
+    t.denominator(m=np.ones(3, dtype=bool))
+    with pytest.raises(ValueError):
+        t.stat(denominator="m", bad=np.ones(4))
+    with pytest.raises(ValueError):
+        t.stat(denominator="nope", x=np.ones(3))

@@ -1,0 +1,47 @@
+import numpy as np
+import pytest
+
+from areal_tpu.base.topology import MeshSpec, ProcessTopology, worker_topology
+
+
+def test_mesh_spec_basics():
+    s = MeshSpec(data=2, fsdp=2, model=2)
+    assert s.world_size == 8
+    assert s.dp_size == 4
+    assert MeshSpec.from_str("d2f2m2") == s
+    assert MeshSpec.from_str(str(s)) == s
+    assert MeshSpec.from_str("d4p1m1") == MeshSpec(data=4, pipe=1, model=1)
+
+
+def test_make_mesh_cpu():
+    import jax
+
+    s = MeshSpec(data=2, fsdp=2, model=2)
+    mesh = s.make_mesh(jax.devices())
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["model"] == 2
+    assert mesh.size == 8
+
+
+def test_process_topology_rank_roundtrip():
+    t = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 3, 4])
+    assert t.world_size() == 24
+    for rank in range(24):
+        coord = t.get_coord(rank)
+        assert t.get_rank(**coord) == rank
+    # first axis varies slowest
+    assert t.get_rank(pipe=0, data=0, model=1) == 1
+    assert t.get_rank(pipe=1, data=0, model=0) == 12
+
+
+def test_filter_match():
+    t = ProcessTopology(axes=["data", "model"], dims=[2, 3])
+    assert t.filter_match(data=0) == [0, 1, 2]
+    assert t.filter_match(model=2) == [2, 5]
+    assert t.filter_match(data=1, model=1) == [4]
+
+
+def test_worker_topology():
+    t = worker_topology(MeshSpec(data=2, model=2))
+    assert t.world_size() == 4

@@ -1,0 +1,33 @@
+"""Test configuration: run all tests on an 8-device virtual CPU mesh so that
+every distributed feature is exercised without TPU hardware, mirroring the
+reference's gloo/CPU multi-process harness (reference: realhf/base/testing.py).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Force CPU for tests even when the environment points JAX at a TPU
+# (JAX_PLATFORMS=axon, registered eagerly by sitecustomize before this file
+# runs): tests must run hermetically on the virtual 8-device CPU mesh, so the
+# env var alone is not enough — override via jax.config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_globals():
+    """Reset process-global state between tests."""
+    from areal_tpu.base import constants, name_resolve
+
+    yield
+    name_resolve.reset()
+    constants.reset()
