@@ -1,0 +1,94 @@
+"""Core named abstractions (reference: realhf/api/core/config.py).
+
+``ModelName`` identifies a model role + replica; ``ModelShardID`` pins one
+shard of a model onto a mesh coordinate.  The ``*Abstraction`` dataclasses
+are (type_, args) factory references resolved through registries — the
+config-file-friendly way the reference wires datasets/models/interfaces/
+backends/agents/envs into experiments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ModelName:
+    role: str
+    replica_id: int = 0
+
+    def __str__(self):
+        return f"{self.role}@{self.replica_id}"
+
+    @classmethod
+    def from_str(cls, s: str) -> "ModelName":
+        role, _, rid = s.partition("@")
+        return cls(role=role, replica_id=int(rid or 0))
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ModelFamily:
+    """HF model family tag, e.g. qwen2 / llama / gemma."""
+
+    _class: str
+    is_critic: bool = False
+
+    def __str__(self):
+        return f"{self._class}{'-critic' if self.is_critic else ''}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelShardID:
+    """One shard of a model: mesh coordinates of the owning chip.
+
+    The reference uses (dp, tp, pp) ranks (realhf/api/core/config.py);
+    we keep the same identification for the system layer, where ``dp``
+    indexes the combined data×fsdp axes, ``tp`` the model axis, and ``pp``
+    the pipe axis of the MeshSpec.
+    """
+
+    model_name: ModelName
+    dp_rank: int = 0
+    tp_rank: int = 0
+    pp_rank: int = 0
+
+    @classmethod
+    def from_parallelism_rank(cls, model_name: ModelName, spec, rank: int):
+        """Map a flat chip rank in a MeshSpec to shard coordinates."""
+        from areal_tpu.base.topology import worker_topology
+
+        topo = worker_topology(spec)
+        coord = topo.get_coord(rank)
+        dp = coord["data"] * spec.fsdp + coord["fsdp"]
+        return cls(
+            model_name=model_name,
+            dp_rank=dp,
+            tp_rank=coord["model"],
+            pp_rank=coord["pipe"],
+        )
+
+    def __str__(self):
+        return (
+            f"{self.model_name}-d{self.dp_rank}t{self.tp_rank}p{self.pp_rank}"
+        )
+
+
+def _abstraction(name: str):
+    @dataclasses.dataclass
+    class _Abstraction:
+        type_: str
+        args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    _Abstraction.__name__ = name
+    _Abstraction.__qualname__ = name
+    return _Abstraction
+
+
+DatasetAbstraction = _abstraction("DatasetAbstraction")
+ModelAbstraction = _abstraction("ModelAbstraction")
+ModelInterfaceAbstraction = _abstraction("ModelInterfaceAbstraction")
+ModelBackendAbstraction = _abstraction("ModelBackendAbstraction")
+AgentAbstraction = _abstraction("AgentAbstraction")
+EnvServiceAbstraction = _abstraction("EnvServiceAbstraction")
+RewardAbstraction = _abstraction("RewardAbstraction")

@@ -78,6 +78,35 @@ def partition_by_budget(
     return groups
 
 
+def ffd_allocate(
+    nums: Sequence[int], capacity: int, min_groups: int = 1
+) -> List[List[int]]:
+    """First-fit-decreasing allocation with a minimum group count
+    (reference: realhf/base/datapack.py ``ffd_allocate`` used by
+    ``SequenceSample.split_with_lengths``).
+
+    Returns non-contiguous index groups, each with total <= capacity when
+    possible; at least ``min_groups`` groups are returned (falling back to a
+    longest-processing-time balance into exactly ``min_groups`` bins).
+    """
+    if min_groups > len(nums):
+        raise ValueError(
+            f"cannot allocate {len(nums)} items into {min_groups} groups"
+        )
+    bins = bin_pack_ffd(nums, capacity)
+    if len(bins) >= min_groups:
+        return bins
+    # LPT into exactly min_groups bins.
+    order = np.argsort(nums)[::-1]
+    groups: List[List[int]] = [[] for _ in range(min_groups)]
+    sums = np.zeros(min_groups)
+    for i in order:
+        b = int(np.argmin(sums))
+        groups[b].append(int(i))
+        sums[b] += nums[i]
+    return [g for g in groups if g]
+
+
 def bin_pack_ffd(nums: Sequence[int], capacity: int) -> List[List[int]]:
     """First-fit-decreasing bin packing (non-contiguous), for packing variable
     length sequences into fixed token-capacity batches."""

@@ -1,0 +1,8 @@
+"""Dataset implementations.  Importing this package registers all datasets."""
+
+from areal_tpu.data import (  # noqa: F401
+    math_code_dataset,
+    prompt_answer_dataset,
+    prompt_dataset,
+    rw_paired_dataset,
+)

@@ -1,0 +1,73 @@
+"""Reward-model paired dataset (reference: realhf/impl/dataset/rw_paired_dataset.py).
+
+Each row has "prompt", "pos_answers", "neg_answers"; a row yields one id with
+2*n_pairs sequences packed as [pos1, neg1, pos2, neg2, ...] under the key
+``packed_input_ids`` with ``group_factor`` metadata for loss averaging.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import torch.utils.data
+
+from areal_tpu.api import dataset_api
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("rw_paired_dataset")
+
+
+class RewardModelingPairedDataset(torch.utils.data.Dataset):
+    def __init__(
+        self,
+        util: dataset_api.DatasetUtility,
+        max_length: int,
+        max_pairs_per_prompt: int = 2,
+        dataset_path: Optional[str] = None,
+        dataset_builder: Optional[Callable[[], List[Dict]]] = None,
+    ):
+        self.util = util
+        data = dataset_api.load_shuffle_split_dataset(
+            util, dataset_path, dataset_builder
+        )
+        tok = util.tokenizer
+        self.ids = [str(d["id"]) for d in data]
+        self.token_groups: List[List[List[int]]] = []
+        for d in data:
+            pairs = list(zip(d["pos_answers"], d["neg_answers"]))[
+                :max_pairs_per_prompt
+            ]
+            group = []
+            for pos, neg in pairs:
+                for ans in (pos, neg):
+                    enc = tok(
+                        d["prompt"] + ans + tok.eos_token,
+                        truncation=True,
+                        max_length=max_length,
+                        padding=False,
+                        return_attention_mask=False,
+                    )
+                    group.append(enc["input_ids"])
+            self.token_groups.append(group)
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, idx: int) -> SequenceSample:
+        group = self.token_groups[idx]
+        packed = np.concatenate([np.array(g, dtype=np.int32) for g in group])
+        n_pairs = len(group) // 2
+        return SequenceSample(
+            keys={"packed_input_ids"},
+            trailing_shapes={"packed_input_ids": ()},
+            dtypes={"packed_input_ids": np.dtype(np.int32)},
+            ids=[self.ids[idx]],
+            seqlens={"packed_input_ids": [[len(g) for g in group]]},
+            data={"packed_input_ids": packed},
+            metadata={"group_factor": [1 / n_pairs]},
+        )
+
+
+dataset_api.register_dataset("rw_pair", RewardModelingPairedDataset)

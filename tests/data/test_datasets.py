@@ -1,0 +1,87 @@
+import numpy as np
+import pytest
+
+from areal_tpu.api import dataset_api
+from areal_tpu.api.config import DatasetAbstraction
+from areal_tpu.api.data import SequenceSample
+from tests.fixtures import dataset, dataset_path, save_path, tokenizer  # noqa: F401
+
+import areal_tpu.data  # noqa: F401  (registers datasets)
+
+
+def _make(name, tokenizer, dataset_path, **args):
+    return dataset_api.make_dataset(
+        DatasetAbstraction(name, dict(dataset_path=dataset_path, **args)),
+        seed=1,
+        dp_rank=0,
+        world_size=1,
+        tokenizer_or_path=tokenizer,
+    )
+
+
+def test_math_code_prompt_dataset(tokenizer, dataset_path, dataset):
+    ds = _make("math_code_prompt", tokenizer, dataset_path, max_length=16)
+    assert len(ds) == len(dataset)
+    s = ds[0]
+    assert isinstance(s, SequenceSample)
+    assert s.keys == {"packed_prompts"}
+    assert s.metadata["task"] == ["math"]
+    assert s.data["packed_prompts"].dtype == np.int32
+
+
+def test_math_code_dataset_filtering(tokenizer, dataset_path, dataset):
+    ds = _make(
+        "math_code_prompt",
+        tokenizer,
+        dataset_path,
+        max_length=16,
+        filter_threshold=0.9,
+        max_filter_percentage=0.5,
+    )
+    n0 = len(ds)
+    scores = {str(d["query_id"]): 1.0 for d in dataset[:4]}
+    ds.filter(scores)
+    assert len(ds) < n0
+
+
+def test_prompt_answer_dataset(tokenizer, dataset_path):
+    ds = _make("prompt_answer", tokenizer, dataset_path, max_length=32)
+    s = ds[0]
+    assert s.keys == {"packed_input_ids", "prompt_mask"}
+    toks = s.data["packed_input_ids"]
+    mask = s.data["prompt_mask"]
+    assert toks.shape == mask.shape
+    assert mask[0]  # starts with prompt
+    assert not mask[-1]  # ends with answer/eos
+
+
+def test_rw_paired_dataset(tokenizer, dataset_path):
+    ds = _make("rw_pair", tokenizer, dataset_path, max_length=32)
+    s = ds[0]
+    lens = s.seqlens["packed_input_ids"][0]
+    assert len(lens) % 2 == 0
+    assert s.data["packed_input_ids"].shape[0] == sum(lens)
+
+
+def test_dp_sharding(tokenizer, dataset_path, dataset):
+    parts = []
+    for rank in range(3):
+        ds = dataset_api.make_dataset(
+            DatasetAbstraction("prompt", dict(dataset_path=dataset_path)),
+            seed=7,
+            dp_rank=rank,
+            world_size=3,
+            tokenizer_or_path=tokenizer,
+        )
+        parts.append([ds[i].ids[0] for i in range(len(ds))])
+    all_ids = sum(parts, [])
+    assert len(all_ids) == len(dataset)
+    assert len(set(all_ids)) == len(dataset)
+
+
+def test_dataloader_gathers(tokenizer, dataset_path):
+    ds = _make("prompt", tokenizer, dataset_path)
+    dl = dataset_api.SequenceSampleDataLoader(ds, batch_size=4, seed=0)
+    batch = next(iter(dl))
+    assert batch.bs == 4
+    assert "packed_prompts" in batch.keys

@@ -1,0 +1,86 @@
+"""Shared test fixtures: tiny random datasets and a trained-from-scratch
+tokenizer (mirrors the reference's tests/fixtures.py pattern)."""
+
+import json
+import random
+import uuid
+
+import pytest
+
+TESTING_DATASET_SIZE = 24
+
+_WORDS = (
+    "the quick brown fox jumps over lazy dog and then runs away from big "
+    "scary bear in forest during sunny day while birds sing beautiful songs "
+    "under blue sky with white clouds floating gently"
+).split()
+
+
+def random_sentence(length):
+    return " ".join(random.choices(_WORDS, k=length)) + "\n"
+
+
+@pytest.fixture
+def save_path(tmp_path_factory):
+    return tmp_path_factory.mktemp("save_path")
+
+
+@pytest.fixture
+def dataset(save_path):
+    random.seed(0)
+    rows = []
+    for _ in range(TESTING_DATASET_SIZE):
+        qid = str(uuid.uuid4())
+        n_pairs = random.randint(1, 3)
+        rows.append(
+            dict(
+                id=qid,
+                query_id=qid,
+                prompt=random_sentence(random.randint(1, 8)),
+                solutions=["\\boxed{42}"],
+                answer=random_sentence(random.randint(1, 8)),
+                pos_answers=[
+                    random_sentence(random.randint(1, 8))
+                    for _ in range(n_pairs)
+                ],
+                neg_answers=[
+                    random_sentence(random.randint(1, 8))
+                    for _ in range(n_pairs)
+                ],
+                task="math",
+            )
+        )
+    path = save_path / "dataset.jsonl"
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return rows
+
+
+@pytest.fixture
+def dataset_path(dataset, save_path):
+    return str(save_path / "dataset.jsonl")
+
+
+@pytest.fixture
+def tokenizer(dataset, save_path):
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordPiece
+    from tokenizers.pre_tokenizers import Whitespace
+    from tokenizers.trainers import WordPieceTrainer
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(WordPiece(unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    trainer = WordPieceTrainer(
+        vocab_size=200, special_tokens=["[UNK]", "[PAD]", "[EOS]"]
+    )
+    corpus = [d["prompt"] + d["answer"] for d in dataset]
+    tok.train_from_iterator(corpus, trainer)
+    hf_tok = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        unk_token="[UNK]",
+        pad_token="[PAD]",
+        eos_token="[EOS]",
+    )
+    return hf_tok
