@@ -1,0 +1,89 @@
+"""Transformer architecture config.
+
+TPU-native analogue of the reference's ``ReaLModelConfig``
+(reference: realhf/api/core/model_api.py — model config consumed by
+realhf/impl/model/nn/real_llm_api.py:100).  One config dataclass covers all
+supported HF families (llama/qwen2/qwen3/mistral/gemma/gpt2/mixtral); family
+specific conversion lives in ``areal_tpu/models/hf/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    n_layers: int
+    hidden_dim: int
+    n_q_heads: int
+    n_kv_heads: int
+    head_dim: int
+    intermediate_dim: int
+    vocab_size: int
+    max_position_embeddings: int = 32768
+
+    # architecture knobs
+    activation: str = "silu"  # silu | gelu
+    norm_type: str = "rms"  # rms | layer
+    norm_eps: float = 1e-6
+    rotary_base: float = 10000.0
+    use_attention_bias: bool = False  # qwen2-style qkv bias
+    use_mlp_bias: bool = False
+    tied_embedding: bool = False
+    use_qk_norm: bool = False  # qwen3-style per-head q/k RMSNorm
+    embed_scale: Optional[float] = None  # gemma multiplies embeddings
+    abs_position_embedding: bool = False  # gpt2
+    sliding_window: Optional[int] = None  # mistral
+
+    # MoE (mixtral / qwen3-moe); n_experts=0 disables
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    moe_intermediate_dim: Optional[int] = None
+    moe_aux_loss_coef: float = 0.001
+    moe_z_loss_coef: float = 0.0
+
+    # head
+    is_critic: bool = False  # value head (dim 1) instead of lm head
+
+    # numerics
+    dtype: str = "bfloat16"  # activation/param dtype on device
+    logits_dtype: str = "float32"
+
+    def __post_init__(self):
+        assert self.n_q_heads % self.n_kv_heads == 0
+        assert self.activation in ("silu", "gelu")
+        assert self.norm_type in ("rms", "layer")
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_q_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+
+def tiny_config(
+    vocab_size: int = 256, is_critic: bool = False, **kwargs
+) -> TransformerConfig:
+    """Small config for tests."""
+    defaults = dict(
+        n_layers=2,
+        hidden_dim=32,
+        n_q_heads=4,
+        n_kv_heads=2,
+        head_dim=8,
+        intermediate_dim=64,
+        vocab_size=vocab_size,
+        max_position_embeddings=128,
+        dtype="float32",
+        is_critic=is_critic,
+    )
+    defaults.update(kwargs)
+    return TransformerConfig(**defaults)

@@ -1,0 +1,585 @@
+"""TPU-native transformer.
+
+This is the rebuild of the reference's ``ReaLModel``
+(reference: realhf/impl/model/nn/real_llm_api.py:100 and the modules under
+realhf/impl/model/modules/) as a *pure-functional* JAX model:
+
+* Params are a plain pytree (nested dicts of jnp arrays).  Per-layer params
+  are **stacked along a leading layer axis** and the forward pass runs
+  ``lax.scan`` over layers — fast compiles, and the layer axis is the natural
+  shard target for pipeline parallelism.
+* Batches are padded ``[B, T]`` with **segment ids** (0 = padding): packed
+  varlen sequences are bins of concatenated segments, replacing the
+  reference's flash-attn varlen 1-D packing (realhf/impl/model/modules/attn.py)
+  with the TPU-idiomatic static-shape equivalent.
+* Attention dispatches to a Pallas flash kernel on TPU
+  (areal_tpu/ops/flash_attention.py) and a jnp reference path elsewhere.
+* Sharding is expressed as a PartitionSpec pytree (:func:`param_pspecs`)
+  over the canonical mesh axes (areal_tpu/base/topology.py) — megatron-style
+  tensor parallelism over ``model``, ZeRO-style over ``fsdp`` — and XLA
+  inserts all collectives.
+
+Supported features mirroring the reference model zoo: GQA, RoPE, RMS/LayerNorm,
+qkv bias (qwen2), per-head q/k norm (qwen3), tied embeddings, absolute position
+embeddings (gpt2), embedding scale (gemma), sliding window (mistral), MoE
+(mixtral-style top-k router; see areal_tpu/models/moe.py), and a critic value
+head (reference: realhf/impl/model/nn/real_llm_base.py:358-451).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.models.config import TransformerConfig
+
+Params = Dict[str, Any]
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def _dense_init(key, shape, scale_axis=0):
+    scale = 1.0 / np.sqrt(shape[scale_axis])
+    return jax.random.uniform(
+        key, shape, minval=-scale, maxval=scale, dtype=jnp.float32
+    )
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
+    """Random init (HF-load overwrites this; used by tests and from-scratch)."""
+    keys = iter(jax.random.split(key, 32))
+    L, D, F = cfg.n_layers, cfg.hidden_dim, cfg.intermediate_dim
+    Hq, Hkv, hd = cfg.n_q_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def stack_init(shape, scale_axis=0):
+        k = next(keys)
+        return jax.vmap(
+            lambda kk: _dense_init(kk, shape, scale_axis=scale_axis)
+        )(jax.random.split(k, L))
+
+    attn: Params = {
+        "q": {"w": stack_init((D, Hq * hd))},
+        "k": {"w": stack_init((D, Hkv * hd))},
+        "v": {"w": stack_init((D, Hkv * hd))},
+        "o": {"w": stack_init((Hq * hd, D))},
+    }
+    if cfg.use_attention_bias:
+        attn["q"]["b"] = jnp.zeros((L, Hq * hd), jnp.float32)
+        attn["k"]["b"] = jnp.zeros((L, Hkv * hd), jnp.float32)
+        attn["v"]["b"] = jnp.zeros((L, Hkv * hd), jnp.float32)
+    if cfg.use_qk_norm:
+        attn["q_norm"] = {"scale": jnp.ones((L, hd), jnp.float32)}
+        attn["k_norm"] = {"scale": jnp.ones((L, hd), jnp.float32)}
+
+    if cfg.is_moe:
+        from areal_tpu.models.moe import init_moe_params
+
+        mlp = init_moe_params(cfg, next(keys))
+    else:
+        mlp = {
+            "gate": {"w": stack_init((D, F))},
+            "up": {"w": stack_init((D, F))},
+            "down": {"w": stack_init((F, D), scale_axis=0)},
+        }
+        if cfg.use_mlp_bias:
+            mlp["gate"]["b"] = jnp.zeros((L, F), jnp.float32)
+            mlp["up"]["b"] = jnp.zeros((L, F), jnp.float32)
+            mlp["down"]["b"] = jnp.zeros((L, D), jnp.float32)
+
+    def norm_params(shape):
+        p = {"scale": jnp.ones(shape, jnp.float32)}
+        if cfg.norm_type == "layer":
+            p["bias"] = jnp.zeros(shape, jnp.float32)
+        return p
+
+    params: Params = {
+        "embed": {"weight": _dense_init(next(keys), (cfg.vocab_size, D))},
+        "layers": {
+            "attn_norm": norm_params((L, D)),
+            "attn": attn,
+            "mlp_norm": norm_params((L, D)),
+            "mlp": mlp,
+        },
+        "final_norm": norm_params((D,)),
+    }
+    if cfg.abs_position_embedding:
+        params["pos_embed"] = {
+            "weight": _dense_init(
+                next(keys), (cfg.max_position_embeddings, D)
+            )
+        }
+    if cfg.is_critic:
+        params["value_head"] = {"w": _dense_init(next(keys), (D, 1))}
+    elif not cfg.tied_embedding:
+        params["lm_head"] = {"w": _dense_init(next(keys), (D, cfg.vocab_size))}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules
+# ---------------------------------------------------------------------------
+
+
+def param_pspecs(cfg: TransformerConfig) -> Params:
+    """PartitionSpec pytree matching :func:`init_params`'s structure.
+
+    Megatron-style TP over the ``model`` axis (reference:
+    realhf/impl/model/parallelism/tensor_parallel/modules.py — column/row
+    parallel linears), ZeRO-sharding over ``fsdp``; the stacked layer axis is
+    left for the ``pipe`` axis when pipeline parallelism is enabled.
+    """
+    lp = "pipe" if cfg.n_layers > 1 else None
+
+    def col(bias=False):  # output-dim sharded over model (ColumnParallel)
+        d = {"w": P(lp, "fsdp", "model")}
+        if bias:
+            d["b"] = P(lp, "model")
+        return d
+
+    def row(bias=False):  # input-dim sharded over model (RowParallel)
+        d = {"w": P(lp, "model", "fsdp")}
+        if bias:
+            d["b"] = P(lp, None)
+        return d
+
+    def norm(shape_1d=False):
+        p = {"scale": P(None) if shape_1d else P(lp, None)}
+        if cfg.norm_type == "layer":
+            p["bias"] = P(None) if shape_1d else P(lp, None)
+        return p
+
+    if cfg.is_moe:
+        from areal_tpu.models.moe import moe_pspecs
+
+        mlp = moe_pspecs(cfg, lp)
+    else:
+        mlp = {
+            "gate": col(cfg.use_mlp_bias),
+            "up": col(cfg.use_mlp_bias),
+            "down": row(cfg.use_mlp_bias),
+        }
+        if cfg.use_mlp_bias:
+            mlp["down"]["b"] = P(lp, None)
+
+    attn = {
+        "q": col(cfg.use_attention_bias),
+        "k": col(cfg.use_attention_bias),
+        "v": col(cfg.use_attention_bias),
+        "o": row(),
+    }
+    if cfg.use_qk_norm:
+        attn["q_norm"] = {"scale": P(lp, None)}
+        attn["k_norm"] = {"scale": P(lp, None)}
+
+    specs: Params = {
+        "embed": {"weight": P("model", "fsdp")},
+        "layers": {
+            "attn_norm": norm(),
+            "attn": attn,
+            "mlp_norm": norm(),
+            "mlp": mlp,
+        },
+        "final_norm": norm(shape_1d=True),
+    }
+    if cfg.abs_position_embedding:
+        specs["pos_embed"] = {"weight": P(None, "fsdp")}
+    if cfg.is_critic:
+        specs["value_head"] = {"w": P("fsdp", None)}
+    elif not cfg.tied_embedding:
+        specs["lm_head"] = {"w": P("fsdp", "model")}
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Core ops
+# ---------------------------------------------------------------------------
+
+
+def _norm(x, p, cfg: TransformerConfig):
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    if cfg.norm_type == "rms":
+        x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + cfg.norm_eps)
+        out = x * p["scale"].astype(jnp.float32)
+    else:
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        out = (x - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+        out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return out.astype(dt)
+
+
+def _head_norm(x, scale, eps):
+    # per-head RMSNorm over head_dim (qwen3)
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * scale.astype(jnp.float32)).astype(dt)
+
+
+def rope(x: jax.Array, positions: jax.Array, base: float) -> jax.Array:
+    """Rotary embedding. x: [B, T, H, hd]; positions: [B, T]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,half]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _activation(x, kind: str):
+    if kind == "silu":
+        return jax.nn.silu(x)
+    return jax.nn.gelu(x)
+
+
+def make_attention_mask(
+    seg_q: jax.Array,
+    pos_q: jax.Array,
+    seg_kv: jax.Array,
+    pos_kv: jax.Array,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """[B, Tq, Tkv] bool mask: same segment, causal, non-pad; optional
+    sliding window."""
+    same = seg_q[:, :, None] == seg_kv[:, None, :]
+    causal = pos_q[:, :, None] >= pos_kv[:, None, :]
+    valid = (seg_q[:, :, None] != 0) & (seg_kv[:, None, :] != 0)
+    mask = same & causal & valid
+    if sliding_window is not None:
+        mask &= pos_q[:, :, None] - pos_kv[:, None, :] < sliding_window
+    return mask
+
+
+def reference_attention(q, k, v, mask, logits_dtype=jnp.float32):
+    """jnp attention: q [B,T,Hq,hd], k/v [B,S,Hkv,hd], mask [B,T,S]."""
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    rep = Hq // Hkv
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q.astype(logits_dtype), k.astype(logits_dtype)
+    ) / np.sqrt(hd)
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+    return out
+
+
+def _attention_dispatch(q, k, v, mask, cfg: TransformerConfig):
+    """Pick the attention implementation: Pallas flash on TPU for the
+    self-attention (no-cache) path; jnp reference elsewhere."""
+    use_pallas = (
+        jax.default_backend() == "tpu"
+        and q.shape[1] == k.shape[1]
+        and q.shape[1] >= 128
+    )
+    if use_pallas:
+        try:
+            from areal_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, mask=mask)
+        except Exception:  # pragma: no cover - fallback safety
+            pass
+    return reference_attention(q, k, v, mask)
+
+
+# ---------------------------------------------------------------------------
+# Layer + model forward
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Decode-time KV cache: stacked over layers.
+
+    k/v: [L, B, S, Hkv, hd]; ``lengths``: [B] current per-row lengths (also
+    the insertion offset for the next token); rows are independent so the
+    cache natively supports continuous batching.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array  # [B] int32
+
+    @classmethod
+    def zeros(cls, cfg: TransformerConfig, batch: int, max_len: int, dtype=None):
+        dtype = dtype or jnp.dtype(cfg.dtype)
+        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            lengths=jnp.zeros((batch,), jnp.int32),
+        )
+
+
+jax.tree_util.register_dataclass(
+    KVCache, data_fields=["k", "v", "lengths"], meta_fields=[]
+)
+
+
+def _layer(
+    cfg: TransformerConfig,
+    x: jax.Array,
+    lp: Params,
+    positions: jax.Array,
+    mask: jax.Array,
+    kv: Optional[Tuple[jax.Array, jax.Array]] = None,
+    kv_write_pos: Optional[jax.Array] = None,
+):
+    """One transformer block. Returns (y, (k_full, v_full)) where k/v_full
+    include cached history when provided."""
+    B, T, D = x.shape
+    h = _norm(x, lp["attn_norm"], cfg)
+
+    def proj(p, y):
+        out = y @ p["w"].astype(y.dtype)
+        if "b" in p:
+            out = out + p["b"].astype(y.dtype)
+        return out
+
+    q = proj(lp["attn"]["q"], h).reshape(B, T, cfg.n_q_heads, cfg.head_dim)
+    k = proj(lp["attn"]["k"], h).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = proj(lp["attn"]["v"], h).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.use_qk_norm:
+        q = _head_norm(q, lp["attn"]["q_norm"]["scale"], cfg.norm_eps)
+        k = _head_norm(k, lp["attn"]["k_norm"]["scale"], cfg.norm_eps)
+    if not cfg.abs_position_embedding:
+        q = rope(q, positions, cfg.rotary_base)
+        k = rope(k, positions, cfg.rotary_base)
+
+    if kv is not None:
+        # write new k/v into cache at per-row offsets, attend over full cache
+        k_cache, v_cache = kv
+
+        def write_row(cache_row, new_row, off):
+            return jax.lax.dynamic_update_slice(
+                cache_row, new_row.astype(cache_row.dtype), (off, 0, 0)
+            )
+
+        k_full = jax.vmap(write_row)(k_cache, k, kv_write_pos)
+        v_full = jax.vmap(write_row)(v_cache, v, kv_write_pos)
+        attn_out = reference_attention(q, k_full, v_full, mask)
+    else:
+        k_full = v_full = None
+        attn_out = _attention_dispatch(q, k, v, mask, cfg)
+
+    attn_out = attn_out.reshape(B, T, cfg.n_q_heads * cfg.head_dim)
+    x = x + proj(lp["attn"]["o"], attn_out)
+
+    h = _norm(x, lp["mlp_norm"], cfg)
+    if cfg.is_moe:
+        from areal_tpu.models.moe import moe_mlp
+
+        mlp_out, _aux = moe_mlp(cfg, h, lp["mlp"])
+    else:
+        gate = _activation(proj(lp["mlp"]["gate"], h), cfg.activation)
+        up = proj(lp["mlp"]["up"], h)
+        mlp_out = proj(lp["mlp"]["down"], gate * up)
+    x = x + mlp_out
+    return x, (k_full, v_full)
+
+
+def _embed(params, cfg: TransformerConfig, tokens, positions):
+    x = params["embed"]["weight"].astype(jnp.dtype(cfg.dtype))[tokens]
+    if cfg.embed_scale is not None:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    if cfg.abs_position_embedding:
+        x = x + params["pos_embed"]["weight"].astype(x.dtype)[positions]
+    return x
+
+
+def _head(params, cfg: TransformerConfig, x):
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.is_critic:
+        w = params["value_head"]["w"].astype(x.dtype)
+        return (x @ w)[..., 0].astype(jnp.dtype(cfg.logits_dtype))
+    if cfg.tied_embedding:
+        w = params["embed"]["weight"].astype(x.dtype).T
+    else:
+        w = params["lm_head"]["w"].astype(x.dtype)
+    return (x @ w).astype(jnp.dtype(cfg.logits_dtype))
+
+
+def forward(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [B, T] int32
+    positions: jax.Array,  # [B, T] int32 (within-segment positions)
+    seg_ids: jax.Array,  # [B, T] int32, 0 = padding
+) -> jax.Array:
+    """Full forward over a packed padded batch.
+
+    Returns logits [B, T, V] (or values [B, T] for critics).
+    """
+    x = _embed(params, cfg, tokens, positions)
+    mask = make_attention_mask(
+        seg_ids, positions, seg_ids, positions, cfg.sliding_window
+    )
+
+    def body(carry, lp):
+        y, _ = _layer(cfg, carry, lp, positions, mask)
+        return y, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return _head(params, cfg, x)
+
+
+def prefill(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [B, T]
+    positions: jax.Array,
+    seg_ids: jax.Array,
+    cache: KVCache,
+) -> Tuple[jax.Array, KVCache]:
+    """Run the prompt through the model, filling the KV cache.
+
+    Each batch row is ONE sequence (seg_ids: 1 for real tokens, 0 for right
+    padding).  Returns (logits [B, T, V], cache).
+    """
+    B, T = tokens.shape
+    S = cache.k.shape[2]
+    x = _embed(params, cfg, tokens, positions)
+    # Cache slot s holds the token at absolute position s; a query at
+    # absolute position p attends to slots <= p.  (``positions`` must be
+    # absolute, i.e. offset by cache.lengths when continuing a sequence.)
+    kv_pos = jnp.arange(S)[None, None, :]  # [1,1,S]
+    mask = (kv_pos <= positions[:, :, None]) & (seg_ids != 0)[:, :, None]
+    if cfg.sliding_window is not None:
+        mask &= positions[:, :, None] - kv_pos < cfg.sliding_window
+    write_pos = cache.lengths  # [B]
+
+    def body(carry, xs):
+        lp, kc, vc = xs
+        y, (k_full, v_full) = _layer(
+            cfg, carry, lp, positions, mask, kv=(kc, vc), kv_write_pos=write_pos
+        )
+        return y, (k_full, v_full)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    new_lengths = cache.lengths + jnp.sum(seg_ids != 0, axis=1).astype(jnp.int32)
+    logits = _head(params, cfg, x)
+    return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
+
+
+def decode_step(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [B] int32 — next token per row
+    cache: KVCache,
+    active: Optional[jax.Array] = None,  # [B] bool; inactive rows don't advance
+) -> Tuple[jax.Array, KVCache]:
+    """One decode step for all rows. Returns (logits [B, V], new cache)."""
+    B = tokens.shape[0]
+    S = cache.k.shape[2]
+    if active is None:
+        active = jnp.ones((B,), bool)
+    positions = cache.lengths[:, None]  # [B,1]
+    x = _embed(params, cfg, tokens[:, None], positions)
+    # mask over cache: attend to slots < length+1 for active rows
+    kv_pos = jnp.arange(S)[None, :]  # [1,S]
+    mask = kv_pos <= positions  # [B, S]
+    if cfg.sliding_window is not None:
+        mask &= positions - kv_pos < cfg.sliding_window
+    mask = mask[:, None, :]  # [B, 1(Tq), S]
+
+    def body(carry, xs):
+        lp, kc, vc = xs
+        y, (k_full, v_full) = _layer(
+            cfg,
+            carry,
+            lp,
+            positions,
+            mask,
+            kv=(kc, vc),
+            kv_write_pos=cache.lengths,
+        )
+        return y, (k_full, v_full)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    logits = _head(params, cfg, x)[:, 0]
+    # freeze inactive rows: keep old cache content & lengths
+    new_k = jnp.where(active[None, :, None, None, None], new_k, cache.k)
+    new_v = jnp.where(active[None, :, None, None, None], new_v, cache.v)
+    new_lengths = cache.lengths + active.astype(jnp.int32)
+    return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
+
+
+# ---------------------------------------------------------------------------
+# Memory-lean logprob computation (no [B,T,V] materialization)
+# ---------------------------------------------------------------------------
+
+
+def logprobs_of_labels(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [B,T]
+    positions: jax.Array,
+    seg_ids: jax.Array,
+) -> jax.Array:
+    """log p(tokens[t+1] | tokens[<=t]) — shape [B, T-1].
+
+    Used by PPO inference passes (reference recomputes logprobs at
+    realhf/impl/model/interface/ppo_interface.py:474); computes the head in
+    chunks so the full-vocab logits for long contexts never materialize.
+    """
+    x = _embed(params, cfg, tokens, positions)
+    mask = make_attention_mask(
+        seg_ids, positions, seg_ids, positions, cfg.sliding_window
+    )
+
+    def body(carry, lp):
+        y, _ = _layer(cfg, carry, lp, positions, mask)
+        return y, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tied_embedding:
+        w = params["embed"]["weight"].astype(x.dtype).T
+    else:
+        w = params["lm_head"]["w"].astype(x.dtype)
+
+    labels = tokens[:, 1:]
+    hs = x[:, :-1]  # hidden predicting next token
+
+    chunk = 1024
+
+    B, Tm1, D = hs.shape
+    pad = (-Tm1) % chunk
+    hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+    labels_p = jnp.pad(labels, ((0, 0), (0, pad)))
+    n_chunks = hs.shape[1] // chunk
+    hs = hs.reshape(B, n_chunks, chunk, D)
+    labels_p = labels_p.reshape(B, n_chunks, chunk)
+
+    def chunk_body(_, xs):
+        h, lab = xs  # [B,chunk,D], [B,chunk]
+        logits = (h @ w).astype(jnp.float32)  # [B,chunk,V]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, lab[..., None], axis=-1)[..., 0]
+        return None, tgt - lse
+
+    _, lps = jax.lax.scan(
+        chunk_body, None, (hs.swapaxes(0, 1), labels_p.swapaxes(0, 1))
+    )
+    lps = lps.swapaxes(0, 1).reshape(B, -1)[:, :Tm1]
+    return lps

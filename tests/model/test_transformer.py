@@ -1,0 +1,174 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import (
+    KVCache,
+    decode_step,
+    forward,
+    init_params,
+    logprobs_of_labels,
+    param_pspecs,
+    prefill,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _single_row(tokens):
+    t = jnp.asarray(tokens, jnp.int32)[None, :]
+    pos = jnp.arange(t.shape[1], dtype=jnp.int32)[None, :]
+    seg = jnp.ones_like(t)
+    return t, pos, seg
+
+
+def test_forward_shapes(cfg, params):
+    tokens, pos, seg = _single_row(np.arange(10) % cfg.vocab_size)
+    logits = forward(params, cfg, tokens, pos, seg)
+    assert logits.shape == (1, 10, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_critic_head_shape():
+    cfg = tiny_config(is_critic=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens, pos, seg = _single_row(np.arange(8))
+    values = forward(params, cfg, tokens, pos, seg)
+    assert values.shape == (1, 8)
+
+
+def test_packing_equivalence(cfg, params):
+    """Two sequences packed into one row with segment ids give the same
+    logits as running them in separate rows."""
+    rng = np.random.RandomState(0)
+    a = rng.randint(0, cfg.vocab_size, size=6)
+    b = rng.randint(0, cfg.vocab_size, size=4)
+    # packed row: [a, b, pad pad]
+    packed_tokens = jnp.asarray(
+        np.concatenate([a, b, [0, 0]]), jnp.int32
+    )[None, :]
+    packed_pos = jnp.asarray(
+        np.concatenate([np.arange(6), np.arange(4), [0, 0]]), jnp.int32
+    )[None, :]
+    packed_seg = jnp.asarray(
+        np.concatenate([[1] * 6, [2] * 4, [0, 0]]), jnp.int32
+    )[None, :]
+    packed_logits = forward(params, cfg, packed_tokens, packed_pos, packed_seg)
+
+    ta, pa, sa = _single_row(a)
+    tb, pb, sb = _single_row(b)
+    la = forward(params, cfg, ta, pa, sa)
+    lb = forward(params, cfg, tb, pb, sb)
+
+    np.testing.assert_allclose(packed_logits[0, :6], la[0], atol=2e-5)
+    np.testing.assert_allclose(packed_logits[0, 6:10], lb[0], atol=2e-5)
+
+
+def test_padding_invariance(cfg, params):
+    tokens, pos, seg = _single_row(np.arange(5))
+    base = forward(params, cfg, tokens, pos, seg)
+    # add right padding
+    t2 = jnp.pad(tokens, ((0, 0), (0, 3)))
+    p2 = jnp.pad(pos, ((0, 0), (0, 3)))
+    s2 = jnp.pad(seg, ((0, 0), (0, 3)))
+    padded = forward(params, cfg, t2, p2, s2)
+    np.testing.assert_allclose(padded[0, :5], base[0], atol=2e-5)
+
+
+def test_prefill_decode_matches_forward(cfg, params):
+    """Greedy decode token-by-token must match teacher-forced forward."""
+    rng = np.random.RandomState(1)
+    seq = rng.randint(1, cfg.vocab_size, size=12)
+    prompt, rest = seq[:5], seq[5:]
+
+    tokens, pos, seg = _single_row(seq)
+    full_logits = forward(params, cfg, tokens, pos, seg)
+
+    cache = KVCache.zeros(cfg, batch=1, max_len=32, dtype=jnp.float32)
+    pt, pp, ps = _single_row(prompt)
+    logits, cache = prefill(params, cfg, pt, pp, ps, cache)
+    np.testing.assert_allclose(logits[0], full_logits[0, :5], atol=2e-5)
+    assert int(cache.lengths[0]) == 5
+
+    # decode the rest
+    for i, tok in enumerate(rest):
+        step_logits, cache = decode_step(
+            params, cfg, jnp.asarray([tok], jnp.int32), cache
+        )
+        np.testing.assert_allclose(
+            step_logits[0], full_logits[0, 5 + i], atol=3e-5
+        )
+    assert int(cache.lengths[0]) == 12
+
+
+def test_decode_inactive_rows_frozen(cfg, params):
+    cache = KVCache.zeros(cfg, batch=2, max_len=16, dtype=jnp.float32)
+    toks = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    pos = jnp.tile(jnp.arange(3), (2, 1))
+    seg = jnp.ones_like(toks)
+    _, cache = prefill(params, cfg, toks, pos, seg, cache)
+    active = jnp.asarray([True, False])
+    _, cache2 = decode_step(
+        params, cfg, jnp.asarray([7, 8], jnp.int32), cache, active=active
+    )
+    assert int(cache2.lengths[0]) == 4
+    assert int(cache2.lengths[1]) == 3
+    np.testing.assert_array_equal(cache2.k[:, 1], cache.k[:, 1])
+
+
+def test_logprobs_of_labels(cfg, params):
+    tokens, pos, seg = _single_row(np.arange(1, 9))
+    logits = forward(params, cfg, tokens, pos, seg)
+    ref = jax.nn.log_softmax(logits, axis=-1)
+    expected = np.take_along_axis(
+        np.asarray(ref[0, :-1]), np.asarray(tokens[0, 1:])[:, None], axis=-1
+    )[:, 0]
+    got = logprobs_of_labels(params, cfg, tokens, pos, seg)
+    np.testing.assert_allclose(got[0], expected, atol=1e-5)
+
+
+def test_param_pspecs_structure(cfg, params):
+    specs = param_pspecs(cfg)
+    # same tree structure
+    jax.tree_util.tree_map(lambda p, s: None, params, specs)
+
+
+def test_gpt2_style_config():
+    cfg = tiny_config(
+        norm_type="layer",
+        abs_position_embedding=True,
+        tied_embedding=True,
+        activation="gelu",
+        use_attention_bias=True,
+        use_mlp_bias=True,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens, pos, seg = _single_row(np.arange(6))
+    logits = forward(params, cfg, tokens, pos, seg)
+    assert logits.shape == (1, 6, cfg.vocab_size)
+
+
+def test_qwen3_style_qk_norm():
+    cfg = tiny_config(use_qk_norm=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens, pos, seg = _single_row(np.arange(6))
+    assert forward(params, cfg, tokens, pos, seg).shape == (1, 6, cfg.vocab_size)
+
+
+def test_moe_forward():
+    cfg = tiny_config(n_experts=4, n_experts_per_tok=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens, pos, seg = _single_row(np.arange(6))
+    logits = forward(params, cfg, tokens, pos, seg)
+    assert logits.shape == (1, 6, cfg.vocab_size)
+    assert not np.any(np.isnan(logits))
