@@ -31,6 +31,7 @@ class TransformerConfig:
     rotary_base: float = 10000.0
     use_attention_bias: bool = False  # qwen2-style qkv bias
     use_mlp_bias: bool = False
+    gated_mlp: bool = True  # SwiGLU-style; False = plain fc->act->proj (gpt2)
     tied_embedding: bool = False
     use_qk_norm: bool = False  # qwen3-style per-head q/k RMSNorm
     embed_scale: Optional[float] = None  # gemma multiplies embeddings

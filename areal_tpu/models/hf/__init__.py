@@ -1,0 +1,9 @@
+"""HF family adapters.  Importing registers all families."""
+
+from areal_tpu.models.hf import gpt2, llama_like, mixtral  # noqa: F401
+from areal_tpu.models.hf.registry import (  # noqa: F401
+    get_hf_family,
+    load_hf_config,
+    load_hf_model,
+    save_hf_model,
+)

@@ -1,0 +1,175 @@
+"""GPT-2 HF adapter (reference: realhf/api/from_hf/gpt2.py).
+
+GPT-2 quirks: LayerNorm with bias, absolute position embeddings, fused qkv
+``c_attn`` stored in Conv1D layout ([in, out] — NOT transposed like Linear),
+gelu, tied LM head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf.registry import (
+    HFFamily,
+    StateDict,
+    register_hf_family,
+    stack_layers,
+    to_np,
+)
+
+
+def _config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
+    return TransformerConfig(
+        n_layers=hf["n_layer"],
+        hidden_dim=hf["n_embd"],
+        n_q_heads=hf["n_head"],
+        n_kv_heads=hf["n_head"],
+        head_dim=hf["n_embd"] // hf["n_head"],
+        intermediate_dim=hf.get("n_inner") or 4 * hf["n_embd"],
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("n_positions", 1024),
+        activation="gelu",
+        norm_type="layer",
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        use_attention_bias=True,
+        use_mlp_bias=True,
+        gated_mlp=False,
+        tied_embedding=True,
+        abs_position_embedding=True,
+    )
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    return dict(
+        architectures=["GPT2LMHeadModel"],
+        model_type="gpt2",
+        n_layer=cfg.n_layers,
+        n_embd=cfg.hidden_dim,
+        n_head=cfg.n_q_heads,
+        n_inner=cfg.intermediate_dim,
+        vocab_size=cfg.vocab_size,
+        n_positions=cfg.max_position_embeddings,
+        n_ctx=cfg.max_position_embeddings,
+        layer_norm_epsilon=cfg.norm_eps,
+        activation_function="gelu_new",
+    )
+
+
+def _strip_prefix(state: StateDict) -> StateDict:
+    if any(k.startswith("transformer.") for k in state):
+        return {
+            k[len("transformer.") :]: v
+            for k, v in state.items()
+            if k.startswith("transformer.")
+        }
+    return state
+
+
+def _params_from_hf(state: StateDict, cfg: TransformerConfig) -> Dict[str, Any]:
+    state = _strip_prefix(state)
+    L, D = cfg.n_layers, cfg.hidden_dim
+    g = lambda n: to_np(state[n])
+
+    qw, kw, vw, qb, kb, vb = [], [], [], [], [], []
+    for i in range(L):
+        w = g(f"h.{i}.attn.c_attn.weight")  # [D, 3D] Conv1D layout
+        b = g(f"h.{i}.attn.c_attn.bias")  # [3D]
+        qw.append(w[:, :D]); kw.append(w[:, D : 2 * D]); vw.append(w[:, 2 * D :])
+        qb.append(b[:D]); kb.append(b[D : 2 * D]); vb.append(b[2 * D :])
+
+    def conv_stack(fmt):
+        return jnp.asarray(stack_layers([g(fmt.format(i=i)) for i in range(L)]))
+
+    params: Dict[str, Any] = {
+        "embed": {"weight": jnp.asarray(g("wte.weight"))},
+        "pos_embed": {"weight": jnp.asarray(g("wpe.weight"))},
+        "layers": {
+            "attn_norm": {
+                "scale": conv_stack("h.{i}.ln_1.weight"),
+                "bias": conv_stack("h.{i}.ln_1.bias"),
+            },
+            "attn": {
+                "q": {"w": jnp.asarray(stack_layers(qw)), "b": jnp.asarray(stack_layers(qb))},
+                "k": {"w": jnp.asarray(stack_layers(kw)), "b": jnp.asarray(stack_layers(kb))},
+                "v": {"w": jnp.asarray(stack_layers(vw)), "b": jnp.asarray(stack_layers(vb))},
+                "o": {
+                    "w": conv_stack("h.{i}.attn.c_proj.weight"),
+                    "b": conv_stack("h.{i}.attn.c_proj.bias"),
+                },
+            },
+            "mlp_norm": {
+                "scale": conv_stack("h.{i}.ln_2.weight"),
+                "bias": conv_stack("h.{i}.ln_2.bias"),
+            },
+            "mlp": {
+                # non-gated mlp: "gate" is the fc layer (cfg.gated_mlp=False)
+                "gate": {
+                    "w": conv_stack("h.{i}.mlp.c_fc.weight"),
+                    "b": conv_stack("h.{i}.mlp.c_fc.bias"),
+                },
+                "down": {
+                    "w": conv_stack("h.{i}.mlp.c_proj.weight"),
+                    "b": conv_stack("h.{i}.mlp.c_proj.bias"),
+                },
+            },
+        },
+        "final_norm": {
+            "scale": jnp.asarray(g("ln_f.weight")),
+            "bias": jnp.asarray(g("ln_f.bias")),
+        },
+    }
+    return params
+
+
+def _params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> StateDict:
+    out: StateDict = {}
+    np_ = lambda x: np.asarray(x, np.float32)
+    lay = params["layers"]
+    out["wte.weight"] = np_(params["embed"]["weight"])
+    out["wpe.weight"] = np_(params["pos_embed"]["weight"])
+    for i in range(cfg.n_layers):
+        pre = f"h.{i}."
+        out[pre + "ln_1.weight"] = np_(lay["attn_norm"]["scale"][i])
+        out[pre + "ln_1.bias"] = np_(lay["attn_norm"]["bias"][i])
+        out[pre + "ln_2.weight"] = np_(lay["mlp_norm"]["scale"][i])
+        out[pre + "ln_2.bias"] = np_(lay["mlp_norm"]["bias"][i])
+        out[pre + "attn.c_attn.weight"] = np.concatenate(
+            [
+                np_(lay["attn"]["q"]["w"][i]),
+                np_(lay["attn"]["k"]["w"][i]),
+                np_(lay["attn"]["v"]["w"][i]),
+            ],
+            axis=1,
+        )
+        out[pre + "attn.c_attn.bias"] = np.concatenate(
+            [
+                np_(lay["attn"]["q"]["b"][i]),
+                np_(lay["attn"]["k"]["b"][i]),
+                np_(lay["attn"]["v"]["b"][i]),
+            ]
+        )
+        out[pre + "attn.c_proj.weight"] = np_(lay["attn"]["o"]["w"][i])
+        out[pre + "attn.c_proj.bias"] = np_(lay["attn"]["o"]["b"][i])
+        out[pre + "mlp.c_fc.weight"] = np_(lay["mlp"]["gate"]["w"][i])
+        out[pre + "mlp.c_fc.bias"] = np_(lay["mlp"]["gate"]["b"][i])
+        out[pre + "mlp.c_proj.weight"] = np_(lay["mlp"]["down"]["w"][i])
+        out[pre + "mlp.c_proj.bias"] = np_(lay["mlp"]["down"]["b"][i])
+    out["ln_f.weight"] = np_(params["final_norm"]["scale"])
+    out["ln_f.bias"] = np_(params["final_norm"]["bias"])
+    return out
+
+
+register_hf_family(
+    HFFamily(
+        name="gpt2",
+        hf_architecture="GPT2LMHeadModel",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=_params_from_hf,
+        params_to_hf=_params_to_hf,
+    )
+)

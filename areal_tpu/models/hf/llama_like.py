@@ -1,0 +1,292 @@
+"""Llama-family HF adapters: llama, qwen2, qwen3, mistral, gemma.
+
+(reference: realhf/api/from_hf/{llama,qwen2,qwen3,mistral,gemma}.py — each
+registers config+param converters via register_hf_family.)
+
+These share the ``model.layers.{i}.self_attn.*`` naming; family differences
+are bias flags, qk-norm, sliding window, tied embeddings, norm offset
+(gemma stores RMSNorm scale as weight+1) and embedding scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf.registry import (
+    HFFamily,
+    StateDict,
+    register_hf_family,
+    stack_layers,
+    to_np,
+)
+
+
+def _llama_like_config_from_hf(hf: Dict[str, Any], **overrides) -> TransformerConfig:
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+    kwargs = dict(
+        n_layers=hf["num_hidden_layers"],
+        hidden_dim=hf["hidden_size"],
+        n_q_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        intermediate_dim=hf["intermediate_size"],
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("max_position_embeddings", 32768),
+        norm_eps=hf.get("rms_norm_eps", 1e-6),
+        rotary_base=hf.get("rope_theta", 10000.0),
+        tied_embedding=hf.get("tie_word_embeddings", False),
+        sliding_window=(
+            hf.get("sliding_window")
+            if hf.get("use_sliding_window", True)
+            else None
+        ),
+    )
+    kwargs.update(overrides)
+    return TransformerConfig(**kwargs)
+
+
+def _llama_like_config_to_hf(
+    cfg: TransformerConfig, model_type: str, architecture: str, **extra
+) -> Dict[str, Any]:
+    hf = dict(
+        architectures=[architecture],
+        model_type=model_type,
+        hidden_size=cfg.hidden_dim,
+        intermediate_size=cfg.intermediate_dim,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_q_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim,
+        vocab_size=cfg.vocab_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rotary_base,
+        tie_word_embeddings=cfg.tied_embedding,
+        hidden_act="silu" if cfg.activation == "silu" else "gelu_pytorch_tanh",
+        torch_dtype="bfloat16",
+    )
+    if cfg.sliding_window is not None:
+        hf["sliding_window"] = cfg.sliding_window
+        hf["use_sliding_window"] = True
+    hf.update(extra)
+    return hf
+
+
+def _params_from_hf(
+    state: StateDict,
+    cfg: TransformerConfig,
+    qkv_bias: bool = False,
+    qk_norm: bool = False,
+    gemma_norm: bool = False,
+) -> Dict[str, Any]:
+    L = cfg.n_layers
+    g = lambda name: to_np(state[name])
+
+    def layer_stack(fmt: str, transpose: bool = True):
+        mats = [g(fmt.format(i=i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]  # torch [out,in] -> ours [in,out]
+        return jnp.asarray(stack_layers(mats))
+
+    def norm_stack(fmt: str):
+        mats = [g(fmt.format(i=i)) for i in range(L)]
+        if gemma_norm:
+            mats = [m + 1.0 for m in mats]
+        return jnp.asarray(stack_layers(mats))
+
+    attn: Dict[str, Any] = {
+        "q": {"w": layer_stack("model.layers.{i}.self_attn.q_proj.weight")},
+        "k": {"w": layer_stack("model.layers.{i}.self_attn.k_proj.weight")},
+        "v": {"w": layer_stack("model.layers.{i}.self_attn.v_proj.weight")},
+        "o": {"w": layer_stack("model.layers.{i}.self_attn.o_proj.weight")},
+    }
+    if qkv_bias:
+        attn["q"]["b"] = layer_stack(
+            "model.layers.{i}.self_attn.q_proj.bias", transpose=False
+        )
+        attn["k"]["b"] = layer_stack(
+            "model.layers.{i}.self_attn.k_proj.bias", transpose=False
+        )
+        attn["v"]["b"] = layer_stack(
+            "model.layers.{i}.self_attn.v_proj.bias", transpose=False
+        )
+    if qk_norm:
+        attn["q_norm"] = {
+            "scale": norm_stack("model.layers.{i}.self_attn.q_norm.weight")
+        }
+        attn["k_norm"] = {
+            "scale": norm_stack("model.layers.{i}.self_attn.k_norm.weight")
+        }
+
+    final_norm = to_np(state["model.norm.weight"])
+    if gemma_norm:
+        final_norm = final_norm + 1.0
+
+    params: Dict[str, Any] = {
+        "embed": {"weight": jnp.asarray(to_np(state["model.embed_tokens.weight"]))},
+        "layers": {
+            "attn_norm": {
+                "scale": norm_stack("model.layers.{i}.input_layernorm.weight")
+            },
+            "attn": attn,
+            "mlp_norm": {
+                "scale": norm_stack(
+                    "model.layers.{i}.post_attention_layernorm.weight"
+                )
+            },
+            "mlp": {
+                "gate": {
+                    "w": layer_stack("model.layers.{i}.mlp.gate_proj.weight")
+                },
+                "up": {"w": layer_stack("model.layers.{i}.mlp.up_proj.weight")},
+                "down": {
+                    "w": layer_stack("model.layers.{i}.mlp.down_proj.weight")
+                },
+            },
+        },
+        "final_norm": {"scale": jnp.asarray(final_norm)},
+    }
+    if not cfg.tied_embedding and not cfg.is_critic:
+        params["lm_head"] = {
+            "w": jnp.asarray(to_np(state["lm_head.weight"]).T)
+        }
+    return params
+
+
+def _params_to_hf(
+    params: Dict[str, Any],
+    cfg: TransformerConfig,
+    qkv_bias: bool = False,
+    qk_norm: bool = False,
+    gemma_norm: bool = False,
+) -> StateDict:
+    out: StateDict = {}
+    np_ = lambda x: np.asarray(x, dtype=np.float32)
+    out["model.embed_tokens.weight"] = np_(params["embed"]["weight"])
+    lay = params["layers"]
+    L = cfg.n_layers
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        norm_off = -1.0 if gemma_norm else 0.0
+        out[pre + "input_layernorm.weight"] = (
+            np_(lay["attn_norm"]["scale"][i]) + norm_off
+        )
+        out[pre + "post_attention_layernorm.weight"] = (
+            np_(lay["mlp_norm"]["scale"][i]) + norm_off
+        )
+        for ours, theirs in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj"), ("o", "o_proj")):
+            out[pre + f"self_attn.{theirs}.weight"] = np_(
+                lay["attn"][ours]["w"][i]
+            ).T
+            if qkv_bias and ours != "o":
+                out[pre + f"self_attn.{theirs}.bias"] = np_(
+                    lay["attn"][ours]["b"][i]
+                )
+        if qk_norm:
+            out[pre + "self_attn.q_norm.weight"] = (
+                np_(lay["attn"]["q_norm"]["scale"][i]) + norm_off
+            )
+            out[pre + "self_attn.k_norm.weight"] = (
+                np_(lay["attn"]["k_norm"]["scale"][i]) + norm_off
+            )
+        for ours, theirs in (("gate", "gate_proj"), ("up", "up_proj"), ("down", "down_proj")):
+            out[pre + f"mlp.{theirs}.weight"] = np_(
+                lay["mlp"][ours]["w"][i]
+            ).T
+    out["model.norm.weight"] = np_(params["final_norm"]["scale"]) + (
+        -1.0 if gemma_norm else 0.0
+    )
+    if "lm_head" in params:
+        out["lm_head.weight"] = np_(params["lm_head"]["w"]).T
+    if "value_head" in params:
+        out["value_head.weight"] = np_(params["value_head"]["w"]).T
+    return out
+
+
+register_hf_family(
+    HFFamily(
+        name="llama",
+        hf_architecture="LlamaForCausalLM",
+        config_from_hf=lambda hf: _llama_like_config_from_hf(hf),
+        config_to_hf=lambda cfg: _llama_like_config_to_hf(
+            cfg, "llama", "LlamaForCausalLM"
+        ),
+        params_from_hf=lambda s, c: _params_from_hf(s, c),
+        params_to_hf=lambda p, c: _params_to_hf(p, c),
+    )
+)
+
+register_hf_family(
+    HFFamily(
+        name="qwen2",
+        hf_architecture="Qwen2ForCausalLM",
+        config_from_hf=lambda hf: _llama_like_config_from_hf(
+            hf, use_attention_bias=True
+        ),
+        config_to_hf=lambda cfg: _llama_like_config_to_hf(
+            cfg, "qwen2", "Qwen2ForCausalLM"
+        ),
+        params_from_hf=lambda s, c: _params_from_hf(s, c, qkv_bias=True),
+        params_to_hf=lambda p, c: _params_to_hf(p, c, qkv_bias=True),
+    )
+)
+
+register_hf_family(
+    HFFamily(
+        name="qwen3",
+        hf_architecture="Qwen3ForCausalLM",
+        config_from_hf=lambda hf: _llama_like_config_from_hf(
+            hf, use_qk_norm=True
+        ),
+        config_to_hf=lambda cfg: _llama_like_config_to_hf(
+            cfg, "qwen3", "Qwen3ForCausalLM"
+        ),
+        params_from_hf=lambda s, c: _params_from_hf(s, c, qk_norm=True),
+        params_to_hf=lambda p, c: _params_to_hf(p, c, qk_norm=True),
+    )
+)
+
+register_hf_family(
+    HFFamily(
+        name="mistral",
+        hf_architecture="MistralForCausalLM",
+        config_from_hf=lambda hf: _llama_like_config_from_hf(hf),
+        config_to_hf=lambda cfg: _llama_like_config_to_hf(
+            cfg, "mistral", "MistralForCausalLM"
+        ),
+        params_from_hf=lambda s, c: _params_from_hf(s, c),
+        params_to_hf=lambda p, c: _params_to_hf(p, c),
+    )
+)
+
+
+def _gemma_config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
+    cfg = _llama_like_config_from_hf(
+        hf,
+        activation="gelu",
+        tied_embedding=True,
+        embed_scale=float(np.sqrt(hf["hidden_size"])),
+    )
+    return cfg
+
+
+register_hf_family(
+    HFFamily(
+        name="gemma",
+        hf_architecture="GemmaForCausalLM",
+        config_from_hf=_gemma_config_from_hf,
+        config_to_hf=lambda cfg: _llama_like_config_to_hf(
+            cfg,
+            "gemma",
+            "GemmaForCausalLM",
+            hidden_act="gelu_pytorch_tanh",
+        ),
+        params_from_hf=lambda s, c: _params_from_hf(s, c, gemma_norm=True),
+        params_to_hf=lambda p, c: _params_to_hf(p, c, gemma_norm=True),
+    )
+)

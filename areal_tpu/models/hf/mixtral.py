@@ -1,0 +1,171 @@
+"""Mixtral (MoE) HF adapter (reference: realhf/api/from_hf/mixtral.py).
+
+HF expert weights are per-expert Linears ``block_sparse_moe.experts.{e}.w1/w2/w3``
+(w1=gate [F,D], w2=down [D,F], w3=up [F,D]); we stack them to [L, E, D, F]
+for the ragged-dot MoE path (areal_tpu/models/moe.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf.registry import (
+    HFFamily,
+    StateDict,
+    register_hf_family,
+    stack_layers,
+    to_np,
+)
+
+
+def _config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
+    return TransformerConfig(
+        n_layers=hf["num_hidden_layers"],
+        hidden_dim=hf["hidden_size"],
+        n_q_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf["hidden_size"] // hf["num_attention_heads"],
+        intermediate_dim=hf["intermediate_size"],
+        moe_intermediate_dim=hf["intermediate_size"],
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("max_position_embeddings", 32768),
+        norm_eps=hf.get("rms_norm_eps", 1e-5),
+        rotary_base=hf.get("rope_theta", 1e6),
+        n_experts=hf["num_local_experts"],
+        n_experts_per_tok=hf["num_experts_per_tok"],
+        moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.001),
+        sliding_window=hf.get("sliding_window"),
+    )
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    return dict(
+        architectures=["MixtralForCausalLM"],
+        model_type="mixtral",
+        hidden_size=cfg.hidden_dim,
+        intermediate_size=cfg.moe_intermediate_dim or cfg.intermediate_dim,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_q_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        vocab_size=cfg.vocab_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rotary_base,
+        num_local_experts=cfg.n_experts,
+        num_experts_per_tok=cfg.n_experts_per_tok,
+        router_aux_loss_coef=cfg.moe_aux_loss_coef,
+        sliding_window=cfg.sliding_window,
+        torch_dtype="bfloat16",
+    )
+
+
+def _params_from_hf(state: StateDict, cfg: TransformerConfig) -> Dict[str, Any]:
+    L, E = cfg.n_layers, cfg.n_experts
+    g = lambda n: to_np(state[n])
+
+    def layer_stack(fmt, transpose=True):
+        mats = [g(fmt.format(i=i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(stack_layers(mats))
+
+    def expert_stack(w_name):  # -> [L, E, in, out]
+        per_layer = []
+        for i in range(L):
+            per_exp = [
+                g(
+                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"
+                ).T
+                for e in range(E)
+            ]
+            per_layer.append(np.stack(per_exp, axis=0))
+        return jnp.asarray(np.stack(per_layer, axis=0))
+
+    params: Dict[str, Any] = {
+        "embed": {"weight": jnp.asarray(g("model.embed_tokens.weight"))},
+        "layers": {
+            "attn_norm": {
+                "scale": layer_stack(
+                    "model.layers.{i}.input_layernorm.weight", transpose=False
+                )
+            },
+            "attn": {
+                "q": {"w": layer_stack("model.layers.{i}.self_attn.q_proj.weight")},
+                "k": {"w": layer_stack("model.layers.{i}.self_attn.k_proj.weight")},
+                "v": {"w": layer_stack("model.layers.{i}.self_attn.v_proj.weight")},
+                "o": {"w": layer_stack("model.layers.{i}.self_attn.o_proj.weight")},
+            },
+            "mlp_norm": {
+                "scale": layer_stack(
+                    "model.layers.{i}.post_attention_layernorm.weight",
+                    transpose=False,
+                )
+            },
+            "mlp": {
+                "router": {
+                    "w": layer_stack(
+                        "model.layers.{i}.block_sparse_moe.gate.weight"
+                    )
+                },
+                "experts": {
+                    "gate": expert_stack("w1"),
+                    "down": expert_stack("w2"),
+                    "up": expert_stack("w3"),
+                },
+            },
+        },
+        "final_norm": {"scale": jnp.asarray(g("model.norm.weight"))},
+    }
+    if not cfg.is_critic:
+        params["lm_head"] = {"w": jnp.asarray(g("lm_head.weight").T)}
+    return params
+
+
+def _params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> StateDict:
+    out: StateDict = {}
+    np_ = lambda x: np.asarray(x, np.float32)
+    lay = params["layers"]
+    out["model.embed_tokens.weight"] = np_(params["embed"]["weight"])
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        out[pre + "input_layernorm.weight"] = np_(lay["attn_norm"]["scale"][i])
+        out[pre + "post_attention_layernorm.weight"] = np_(
+            lay["mlp_norm"]["scale"][i]
+        )
+        for ours, theirs in (
+            ("q", "q_proj"),
+            ("k", "k_proj"),
+            ("v", "v_proj"),
+            ("o", "o_proj"),
+        ):
+            out[pre + f"self_attn.{theirs}.weight"] = np_(
+                lay["attn"][ours]["w"][i]
+            ).T
+        out[pre + "block_sparse_moe.gate.weight"] = np_(
+            lay["mlp"]["router"]["w"][i]
+        ).T
+        for e in range(cfg.n_experts):
+            base = pre + f"block_sparse_moe.experts.{e}."
+            out[base + "w1.weight"] = np_(lay["mlp"]["experts"]["gate"][i, e]).T
+            out[base + "w2.weight"] = np_(lay["mlp"]["experts"]["down"][i, e]).T
+            out[base + "w3.weight"] = np_(lay["mlp"]["experts"]["up"][i, e]).T
+    out["model.norm.weight"] = np_(params["final_norm"]["scale"])
+    if "lm_head" in params:
+        out["lm_head.weight"] = np_(params["lm_head"]["w"]).T
+    return out
+
+
+register_hf_family(
+    HFFamily(
+        name="mixtral",
+        hf_architecture="MixtralForCausalLM",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=_params_from_hf,
+        params_to_hf=_params_to_hf,
+    )
+)

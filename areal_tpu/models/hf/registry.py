@@ -1,0 +1,203 @@
+"""HF checkpoint import/export registry.
+
+Rebuild of the reference's bidirectional ReaL<->HF conversion
+(reference: realhf/impl/model/conversion/hf_registry.py:33 ``HFModelRegistry``,
+family adapters realhf/api/from_hf/*.py registered via ``register_hf_family``).
+
+Each family provides: config conversion (HF config.json <-> TransformerConfig)
+and param-tree conversion (HF state dict of numpy arrays <-> our stacked-layer
+pytree).  Loading reads sharded safetensors; saving writes safetensors +
+config.json that ``transformers`` can load back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.base import logging_
+from areal_tpu.models.config import TransformerConfig
+
+logger = logging_.getLogger("hf_registry")
+
+StateDict = Dict[str, np.ndarray]
+
+
+@dataclasses.dataclass
+class HFFamily:
+    name: str
+    hf_architecture: str
+    config_from_hf: Callable[[Dict[str, Any]], TransformerConfig]
+    config_to_hf: Callable[[TransformerConfig], Dict[str, Any]]
+    params_from_hf: Callable[[StateDict, TransformerConfig], Dict[str, Any]]
+    params_to_hf: Callable[[Dict[str, Any], TransformerConfig], StateDict]
+
+
+_FAMILIES: Dict[str, HFFamily] = {}
+_BY_ARCH: Dict[str, str] = {}
+
+
+def register_hf_family(family: HFFamily):
+    if family.name in _FAMILIES:
+        raise KeyError(f"hf family {family.name} already registered")
+    _FAMILIES[family.name] = family
+    _BY_ARCH[family.hf_architecture] = family.name
+
+
+def get_hf_family(name: str) -> HFFamily:
+    import areal_tpu.models.hf  # noqa: F401 ensure registration
+
+    return _FAMILIES[name]
+
+
+def family_from_architecture(arch: str) -> HFFamily:
+    return _FAMILIES[_BY_ARCH[arch]]
+
+
+def _read_hf_state_dict(path: str) -> StateDict:
+    """Load all safetensors shards under ``path`` into numpy arrays."""
+    from safetensors.numpy import load_file
+
+    index_file = os.path.join(path, "model.safetensors.index.json")
+    state: StateDict = {}
+    if os.path.isfile(index_file):
+        with open(index_file) as f:
+            index = json.load(f)
+        shards = sorted(set(index["weight_map"].values()))
+        for shard in shards:
+            state.update(load_file(os.path.join(path, shard)))
+    else:
+        single = os.path.join(path, "model.safetensors")
+        if os.path.isfile(single):
+            state.update(load_file(single))
+        else:
+            # torch .bin fallback
+            import torch
+
+            for fn in sorted(os.listdir(path)):
+                if fn.startswith("pytorch_model") and fn.endswith(".bin"):
+                    sd = torch.load(
+                        os.path.join(path, fn), map_location="cpu", weights_only=True
+                    )
+                    state.update(
+                        {k: v.float().numpy() for k, v in sd.items()}
+                    )
+            if not state:
+                raise FileNotFoundError(f"no model weights found in {path}")
+    return state
+
+
+def load_hf_config(path: str) -> Tuple[HFFamily, TransformerConfig, Dict]:
+    import areal_tpu.models.hf  # noqa: F401
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    arch = (hf_cfg.get("architectures") or ["?"])[0]
+    family = family_from_architecture(arch)
+    return family, family.config_from_hf(hf_cfg), hf_cfg
+
+
+def load_hf_model(
+    path: str,
+    is_critic: bool = False,
+    dtype: Optional[str] = None,
+) -> Tuple[TransformerConfig, Dict[str, Any]]:
+    """Load an HF checkpoint directory into (config, param pytree).
+
+    ``is_critic=True`` drops the LM head and attaches a zero-init value head
+    (the reference's critic bootstrap from an LM checkpoint).
+    """
+    family, cfg, _ = load_hf_config(path)
+    if dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    if is_critic:
+        cfg = dataclasses.replace(cfg, is_critic=True, tied_embedding=False)
+    state = _read_hf_state_dict(path)
+    params = family.params_from_hf(state, cfg)
+    if is_critic:
+        params.pop("lm_head", None)
+        params["value_head"] = {
+            "w": jnp.zeros((cfg.hidden_dim, 1), jnp.float32)
+        }
+    logger.info(
+        "loaded %s (%d layers, %d hidden) from %s",
+        family.name,
+        cfg.n_layers,
+        cfg.hidden_dim,
+        path,
+    )
+    return cfg, params
+
+
+MAX_SHARD_BYTES = 4 * 1024**3
+
+
+def save_hf_model(
+    path: str,
+    family_name: str,
+    cfg: TransformerConfig,
+    params: Dict[str, Any],
+    tokenizer=None,
+):
+    """Export to an HF checkpoint dir (config.json + sharded safetensors)."""
+    from safetensors.numpy import save_file
+
+    family = get_hf_family(family_name)
+    os.makedirs(path, exist_ok=True)
+    state = family.params_to_hf(params, cfg)
+    # transposed views must be materialized before safetensors writes bytes
+    state = {k: np.ascontiguousarray(v) for k, v in state.items()}
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(family.config_to_hf(cfg), f, indent=2)
+
+    # shard by size (reference: realhf/impl/model/conversion/hf_registry.py:214)
+    shards = []
+    cur: StateDict = {}
+    cur_bytes = 0
+    for k, v in state.items():
+        if cur and cur_bytes + v.nbytes > MAX_SHARD_BYTES:
+            shards.append(cur)
+            cur, cur_bytes = {}, 0
+        cur[k] = v
+        cur_bytes += v.nbytes
+    if cur:
+        shards.append(cur)
+
+    if len(shards) == 1:
+        save_file(shards[0], os.path.join(path, "model.safetensors"))
+    else:
+        weight_map = {}
+        total = sum(v.nbytes for v in state.values())
+        for i, shard in enumerate(shards):
+            fn = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+            save_file(shard, os.path.join(path, fn))
+            for k in shard:
+                weight_map[k] = fn
+        with open(
+            os.path.join(path, "model.safetensors.index.json"), "w"
+        ) as f:
+            json.dump(
+                {
+                    "metadata": {"total_size": total},
+                    "weight_map": weight_map,
+                },
+                f,
+            )
+    if tokenizer is not None:
+        tokenizer.save_pretrained(path)
+
+
+# -- helpers shared by family adapters --------------------------------------
+
+
+def to_np(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.float32)
+
+
+def stack_layers(per_layer: list) -> np.ndarray:
+    return np.stack(per_layer, axis=0)

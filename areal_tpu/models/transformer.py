@@ -86,12 +86,14 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     else:
         mlp = {
             "gate": {"w": stack_init((D, F))},
-            "up": {"w": stack_init((D, F))},
             "down": {"w": stack_init((F, D), scale_axis=0)},
         }
+        if cfg.gated_mlp:
+            mlp["up"] = {"w": stack_init((D, F))}
         if cfg.use_mlp_bias:
             mlp["gate"]["b"] = jnp.zeros((L, F), jnp.float32)
-            mlp["up"]["b"] = jnp.zeros((L, F), jnp.float32)
+            if cfg.gated_mlp:
+                mlp["up"]["b"] = jnp.zeros((L, F), jnp.float32)
             mlp["down"]["b"] = jnp.zeros((L, D), jnp.float32)
 
     def norm_params(shape):
@@ -128,74 +130,52 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def param_pspecs(cfg: TransformerConfig) -> Params:
-    """PartitionSpec pytree matching :func:`init_params`'s structure.
+def param_pspecs(cfg: TransformerConfig, params: Params) -> Params:
+    """PartitionSpec pytree derived from the actual param tree by path.
 
     Megatron-style TP over the ``model`` axis (reference:
     realhf/impl/model/parallelism/tensor_parallel/modules.py — column/row
     parallel linears), ZeRO-sharding over ``fsdp``; the stacked layer axis is
-    left for the ``pipe`` axis when pipeline parallelism is enabled.
+    reserved for the ``pipe`` axis when pipeline parallelism is enabled.
     """
-    lp = "pipe" if cfg.n_layers > 1 else None
+    lp = None  # layer axis: unsharded under SPMD (pipe uses shard_map)
 
-    def col(bias=False):  # output-dim sharded over model (ColumnParallel)
-        d = {"w": P(lp, "fsdp", "model")}
-        if bias:
-            d["b"] = P(lp, "model")
-        return d
+    def spec_for(path: Tuple, leaf) -> P:
+        keys = tuple(
+            k.key if hasattr(k, "key") else str(k) for k in path
+        )
+        if keys[0] == "embed":
+            return P("model", "fsdp")
+        if keys[0] == "pos_embed":
+            return P(None, "fsdp")
+        if keys[0] == "lm_head":
+            return P("fsdp", "model")
+        if keys[0] == "value_head":
+            return P("fsdp", None)
+        if keys[0] == "final_norm":
+            return P(None)
+        # inside "layers": leading dim is the stacked layer axis
+        if "router" in keys or "experts" in keys:
+            if "router" in keys:
+                return P(lp, None, None)
+            if keys[-1] == "down":
+                return P(lp, None, "model", "fsdp")
+            return P(lp, None, "fsdp", "model")
+        if "attn" in keys or "mlp" in keys:
+            name = keys[-2]  # q/k/v/o/gate/up/down/q_norm/...
+            leafname = keys[-1]  # w or b or scale
+            if leafname == "scale":  # q_norm/k_norm
+                return P(lp, None)
+            is_row = name in ("o", "down")
+            if leafname == "b":
+                return P(lp, None) if is_row else P(lp, "model")
+            return (
+                P(lp, "model", "fsdp") if is_row else P(lp, "fsdp", "model")
+            )
+        # norms inside layers
+        return P(lp, None)
 
-    def row(bias=False):  # input-dim sharded over model (RowParallel)
-        d = {"w": P(lp, "model", "fsdp")}
-        if bias:
-            d["b"] = P(lp, None)
-        return d
-
-    def norm(shape_1d=False):
-        p = {"scale": P(None) if shape_1d else P(lp, None)}
-        if cfg.norm_type == "layer":
-            p["bias"] = P(None) if shape_1d else P(lp, None)
-        return p
-
-    if cfg.is_moe:
-        from areal_tpu.models.moe import moe_pspecs
-
-        mlp = moe_pspecs(cfg, lp)
-    else:
-        mlp = {
-            "gate": col(cfg.use_mlp_bias),
-            "up": col(cfg.use_mlp_bias),
-            "down": row(cfg.use_mlp_bias),
-        }
-        if cfg.use_mlp_bias:
-            mlp["down"]["b"] = P(lp, None)
-
-    attn = {
-        "q": col(cfg.use_attention_bias),
-        "k": col(cfg.use_attention_bias),
-        "v": col(cfg.use_attention_bias),
-        "o": row(),
-    }
-    if cfg.use_qk_norm:
-        attn["q_norm"] = {"scale": P(lp, None)}
-        attn["k_norm"] = {"scale": P(lp, None)}
-
-    specs: Params = {
-        "embed": {"weight": P("model", "fsdp")},
-        "layers": {
-            "attn_norm": norm(),
-            "attn": attn,
-            "mlp_norm": norm(),
-            "mlp": mlp,
-        },
-        "final_norm": norm(shape_1d=True),
-    }
-    if cfg.abs_position_embedding:
-        specs["pos_embed"] = {"weight": P(None, "fsdp")}
-    if cfg.is_critic:
-        specs["value_head"] = {"w": P("fsdp", None)}
-    elif not cfg.tied_embedding:
-        specs["lm_head"] = {"w": P("fsdp", "model")}
-    return specs
+    return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
 # ---------------------------------------------------------------------------
@@ -388,8 +368,9 @@ def _layer(
         mlp_out, _aux = moe_mlp(cfg, h, lp["mlp"])
     else:
         gate = _activation(proj(lp["mlp"]["gate"], h), cfg.activation)
-        up = proj(lp["mlp"]["up"], h)
-        mlp_out = proj(lp["mlp"]["down"], gate * up)
+        if cfg.gated_mlp:
+            gate = gate * proj(lp["mlp"]["up"], h)
+        mlp_out = proj(lp["mlp"]["down"], gate)
     x = x + mlp_out
     return x, (k_full, v_full)
 

@@ -138,9 +138,12 @@ def test_logprobs_of_labels(cfg, params):
 
 
 def test_param_pspecs_structure(cfg, params):
-    specs = param_pspecs(cfg)
-    # same tree structure
-    jax.tree_util.tree_map(lambda p, s: None, params, specs)
+    specs = param_pspecs(cfg, params)
+    # same tree structure, and every spec rank <= param rank
+    def check(p, s):
+        assert len([a for a in s if a is not None]) <= p.ndim
+
+    jax.tree_util.tree_map(check, params, specs)
 
 
 def test_gpt2_style_config():
