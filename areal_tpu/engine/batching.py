@@ -1,0 +1,123 @@
+"""Packed SequenceSample <-> padded-device-batch conversion.
+
+The data plane moves packed varlen numpy (areal_tpu/api/data.py); XLA wants
+static shapes.  This module is the boundary: sequences become rows of a
+``[B, T]`` batch with bucketed T (limiting recompilation) and B padded to a
+multiple of the mesh's dp shard count.  Per-token outputs convert back to
+packed arrays for the SequenceSample result.
+
+(The reference keeps 1-D packing all the way into flash-attn varlen kernels,
+realhf/api/core/data_api.py + realhf/impl/model/utils/padding.py; on TPU the
+padded layout with segment ids is the idiomatic equivalent, and token-budget
+micro-batching upstream keeps the padding waste bounded.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from areal_tpu.api.data import SequenceSample
+
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+
+
+def bucket_len(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"sequence length {n} exceeds largest bucket")
+
+
+def pad_rows(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+@dataclasses.dataclass
+class PaddedBatch:
+    """Device-ready arrays; one sequence per row.
+
+    ``tokens``/``positions``/``seg_ids``: [B, T]; ``seq_lens``: [B] (0 for
+    padding rows).  ``extras`` holds per-key aligned arrays:
+      - full-length keys -> [B, T]
+      - transition keys (len L-1) -> [B, T] with entry t = transition t->t+1
+        (the T-1'th column is always 0)
+      - scalar keys -> [B]
+    """
+
+    tokens: np.ndarray
+    positions: np.ndarray
+    seg_ids: np.ndarray
+    seq_lens: np.ndarray
+    extras: Dict[str, np.ndarray]
+    n_real: int  # number of real rows
+
+    @property
+    def shape(self):
+        return self.tokens.shape
+
+
+def pad_batch(
+    sample: SequenceSample,
+    token_key: str = "packed_input_ids",
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+    row_multiple: int = 1,
+    min_rows: int = 1,
+) -> PaddedBatch:
+    """One sequence per row, right padding; extras aligned per class."""
+    seqlens = [l[0] for l in sample.seqlens[token_key]]
+    B = max(pad_rows(max(len(seqlens), min_rows), row_multiple), min_rows)
+    T = bucket_len(max(seqlens), buckets)
+
+    tokens = np.zeros((B, T), np.int32)
+    positions = np.zeros((B, T), np.int32)
+    seg_ids = np.zeros((B, T), np.int32)
+    seq_lens = np.zeros((B,), np.int32)
+
+    offsets = np.concatenate([[0], np.cumsum(seqlens)])
+    data = sample.data[token_key]
+    for i, L in enumerate(seqlens):
+        tokens[i, :L] = data[offsets[i] : offsets[i + 1]]
+        positions[i, :L] = np.arange(L)
+        seg_ids[i, :L] = 1
+        seq_lens[i] = L
+
+    extras: Dict[str, np.ndarray] = {}
+    for key in sample.keys:
+        if key == token_key or sample.data.get(key) is None:
+            continue
+        lens = [sum(l) for l in sample.seqlens[key]]
+        arr = sample.data[key]
+        offs = np.concatenate([[0], np.cumsum(lens)])
+        if all(l == 1 for l in lens):  # scalar per sequence
+            out = np.zeros((B,), arr.dtype)
+            out[: len(lens)] = arr[: len(lens)]
+        else:
+            out = np.zeros((B, T), arr.dtype)
+            for i, L in enumerate(lens):
+                out[i, :L] = arr[offs[i] : offs[i + 1]]
+        extras[key] = out
+    return PaddedBatch(
+        tokens=tokens,
+        positions=positions,
+        seg_ids=seg_ids,
+        seq_lens=seq_lens,
+        extras=extras,
+        n_real=len(seqlens),
+    )
+
+
+def unpad_per_token(
+    out: np.ndarray,  # [B, T] per-token outputs (full-length alignment)
+    seq_lens: np.ndarray,
+    n_real: int,
+    shift: int = 0,  # 1 for transition-aligned outputs (length L-1)
+) -> np.ndarray:
+    """Back to packed 1-D concat over real rows."""
+    parts: List[np.ndarray] = []
+    for i in range(n_real):
+        L = int(seq_lens[i]) - shift
+        parts.append(out[i, :L])
+    return np.concatenate(parts, axis=0)

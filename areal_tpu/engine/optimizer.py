@@ -1,0 +1,73 @@
+"""Optimizer construction (reference: realhf/api/cli_args.py ``OptimizerConfig``
+and the Megatron lr-scheduler wiring in realhf/impl/model/backend/megatron.py:529).
+
+optax replaces Megatron's DistributedOptimizer: optimizer-state sharding falls
+out of the params' NamedShardings (ZeRO-equivalent on the fsdp axis) with no
+dedicated machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    type: str = "adam"  # adam | sgd
+    lr: float = 2e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-5
+    min_lr_ratio: float = 0.0
+    lr_scheduler_type: str = "constant"  # constant | linear | cosine
+    warmup_steps_proportion: float = 0.001
+    gradient_clipping: float = 1.0
+    # offload / initial_loss_scale etc. are GPU-specific; bf16 on TPU needs no
+    # loss scaling.
+
+
+def make_lr_schedule(
+    cfg: OptimizerConfig, total_train_steps: int
+) -> optax.Schedule:
+    warmup_steps = max(1, int(cfg.warmup_steps_proportion * total_train_steps))
+    decay_steps = max(1, total_train_steps - warmup_steps)
+    end_lr = cfg.lr * cfg.min_lr_ratio
+    if cfg.lr_scheduler_type == "constant":
+        main = optax.constant_schedule(cfg.lr)
+    elif cfg.lr_scheduler_type == "linear":
+        main = optax.linear_schedule(cfg.lr, end_lr, decay_steps)
+    elif cfg.lr_scheduler_type == "cosine":
+        main = optax.cosine_decay_schedule(
+            cfg.lr, decay_steps, alpha=cfg.min_lr_ratio
+        )
+    else:
+        raise NotImplementedError(cfg.lr_scheduler_type)
+    warmup = optax.linear_schedule(0.0, cfg.lr, warmup_steps)
+    return optax.join_schedules([warmup, main], [warmup_steps])
+
+
+def make_optimizer(
+    cfg: OptimizerConfig, total_train_steps: int
+) -> optax.GradientTransformation:
+    schedule = make_lr_schedule(cfg, total_train_steps)
+    if cfg.type == "adam":
+        opt = optax.adamw(
+            schedule,
+            b1=cfg.beta1,
+            b2=cfg.beta2,
+            eps=cfg.eps,
+            weight_decay=cfg.weight_decay,
+        )
+    elif cfg.type == "sgd":
+        opt = optax.sgd(schedule)
+    else:
+        raise NotImplementedError(cfg.type)
+    chain = []
+    if cfg.gradient_clipping:
+        chain.append(optax.clip_by_global_norm(cfg.gradient_clipping))
+    chain.append(opt)
+    return optax.chain(*chain)

@@ -1,0 +1,171 @@
+"""PPO losses, reward shaping, and KL controllers in JAX
+(reference: realhf/impl/model/utils/ppo_functional.py — ``actor_loss_fn`` :51
+with clip / dual-clip / decoupled behavioral-vs-proximal importance weighting,
+``critic_loss_fn`` :161, packed reward shaping :229-291, KL controllers
+:14-48).
+
+All tensor functions are pure jnp on the padded ``[B, T]`` transition layout
+(entry t is the transition predicting token t+1) and are jit-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KLController:
+    def __init__(self, kl_coef: float):
+        self.value = kl_coef
+
+    def update(self, current_kl: float, n_steps: int):
+        pass
+
+
+class FixedKLController(KLController):
+    pass
+
+
+class AdaptiveKLController(KLController):
+    """arXiv:1909.08593 adaptive controller."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: float):
+        super().__init__(init_kl_coef)
+        self.target = target
+        self.horizon = horizon
+
+    def update(self, current_kl: float, n_steps: int):
+        proportional_error = float(
+            jnp.clip(current_kl / self.target - 1, -0.2, 0.2)
+        )
+        mult = 1 + proportional_error * n_steps / self.horizon
+        self.value *= mult
+
+
+def actor_loss_fn(
+    logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    eps_clip: float,
+    loss_mask: jax.Array,
+    c_clip: Optional[float] = None,
+    proximal_logprobs: Optional[jax.Array] = None,
+    behav_imp_weight_cap: Optional[float] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """PPO-clip policy loss.
+
+    When ``proximal_logprobs`` is given, this is the *decoupled* objective
+    (the boba² staleness fix): the clip ratio is taken w.r.t. the proximal
+    (recomputed) policy while the behavioral importance weight
+    exp(proximal - behavioral) multiplies the clipped loss, optionally capped.
+    """
+    loss_mask = loss_mask.astype(bool)
+    denorm_logprobs = (
+        proximal_logprobs if proximal_logprobs is not None else old_logprobs
+    )
+    count = jnp.maximum(jnp.sum(loss_mask), 1)
+
+    ratio = jnp.where(loss_mask, jnp.exp(logprobs - denorm_logprobs), 0.0)
+    clipped_ratio = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + eps_clip)
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * clipped_ratio
+    clip_mask = pg_loss1 < pg_loss2
+    pg_loss = jnp.maximum(pg_loss1, pg_loss2)
+
+    if c_clip is not None:
+        assert c_clip > 1.0, c_clip
+        pg_loss3 = jnp.sign(advantages) * c_clip * advantages
+        dual_clip_mask = pg_loss3 < pg_loss
+        pg_loss = jnp.minimum(pg_loss, pg_loss3)
+    else:
+        dual_clip_mask = jnp.zeros_like(clip_mask)
+
+    stat: Dict[str, jax.Array] = {}
+    if proximal_logprobs is not None:
+        behav_kl = proximal_logprobs - old_logprobs
+        behav_imp_weight = jnp.exp(behav_kl)
+        if behav_imp_weight_cap is not None:
+            behav_mask = (behav_imp_weight <= behav_imp_weight_cap) & loss_mask
+        else:
+            behav_mask = loss_mask
+        behav_kl = jnp.where(behav_mask, behav_kl, 0.0)
+        behav_imp_weight = jnp.where(behav_mask, behav_imp_weight, 0.0)
+        pg_loss = pg_loss * behav_imp_weight
+        stat["behave_imp_weight"] = behav_imp_weight
+        stat["behave_approx_kl"] = behav_kl
+        stat["behave_mask"] = behav_mask
+
+    logging_loss = pg_loss
+    pg_loss = jnp.sum(jnp.where(loss_mask, pg_loss, 0.0)) / count
+
+    stat.update(
+        loss=logging_loss,
+        importance_weight=ratio,
+        approx_kl=jnp.where(loss_mask, logprobs - denorm_logprobs, 0.0),
+        clip_mask=clip_mask & loss_mask,
+        dual_clip_mask=dual_clip_mask & loss_mask,
+    )
+    return pg_loss, stat
+
+
+def _huber(x, y, delta=10.0):
+    diff = jnp.abs(x - y)
+    return jnp.where(diff < delta, 0.5 * diff**2, delta * (diff - 0.5 * delta))
+
+
+def _mse(x, y):
+    return 0.5 * (x - y) ** 2
+
+
+def critic_loss_fn(
+    value: jax.Array,
+    old_value: jax.Array,
+    target_value: jax.Array,
+    value_eps_clip: float,
+    loss_mask: jax.Array,
+    loss_fn_type: str = "mse",
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    loss_mask = loss_mask.astype(bool)
+    fn = _huber if loss_fn_type == "huber" else _mse
+    loss_original = fn(value, target_value)
+    value_clipped = old_value + jnp.clip(
+        value - old_value, -value_eps_clip, value_eps_clip
+    )
+    loss_clipped = fn(value_clipped, target_value)
+    loss = jnp.maximum(loss_original, loss_clipped)
+    clip_mask = (loss_clipped > loss_original) & loss_mask
+    count = jnp.maximum(jnp.sum(loss_mask), 1)
+    scalar = jnp.sum(jnp.where(loss_mask, loss, 0.0)) / count
+    return scalar, dict(clip_mask=clip_mask, loss=loss)
+
+
+def shape_rewards(
+    kl_ctl: float,
+    clip_reward_value: float,
+    logprobs: jax.Array,  # [B, T] behavioral logprobs on transitions
+    ref_logprobs: jax.Array,  # [B, T]
+    reward_score: jax.Array,  # [B] sequence-level task reward
+    transition_mask: jax.Array,  # [B, T] 1 on valid response transitions
+    seq_no_eos_mask: Optional[jax.Array] = None,  # [B] 1 if truncated (no EOS)
+    mask_no_eos_with_zero: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """KL-penalty token rewards plus the task reward on the final transition
+    (reference ``get_packed_rewards`` :229).  Returns (kl_rewards, rewards)."""
+    transition_mask = transition_mask.astype(jnp.float32)
+    kl_rewards = -kl_ctl * (logprobs - ref_logprobs) * transition_mask
+    score = jnp.clip(reward_score, -clip_reward_value, clip_reward_value)
+    if mask_no_eos_with_zero and seq_no_eos_mask is not None:
+        score = jnp.where(seq_no_eos_mask.astype(bool), 0.0, score)
+    # last valid transition per row
+    next_mask = jnp.concatenate(
+        [
+            transition_mask[:, 1:],
+            jnp.zeros((transition_mask.shape[0], 1), jnp.float32),
+        ],
+        axis=1,
+    )
+    is_last = transition_mask * (1.0 - next_mask)
+    rewards = kl_rewards + is_last * score[:, None]
+    return kl_rewards, rewards

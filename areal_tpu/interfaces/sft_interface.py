@@ -1,0 +1,97 @@
+"""SFT algorithm interface (reference: realhf/impl/model/interface/sft_interface.py:86
+— packed cross-entropy train/eval with prompt masking)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api import model_api
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base import logging_, stats_tracker
+from areal_tpu.models.transformer import head_weight, hidden_states
+from areal_tpu.ops.loss import masked_cross_entropy
+
+logger = logging_.getLogger("sft_interface")
+
+
+def sft_loss_fn(params, cfg, batch):
+    """(loss_sum, token_count, stats). Labels = next token; prompt tokens and
+    padding are masked out of the loss."""
+    hidden = hidden_states(
+        params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
+    )
+    B, T, D = hidden.shape
+    w = head_weight(params, cfg).astype(hidden.dtype)
+    labels = batch["tokens"][:, 1:]  # [B, T-1]
+    h = hidden[:, :-1].reshape(-1, D)
+    # valid transition: current & next token in same non-pad segment
+    valid = (batch["seg_ids"][:, 1:] != 0) & (
+        batch["seg_ids"][:, :-1] == batch["seg_ids"][:, 1:]
+    )
+    if "prompt_mask" in batch:
+        # mask transitions whose TARGET token is part of the prompt
+        valid &= ~(batch["prompt_mask"][:, 1:].astype(bool))
+    mask = valid.reshape(-1)
+    loss_sum, count = masked_cross_entropy(
+        h, w, labels.reshape(-1), mask
+    )
+    stats = {"nll_sum": loss_sum, "n_valid_tokens": count}
+    return loss_sum, count, stats
+
+
+@dataclasses.dataclass
+class SFTInterface(model_api.ModelInterface):
+    token_key: str = "packed_input_ids"
+
+    def train_step(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> Dict:
+        engine = model.engine
+        stats = engine.train_batch(
+            data, sft_loss_fn, mb_spec, token_key=self.token_key
+        )
+        model.version.advance(
+            model.ft_spec.steps_per_epoch if model.ft_spec else int(1e9)
+        )
+        with stats_tracker.scope("sft"):
+            stats_tracker.scalar(
+                loss=stats["loss"],
+                grad_norm=stats["grad_norm"],
+                n_tokens=stats["n_tokens"],
+            )
+        return stats
+
+    def evaluate(self, model: model_api.Model, eval_dataloader) -> Dict:
+        engine = model.engine
+        total_nll, total_tokens = 0.0, 0.0
+        for sample in eval_dataloader:
+            mbs, *_ = sample.split(MicroBatchSpec())
+            for mb in mbs:
+                pb = engine._pad(mb, self.token_key)
+                batch = engine._device_batch(pb)
+                fn = engine._get_fwd_step(_eval_nll)
+                nll, cnt = fn(engine.params, batch)
+                total_nll += float(nll)
+                total_tokens += float(cnt)
+        return {
+            "eval_nll": total_nll / max(total_tokens, 1),
+            "eval_tokens": total_tokens,
+        }
+
+    def save(self, model: model_api.Model, save_dir: str):
+        model.engine.save_hf(save_dir, model.backend_name or "llama", model.tokenizer)
+
+
+def _eval_nll(params, cfg, batch):
+    loss_sum, count, _ = sft_loss_fn(params, cfg, batch)
+    return loss_sum, count
+
+
+model_api.register_interface("sft", SFTInterface)

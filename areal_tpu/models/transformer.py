@@ -510,6 +510,34 @@ def decode_step(
 # ---------------------------------------------------------------------------
 
 
+def hidden_states(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    seg_ids: jax.Array,
+) -> jax.Array:
+    """Final-norm hidden states [B, T, D] (pre-head), for chunked losses."""
+    x = _embed(params, cfg, tokens, positions)
+    mask = make_attention_mask(
+        seg_ids, positions, seg_ids, positions, cfg.sliding_window
+    )
+
+    def body(carry, lp):
+        y, _ = _layer(cfg, carry, lp, positions, mask)
+        return y, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return _norm(x, params["final_norm"], cfg)
+
+
+def head_weight(params: Params, cfg: TransformerConfig) -> jax.Array:
+    """[D, V] output head weight (tied or untied)."""
+    if cfg.tied_embedding:
+        return params["embed"]["weight"].T
+    return params["lm_head"]["w"]
+
+
 def logprobs_of_labels(
     params: Params,
     cfg: TransformerConfig,

@@ -1,0 +1,92 @@
+"""Generalized Advantage Estimation as a JAX scan.
+
+TPU-native replacement for the reference's CUDA GAE kernel
+(reference: csrc/cugae/gae.cu:11-216 ``gae_kernel_1d_nolp_misalign``; python
+dispatch realhf/impl/model/utils/ppo_functional.py:292-395).  The reference
+runs one CUDA thread per sequence doing the reverse recurrence; on TPU the
+same recurrence is a ``lax.scan`` over the time axis of the padded [B, T]
+layout — XLA vectorizes across the batch lanes, and the scan is fused into
+the surrounding jit.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gae_advantages_returns(
+    rewards: jax.Array,  # [B, T] reward on transition t -> t+1
+    values: jax.Array,  # [B, T] value at token t
+    bootstrap_values: jax.Array,  # [B] value after the last transition (0 if done)
+    mask: jax.Array,  # [B, T] 1.0 on valid transitions, 0 elsewhere
+    gamma: float,
+    lam: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Masked reverse-scan GAE.
+
+    For each row, over valid transitions t (mask==1):
+        delta_t = r_t + gamma * V_{t+1} - V_t
+        A_t     = delta_t + gamma * lam * A_{t+1}
+    Values at masked positions are treated as 0; the value after the final
+    valid transition is ``bootstrap_values`` (pass 0 for terminated episodes).
+    Returns (advantages, returns) with returns = A + V on valid positions.
+    """
+    B, T = rewards.shape
+    mask = mask.astype(jnp.float32)
+    values = values.astype(jnp.float32) * mask
+    rewards = rewards.astype(jnp.float32) * mask
+
+    # V_{t+1}: next valid value; at the last valid transition use bootstrap.
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros((B, 1), jnp.float32)], axis=1
+    )
+    next_mask = jnp.concatenate(
+        [mask[:, 1:], jnp.zeros((B, 1), jnp.float32)], axis=1
+    )
+    # position is the LAST valid transition iff mask_t==1 and next_mask==0
+    is_last = mask * (1.0 - next_mask)
+    next_values = next_values + is_last * bootstrap_values[:, None].astype(
+        jnp.float32
+    )
+
+    deltas = rewards + gamma * next_values - values  # [B, T]
+
+    def body(adv_next, xs):
+        delta_t, mask_t = xs  # [B]
+        adv_t = (delta_t + gamma * lam * adv_next) * mask_t
+        return adv_t, adv_t
+
+    _, advs_rev = jax.lax.scan(
+        body,
+        jnp.zeros((B,), jnp.float32),
+        (deltas.T[::-1], mask.T[::-1]),
+    )
+    advantages = advs_rev[::-1].T  # [B, T]
+    returns = advantages + values
+    return advantages * mask, returns * mask
+
+
+def gae_packed_numpy(rewards, values, bootstrap, mask, gamma, lam):
+    """Pure-numpy reference for tests (mirrors the reference's python
+    fallback, realhf/impl/model/utils/ppo_functional.py:292)."""
+    import numpy as np
+
+    B, T = rewards.shape
+    advs = np.zeros((B, T), np.float64)
+    rets = np.zeros((B, T), np.float64)
+    for b in range(B):
+        valid = np.nonzero(mask[b])[0]
+        if len(valid) == 0:
+            continue
+        adv = 0.0
+        nxt = float(bootstrap[b])
+        for t in valid[::-1]:
+            delta = rewards[b, t] + gamma * nxt - values[b, t]
+            adv = delta + gamma * lam * adv
+            advs[b, t] = adv
+            rets[b, t] = adv + values[b, t]
+            nxt = values[b, t]
+    return advs, rets

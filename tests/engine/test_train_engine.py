@@ -1,0 +1,111 @@
+"""TrainEngine on the virtual 8-device CPU mesh: sharding, micro-batch grad
+accumulation equivalence, and SFT loss descent."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.engine.optimizer import OptimizerConfig
+from areal_tpu.engine.train_engine import TrainEngine
+from areal_tpu.interfaces.sft_interface import sft_loss_fn
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+
+
+def make_sample(bs, vocab, seed=0, min_len=4, max_len=12):
+    rng = np.random.RandomState(seed)
+    seqlens = rng.randint(min_len, max_len, size=bs).tolist()
+    total = sum(seqlens)
+    tokens = rng.randint(1, vocab, size=total).astype(np.int32)
+    prompt_mask = np.zeros(total, dtype=bool)
+    off = 0
+    for L in seqlens:
+        prompt_mask[off : off + max(1, L // 3)] = True
+        off += L
+    return SequenceSample.from_default(
+        seqlens,
+        [f"s{i}" for i in range(bs)],
+        {"packed_input_ids": tokens, "prompt_mask": prompt_mask},
+    )
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = tiny_config(vocab_size=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = MeshSpec(data=2, fsdp=2, model=2).make_mesh()
+    return TrainEngine(
+        cfg,
+        mesh,
+        params,
+        optimizer_cfg=OptimizerConfig(lr=1e-2, lr_scheduler_type="constant",
+                                      warmup_steps_proportion=0.0),
+        total_train_steps=100,
+    )
+
+
+def test_params_are_sharded(engine):
+    qw = engine.params["layers"]["attn"]["q"]["w"]
+    assert len(qw.sharding.device_set) == 8
+
+
+def test_sft_loss_decreases(engine):
+    sample = make_sample(8, 64, seed=1)
+    first = engine.train_batch(sample, sft_loss_fn, MicroBatchSpec())
+    for _ in range(10):
+        stats = engine.train_batch(sample, sft_loss_fn, MicroBatchSpec())
+    assert stats["loss"] < first["loss"]
+    assert np.isfinite(stats["grad_norm"])
+
+
+def test_microbatch_grad_accumulation_equivalence():
+    """1 micro-batch vs forced split must produce the same update."""
+    cfg = tiny_config(vocab_size=64)
+    mesh = MeshSpec(data=1, fsdp=1, model=1).make_mesh(jax.devices()[:1])
+    opt = OptimizerConfig(lr=1e-2, warmup_steps_proportion=0.0)
+    sample = make_sample(8, 64, seed=2)
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    e1 = TrainEngine(cfg, mesh, params, opt, 100)
+    e1.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=1))
+
+    params2 = init_params(cfg, jax.random.PRNGKey(0))
+    e2 = TrainEngine(cfg, mesh, params2, opt, 100)
+    e2.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=4))
+
+    for (p1, p2) in zip(
+        jax.tree.leaves(e1.params), jax.tree.leaves(e2.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(p1), np.asarray(p2), atol=2e-5
+        )
+
+
+def test_forward_batch_returns_packed_outputs(engine):
+    from areal_tpu.models.transformer import head_weight, hidden_states
+    from areal_tpu.ops.loss import per_token_logprobs_entropy
+
+    def logp_fn(params, cfg, batch):
+        hidden = hidden_states(
+            params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
+        )
+        B, T, D = hidden.shape
+        w = head_weight(params, cfg).astype(hidden.dtype)
+        logp, _ = per_token_logprobs_entropy(
+            hidden[:, :-1].reshape(-1, D),
+            w,
+            batch["tokens"][:, 1:].reshape(-1),
+        )
+        out = logp.reshape(B, T - 1)
+        return jnp.pad(out, ((0, 0), (0, 1)))  # [B, T] transition-aligned
+
+    sample = make_sample(6, 64, seed=3)
+    out = engine.forward_batch(
+        sample, logp_fn, MicroBatchSpec(n_mbs=2), output_shift=1
+    )
+    expected_len = sum(l[0] - 1 for l in sample.seqlens["packed_input_ids"])
+    assert out.shape == (expected_len,)
+    assert np.all(out <= 0)

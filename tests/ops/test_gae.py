@@ -1,0 +1,58 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.ops.gae import gae_advantages_returns, gae_packed_numpy
+
+
+@pytest.mark.parametrize("gamma,lam", [(1.0, 1.0), (0.99, 0.95)])
+def test_gae_matches_numpy(gamma, lam):
+    rng = np.random.RandomState(0)
+    B, T = 4, 16
+    lens = rng.randint(2, T, size=B)
+    mask = np.zeros((B, T), np.float32)
+    for b, l in enumerate(lens):
+        mask[b, :l] = 1.0
+    rewards = rng.randn(B, T).astype(np.float32) * mask
+    values = rng.randn(B, T).astype(np.float32) * mask
+    bootstrap = rng.randn(B).astype(np.float32)
+
+    adv, ret = gae_advantages_returns(
+        jnp.asarray(rewards),
+        jnp.asarray(values),
+        jnp.asarray(bootstrap),
+        jnp.asarray(mask),
+        gamma,
+        lam,
+    )
+    adv_np, ret_np = gae_packed_numpy(
+        rewards, values, bootstrap, mask, gamma, lam
+    )
+    np.testing.assert_allclose(np.asarray(adv), adv_np, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ret), ret_np, atol=1e-4)
+
+
+def test_gae_zero_bootstrap_single_step():
+    # one transition: A = r - V
+    adv, ret = gae_advantages_returns(
+        jnp.asarray([[2.0]]),
+        jnp.asarray([[0.5]]),
+        jnp.asarray([0.0]),
+        jnp.asarray([[1.0]]),
+        gamma=1.0,
+        lam=1.0,
+    )
+    np.testing.assert_allclose(np.asarray(adv), [[1.5]], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ret), [[2.0]], atol=1e-6)
+
+
+def test_gae_empty_row():
+    adv, ret = gae_advantages_returns(
+        jnp.zeros((1, 4)),
+        jnp.zeros((1, 4)),
+        jnp.zeros((1,)),
+        jnp.zeros((1, 4)),
+        0.9,
+        0.9,
+    )
+    np.testing.assert_allclose(np.asarray(adv), 0.0)

@@ -1,0 +1,115 @@
+"""Mirrors the reference's tests/data/test_dual_clip.py coverage plus the
+decoupled-loss behaviors."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.interfaces.ppo_functional import (
+    AdaptiveKLController,
+    actor_loss_fn,
+    critic_loss_fn,
+    shape_rewards,
+)
+
+
+def test_actor_loss_no_clip_region():
+    # ratio == 1 => loss = -adv
+    lp = jnp.zeros((1, 4))
+    adv = jnp.asarray([[1.0, -1.0, 2.0, 0.5]])
+    mask = jnp.ones((1, 4))
+    loss, stat = actor_loss_fn(lp, lp, adv, eps_clip=0.2, loss_mask=mask)
+    np.testing.assert_allclose(float(loss), -float(adv.mean()), atol=1e-6)
+    assert not bool(stat["clip_mask"].any())
+
+
+def test_actor_loss_clipping():
+    old = jnp.zeros((1, 2))
+    new = jnp.asarray([[1.0, -1.0]])  # big ratios
+    adv = jnp.asarray([[1.0, 1.0]])
+    mask = jnp.ones((1, 2))
+    loss, stat = actor_loss_fn(new, old, adv, eps_clip=0.2, loss_mask=mask)
+    # positive adv with ratio>1.2 clips to 1.2; ratio<0.8 unclipped (max)
+    expected = (-1.2 + -np.exp(-1.0)) / 2
+    np.testing.assert_allclose(float(loss), expected, atol=1e-5)
+    assert bool(stat["clip_mask"][0, 0])
+
+
+def test_dual_clip():
+    old = jnp.zeros((1, 1))
+    new = jnp.asarray([[-3.0]])  # tiny ratio
+    adv = jnp.asarray([[-2.0]])  # negative advantage
+    mask = jnp.ones((1, 1))
+    # without dual clip: loss = max(-adv*r, -adv*clip(r)) = max(2r, 2*0.8)=1.6
+    l1, _ = actor_loss_fn(new, old, adv, 0.2, mask)
+    np.testing.assert_allclose(float(l1), 1.6, atol=1e-5)
+    # with dual clip c=3: pg3 = sign(adv)*c*adv = 6 -> min(pg,6) keeps 1.6;
+    l2, _ = actor_loss_fn(new, old, adv, 0.2, mask, c_clip=3.0)
+    np.testing.assert_allclose(float(l2), 1.6, atol=1e-5)
+    # positive-ratio explosion with negative adv: pg = -adv*r = 2*e^3 > 6 -> clipped to 6
+    new2 = jnp.asarray([[3.0]])
+    l3, stat = actor_loss_fn(new2, old, adv, 0.2, mask, c_clip=3.0)
+    np.testing.assert_allclose(float(l3), 6.0, atol=1e-4)
+    assert bool(stat["dual_clip_mask"][0, 0])
+
+
+def test_decoupled_loss_importance_weight():
+    behav = jnp.asarray([[0.0]])
+    prox = jnp.asarray([[np.log(2.0)]])  # proximal policy 2x more likely
+    new = prox  # ratio w.r.t. proximal = 1
+    adv = jnp.asarray([[1.0]])
+    mask = jnp.ones((1, 1))
+    loss, stat = actor_loss_fn(
+        new, behav, adv, 0.2, mask, proximal_logprobs=prox
+    )
+    # pg = -adv * 1, behav weight = exp(prox-behav) = 2 -> loss = -2
+    np.testing.assert_allclose(float(loss), -2.0, atol=1e-5)
+    # with cap < 2 the sample is masked out
+    loss2, _ = actor_loss_fn(
+        new, behav, adv, 0.2, mask,
+        proximal_logprobs=prox, behav_imp_weight_cap=1.5,
+    )
+    np.testing.assert_allclose(float(loss2), 0.0, atol=1e-6)
+
+
+def test_critic_loss_clip():
+    v = jnp.asarray([[2.0]])
+    old_v = jnp.asarray([[0.0]])
+    target = jnp.asarray([[0.0]])
+    mask = jnp.ones((1, 1))
+    loss, stat = critic_loss_fn(v, old_v, target, 0.5, mask)
+    # clipped value = 0.5 -> mse vs target = 0.125; orig = 2 -> max = 2
+    np.testing.assert_allclose(float(loss), 2.0, atol=1e-6)
+    assert not bool(stat["clip_mask"][0, 0])  # orig >= clipped
+
+
+def test_shape_rewards_places_score_at_last_transition():
+    B, T = 2, 5
+    lp = jnp.zeros((B, T))
+    ref = jnp.zeros((B, T))
+    mask = jnp.asarray(
+        [[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32
+    )
+    score = jnp.asarray([1.0, -7.0])
+    kl_r, r = shape_rewards(0.1, 5.0, lp, ref, score, mask)
+    np.testing.assert_allclose(np.asarray(kl_r), 0.0)
+    r = np.asarray(r)
+    assert r[0, 2] == 1.0 and r[0, 3] == 0.0
+    assert r[1, 4] == -5.0  # clipped to clip_reward_value
+
+
+def test_shape_rewards_kl_penalty():
+    lp = jnp.full((1, 3), -1.0)
+    ref = jnp.full((1, 3), -2.0)
+    mask = jnp.ones((1, 3))
+    kl_r, r = shape_rewards(0.5, 10.0, lp, ref, jnp.zeros((1,)), mask)
+    np.testing.assert_allclose(np.asarray(kl_r), -0.5, atol=1e-6)
+
+
+def test_adaptive_kl_controller():
+    ctl = AdaptiveKLController(0.1, target=1.0, horizon=100)
+    ctl.update(current_kl=2.0, n_steps=10)
+    assert ctl.value > 0.1
+    ctl2 = AdaptiveKLController(0.1, target=1.0, horizon=100)
+    ctl2.update(current_kl=0.1, n_steps=10)
+    assert ctl2.value < 0.1
