@@ -1,0 +1,60 @@
+"""Token sampling (temperature / top-k / top-p) in jit
+(reference: realhf/impl/model/utils/logits_warper.py + the genstep sampling in
+realhf/impl/model/nn/real_llm_generate.py:30)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Static (compile-time) sampling configuration."""
+
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 or >= vocab disables
+    greedy: bool = False
+
+
+def sample_logits(
+    logits: jax.Array,  # [B, V] float32
+    rng: jax.Array,
+    params: SamplingParams,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (tokens [B], logprob-of-sampled-token [B]).
+
+    The reported logprob is from the *post-temperature* distribution without
+    top-k/p filtering — matching what inference servers report and what PPO
+    treats as the behavioral logprob.
+    """
+    if params.temperature != 1.0 and not params.greedy:
+        logits = logits / max(params.temperature, 1e-5)
+    base_logprobs = jax.nn.log_softmax(logits, axis=-1)
+
+    if params.greedy:
+        tokens = jnp.argmax(logits, axis=-1)
+    else:
+        filtered = logits
+        V = logits.shape[-1]
+        if params.top_k and params.top_k < V:
+            kth = jnp.sort(filtered, axis=-1)[:, V - params.top_k][:, None]
+            filtered = jnp.where(filtered < kth, -jnp.inf, filtered)
+        if params.top_p < 1.0:
+            sorted_logits = jnp.sort(filtered, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep smallest prefix with cum >= top_p (always keep first)
+            cutoff_mask = cum - probs >= params.top_p
+            cutoff_logit = jnp.min(
+                jnp.where(cutoff_mask, jnp.inf, sorted_logits), axis=-1
+            )[:, None]
+            filtered = jnp.where(filtered < cutoff_logit, -jnp.inf, filtered)
+        tokens = jax.random.categorical(rng, filtered, axis=-1)
+
+    logp = jnp.take_along_axis(base_logprobs, tokens[:, None], axis=-1)[:, 0]
+    return tokens.astype(jnp.int32), logp

@@ -1,0 +1,439 @@
+"""PPO actor & critic algorithm interfaces
+(reference: realhf/impl/model/interface/ppo_interface.py — ``PPOActorInterface``
+:210 generate/inference/train_step, ``PPOCriticInterface`` :984; loss math in
+areal_tpu/interfaces/ppo_functional.py).
+
+Data contract (packed SequenceSample keys, lengths per sequence of L tokens):
+  packed_input_ids [L]       prompt + response tokens
+  prompt_mask      [L]       1 on prompt tokens
+  packed_logprobs  [L-1]     behavioral logprobs (from the generation engine)
+  packed_ref_logprobs [L-1]  reference-policy logprobs (KL penalty)
+  prox_logp        [L-1]     proximal (recomputed) logprobs — decoupled PPO
+  values           [L]       critic values (absent when disable_value)
+  rewards          [1]       sequence-level task reward
+  seq_no_eos_mask  [1]       1 if truncated without EOS
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api import model_api
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base import logging_, stats_tracker
+from areal_tpu.engine import batching
+from areal_tpu.interfaces import ppo_functional
+from areal_tpu.models.transformer import head_weight, hidden_states
+from areal_tpu.ops.gae import gae_advantages_returns
+from areal_tpu.ops.loss import per_token_logprobs_entropy
+
+logger = logging_.getLogger("ppo_interface")
+
+
+def _transition_mask(batch: Dict) -> jax.Array:
+    """[B, T] 1.0 on transitions t->t+1 inside the same real segment."""
+    seg = batch["seg_ids"]
+    m = (seg[:, 1:] != 0) & (seg[:, :-1] == seg[:, 1:])
+    return jnp.pad(m, ((0, 0), (0, 1))).astype(jnp.float32)
+
+
+def _response_mask(batch: Dict) -> jax.Array:
+    """[B, T] 1.0 on transitions whose target token is a response token."""
+    m = _transition_mask(batch)
+    if "prompt_mask" in batch:
+        resp_tgt = ~(batch["prompt_mask"].astype(bool))
+        resp = jnp.pad(resp_tgt[:, 1:], ((0, 0), (0, 1)))
+        m = m * resp.astype(jnp.float32)
+    return m
+
+
+def model_logprobs_fwd(temperature: float = 1.0):
+    """fwd_fn producing transition-aligned logprobs [B, T] (col T-1 = 0)."""
+
+    def fn(params, cfg, batch):
+        hidden = hidden_states(
+            params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
+        )
+        B, T, D = hidden.shape
+        w = head_weight(params, cfg).astype(hidden.dtype) / temperature
+        logp, _ = per_token_logprobs_entropy(
+            hidden[:, :-1].reshape(-1, D), w, batch["tokens"][:, 1:].reshape(-1)
+        )
+        return jnp.pad(logp.reshape(B, T - 1), ((0, 0), (0, 1)))
+
+    return fn
+
+
+def critic_values_fwd(params, cfg, batch):
+    """fwd_fn producing per-token values [B, T]."""
+    from areal_tpu.models.transformer import forward
+
+    values = forward(
+        params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
+    )
+    return values * (batch["seg_ids"] != 0)
+
+
+@dataclasses.dataclass
+class PPOActorInterface(model_api.ModelInterface):
+    n_minibatches: int = 4
+    gconfig: model_api.GenerationHyperparameters = dataclasses.field(
+        default_factory=model_api.GenerationHyperparameters
+    )
+
+    kl_ctl: float = 0.1
+    adaptive_kl_ctl: bool = False
+    adaptive_kl_target: float = 6.0
+    adaptive_kl_horizon: float = 10000.0
+
+    eps_clip: float = 0.2
+    c_clip: Optional[float] = None
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    max_reward_clip: float = 5.0
+    reward_scaling: float = 1.0
+    reward_bias: float = 0.0
+    mask_no_eos_with_zero: bool = False
+
+    adv_norm: bool = True
+    group_adv_norm: bool = False
+    group_size: int = 1
+
+    disable_value: bool = False
+    temperature: float = 1.0
+
+    use_decoupled_loss: bool = False
+    behav_imp_weight_cap: Optional[float] = None
+
+    token_key: str = "packed_input_ids"
+
+    def __post_init__(self):
+        if self.adaptive_kl_ctl:
+            self.kl_controller = ppo_functional.AdaptiveKLController(
+                self.kl_ctl, self.adaptive_kl_target, self.adaptive_kl_horizon
+            )
+        else:
+            self.kl_controller = ppo_functional.FixedKLController(self.kl_ctl)
+        self._prep_jit = jax.jit(self._prep_padded)
+        self._loss_fn = functools.partial(_actor_loss, iface=self)
+
+    # -- advantage preparation (pre-minibatch-split, whole batch) -----------
+
+    def _prep_padded(self, batch: Dict, kl_ctl: jax.Array):
+        """jitted: padded batch -> (advantages, returns, loss_mask, kl_sum).
+        ``kl_ctl`` is traced so the adaptive controller doesn't bake a stale
+        constant into the compiled fn."""
+        trans_mask = _transition_mask(batch)
+        loss_mask = _response_mask(batch)
+        logp = batch.get("packed_logprobs", jnp.zeros_like(trans_mask))
+        ref_logp = batch.get("packed_ref_logprobs", logp)
+        score = (
+            batch["rewards"].astype(jnp.float32) * self.reward_scaling
+            - self.reward_bias
+        )
+        no_eos = batch.get(
+            "seq_no_eos_mask", jnp.zeros_like(score)
+        ).astype(jnp.float32)
+        kl_rewards, rewards = ppo_functional.shape_rewards(
+            kl_ctl,
+            self.max_reward_clip,
+            logp,
+            ref_logp,
+            score,
+            loss_mask,
+            seq_no_eos_mask=no_eos,
+            mask_no_eos_with_zero=self.mask_no_eos_with_zero,
+        )
+        if "values" in batch and not self.disable_value:
+            values = batch["values"].astype(jnp.float32)
+        else:
+            values = jnp.zeros_like(trans_mask)
+        # bootstrap with the value at the last token iff truncated
+        seq_lens = batch["seq_lens"]
+        last_idx = jnp.maximum(seq_lens - 1, 0)
+        v_last = jnp.take_along_axis(values, last_idx[:, None], axis=1)[:, 0]
+        bootstrap = v_last * no_eos
+        adv, ret = gae_advantages_returns(
+            rewards, values, bootstrap, trans_mask, self.discount, self.gae_lambda
+        )
+        kl_sum = jnp.sum(-kl_rewards) / jnp.maximum(kl_ctl, 1e-8)
+        return adv, ret, loss_mask, kl_sum
+
+    def _prepare_batch(self, engine, sample: SequenceSample) -> Dict[str, float]:
+        """Compute advantages/returns for the whole batch, amend the sample
+        with packed keys, and apply advantage normalization."""
+        pb = batching.pad_batch(
+            sample, token_key=self.token_key, row_multiple=1
+        )
+        batch = {
+            "tokens": pb.tokens,
+            "positions": pb.positions,
+            "seg_ids": pb.seg_ids,
+            "seq_lens": pb.seq_lens,
+            **pb.extras,
+        }
+        adv, ret, loss_mask, kl_sum = self._prep_jit(
+            batch, jnp.float32(self.kl_controller.value)
+        )
+        adv, ret, loss_mask = map(np.asarray, (adv, ret, loss_mask))
+
+        adv_packed = batching.unpad_per_token(adv, pb.seq_lens, pb.n_real, 1)
+        ret_packed = batching.unpad_per_token(ret, pb.seq_lens, pb.n_real, 1)
+        mask_packed = batching.unpad_per_token(
+            loss_mask, pb.seq_lens, pb.n_real, 1
+        )
+
+        # advantage normalization over response transitions
+        m = mask_packed > 0
+        if self.adv_norm and m.any():
+            if self.group_adv_norm and self.group_size > 1:
+                # normalize within each prompt group (GRPO-style)
+                seqlens = np.array(
+                    [l[0] - 1 for l in sample.seqlens[self.token_key]]
+                )
+                offsets = np.concatenate([[0], np.cumsum(seqlens)])
+                for g0 in range(0, len(seqlens), self.group_size):
+                    g1 = min(g0 + self.group_size, len(seqlens))
+                    sl = slice(offsets[g0], offsets[g1])
+                    gm = m[sl]
+                    if gm.any():
+                        vals = adv_packed[sl][gm]
+                        adv_packed[sl] = (
+                            adv_packed[sl] - vals.mean()
+                        ) / (vals.std() + 1e-5)
+            else:
+                vals = adv_packed[m]
+                adv_packed = (adv_packed - vals.mean()) / (vals.std() + 1e-5)
+            adv_packed = adv_packed * mask_packed
+
+        seqlens_full = [l[0] for l in sample.seqlens[self.token_key]]
+        amend = SequenceSample.from_default(
+            seqlens_full,
+            sample.ids,
+            {
+                "advantages": adv_packed.astype(np.float32),
+                "returns": ret_packed.astype(np.float32),
+                "ppo_loss_mask": mask_packed.astype(np.float32),
+            },
+        )
+        sample.update_(amend)
+        n_resp = float(m.sum())
+        return {
+            "kl": float(kl_sum) / max(n_resp, 1),
+            "n_response_tokens": n_resp,
+            "reward_mean": float(np.mean(sample.data["rewards"])),
+        }
+
+    # -- MFC handlers -------------------------------------------------------
+
+    def train_step(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> Dict:
+        engine = model.engine
+        prep_stats = self._prepare_batch(engine, data)
+
+        all_stats: Dict[str, float] = {}
+        mbs, *_ = data.split(MicroBatchSpec(n_mbs=self.n_minibatches))
+        for mb in mbs:
+            stats = engine.train_batch(
+                mb, self._loss_fn, mb_spec, token_key=self.token_key
+            )
+            for k, v in stats.items():
+                all_stats[k] = all_stats.get(k, 0.0) + v / len(mbs)
+        self.kl_controller.update(
+            prep_stats["kl"], int(prep_stats["n_response_tokens"])
+        )
+        all_stats.update(prep_stats)
+        all_stats["kl_ctl"] = self.kl_controller.value
+        model.version.advance(
+            model.ft_spec.steps_per_epoch if model.ft_spec else int(1e9)
+        )
+        with stats_tracker.scope("ppo_actor"):
+            stats_tracker.scalar(
+                **{
+                    k: v
+                    for k, v in all_stats.items()
+                    if isinstance(v, (int, float))
+                }
+            )
+        return all_stats
+
+    def inference(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> SequenceSample:
+        """Recompute logprobs under the current policy (prox_logp for the
+        decoupled loss; also used for the reference model's ref logprobs)."""
+        engine = model.engine
+        logp = engine.forward_batch(
+            data,
+            model_logprobs_fwd(self.temperature),
+            mb_spec,
+            token_key=self.token_key,
+            output_shift=1,
+        )
+        seqlens = [l[0] for l in data.seqlens[self.token_key]]
+        key = "prox_logp" if self.use_decoupled_loss else "packed_ref_logprobs"
+        return SequenceSample.from_default(
+            seqlens, data.ids, {key: logp.astype(np.float32)}
+        )
+
+    def generate(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> SequenceSample:
+        """On-mesh generation for sync PPO (reference :301)."""
+        from areal_tpu.engine.generation import generate_for_sample
+
+        return generate_for_sample(model, data, self.gconfig)
+
+
+def _actor_loss(params, cfg, batch, iface: PPOActorInterface):
+    hidden = hidden_states(
+        params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
+    )
+    B, T, D = hidden.shape
+    w = head_weight(params, cfg).astype(hidden.dtype) / iface.temperature
+    new_logp, entropy = per_token_logprobs_entropy(
+        hidden[:, :-1].reshape(-1, D), w, batch["tokens"][:, 1:].reshape(-1)
+    )
+    new_logp = jnp.pad(new_logp.reshape(B, T - 1), ((0, 0), (0, 1)))
+    loss_mask = batch["ppo_loss_mask"]
+    old_logp = batch["packed_logprobs"]
+    prox = batch.get("prox_logp") if iface.use_decoupled_loss else None
+    loss, stat = ppo_functional.actor_loss_fn(
+        new_logp.astype(jnp.float32),
+        old_logp.astype(jnp.float32),
+        batch["advantages"].astype(jnp.float32),
+        iface.eps_clip,
+        loss_mask,
+        c_clip=iface.c_clip,
+        proximal_logprobs=(
+            prox.astype(jnp.float32) if prox is not None else None
+        ),
+        behav_imp_weight_cap=iface.behav_imp_weight_cap,
+    )
+    count = jnp.maximum(jnp.sum(loss_mask), 1.0)
+    mask_b = loss_mask.astype(bool)
+    stats = {
+        "actor_clip_frac": jnp.sum(stat["clip_mask"]),
+        "approx_kl_sum": jnp.sum(stat["approx_kl"]),
+        "entropy_sum": jnp.sum(
+            jnp.pad(entropy.reshape(B, T - 1), ((0, 0), (0, 1))) * loss_mask
+        ),
+        "adv_sum": jnp.sum(
+            jnp.where(mask_b, batch["advantages"], 0.0)
+        ),
+    }
+    # engine divides grads by denom; return loss_sum = loss * count
+    return loss * count, count, stats
+
+
+@dataclasses.dataclass
+class PPOCriticInterface(model_api.ModelInterface):
+    n_minibatches: int = 4
+    value_eps_clip: float = 0.2
+    value_loss_type: str = "mse"
+    kl_ctl: float = 0.1
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    max_reward_clip: float = 5.0
+    mask_no_eos_with_zero: bool = False
+    token_key: str = "packed_input_ids"
+
+    def __post_init__(self):
+        # reuse the actor's GAE prep with disable-value off
+        self._prep = PPOActorInterface(
+            kl_ctl=self.kl_ctl,
+            discount=self.discount,
+            gae_lambda=self.gae_lambda,
+            max_reward_clip=self.max_reward_clip,
+            mask_no_eos_with_zero=self.mask_no_eos_with_zero,
+            adv_norm=False,
+            token_key=self.token_key,
+        )
+        self._loss_fn = functools.partial(_critic_loss, iface=self)
+
+    def inference(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> SequenceSample:
+        engine = model.engine
+        values = engine.forward_batch(
+            data, critic_values_fwd, mb_spec, token_key=self.token_key,
+            output_shift=0,
+        )
+        seqlens = [l[0] for l in data.seqlens[self.token_key]]
+        return SequenceSample.from_default(
+            seqlens, data.ids, {"values": values.astype(np.float32)}
+        )
+
+    def train_step(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> Dict:
+        engine = model.engine
+        if "returns" not in data.keys:
+            self._prep._prepare_batch(engine, data)
+        all_stats: Dict[str, float] = {}
+        mbs, *_ = data.split(MicroBatchSpec(n_mbs=self.n_minibatches))
+        for mb in mbs:
+            stats = engine.train_batch(
+                mb, self._loss_fn, mb_spec, token_key=self.token_key
+            )
+            for k, v in stats.items():
+                all_stats[k] = all_stats.get(k, 0.0) + v / len(mbs)
+        model.version.advance(
+            model.ft_spec.steps_per_epoch if model.ft_spec else int(1e9)
+        )
+        with stats_tracker.scope("ppo_critic"):
+            stats_tracker.scalar(
+                **{
+                    k: v
+                    for k, v in all_stats.items()
+                    if isinstance(v, (int, float))
+                }
+            )
+        return all_stats
+
+
+def _critic_loss(params, cfg, batch, iface: PPOCriticInterface):
+    from areal_tpu.models.transformer import forward
+
+    values = forward(
+        params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
+    ).astype(jnp.float32)
+    loss_mask = batch["ppo_loss_mask"]
+    old_values = batch.get("values", jnp.zeros_like(values)).astype(jnp.float32)
+    loss, stat = ppo_functional.critic_loss_fn(
+        values,
+        old_values,
+        batch["returns"].astype(jnp.float32),
+        iface.value_eps_clip,
+        loss_mask,
+        loss_fn_type=iface.value_loss_type,
+    )
+    count = jnp.maximum(jnp.sum(loss_mask), 1.0)
+    stats = {"value_clip_frac": jnp.sum(stat["clip_mask"])}
+    return loss * count, count, stats
+
+
+model_api.register_interface("ppo_actor", PPOActorInterface)
+model_api.register_interface("ppo_critic", PPOCriticInterface)
