@@ -100,24 +100,42 @@ def generate_loop(
         logits, last_idx[:, None, None], axis=1
     )[:, 0]  # [B, V]
 
-    rng, sub = jax.random.split(rng)
-    first_tok, first_logp = sample_logits(last_logits, sub, sampling)
-
-    def is_stop(tok, n_gen):
+    def is_stop(tok):
         stop = jnp.zeros_like(tok, dtype=bool)
         for s in stop_tokens:
             stop |= tok == s
-        # ignore stops before min_new_tokens
-        return stop & (n_gen >= min_new_tokens)
+        return stop
+
+    def suppress_stops(logits, n_prev):
+        """Ban stop tokens from sampling until min_new_tokens are generated
+        (reference: genstep's min-length logit ban,
+        realhf/impl/model/nn/real_llm_generate.py:30)."""
+        if min_new_tokens <= 0 or not stop_tokens:
+            return logits
+        allow = (n_prev + 1 >= min_new_tokens)[:, None]  # [B,1]
+        banned = jnp.zeros((logits.shape[-1],), bool)
+        for s in stop_tokens:
+            banned = banned.at[s].set(True)
+        return jnp.where(~allow & banned[None, :], -jnp.inf, logits)
+
+    rng, sub = jax.random.split(rng)
+    n_prev0 = jnp.zeros((B,), jnp.int32)
+    first_tok, first_logp = sample_logits(
+        suppress_stops(last_logits, n_prev0), sub, sampling
+    )
 
     out_tokens = jnp.zeros((B, max_new_tokens), jnp.int32)
     out_logps = jnp.zeros((B, max_new_tokens), jnp.float32)
     out_tokens = out_tokens.at[:, 0].set(first_tok)
     out_logps = out_logps.at[:, 0].set(first_logp)
     n_gen0 = jnp.ones((B,), jnp.int32)
-    active0 = ~is_stop(first_tok, n_gen0)
-    # rows beyond capacity guard: never generate past cache_len
-    active0 &= cache.lengths + 1 < cache_len
+    active0 = ~is_stop(first_tok)
+    # empty rows (batch padding) are never active — otherwise the early exit
+    # below would never fire
+    active0 &= prompt_lens > 0
+    # capacity guard: the next decode step writes the current token's KV at
+    # slot ``lengths``, so continuing requires lengths < cache_len
+    active0 &= cache.lengths < cache_len
 
     state = GenState(
         cache=cache,
@@ -138,17 +156,19 @@ def generate_loop(
             params, cfg, s.cur_tokens, s.cache, active=s.active
         )
         rng, sub = jax.random.split(s.rng)
-        tok, logp = sample_logits(logits.astype(jnp.float32), sub, sampling)
+        tok, logp = sample_logits(
+            suppress_stops(logits.astype(jnp.float32), s.n_generated),
+            sub,
+            sampling,
+        )
         tok = jnp.where(s.active, tok, 0)
         n_gen = s.n_generated + s.active.astype(jnp.int32)
-        out_tokens = s.out_tokens.at[:, s.step].set(
-            jnp.where(s.active, tok, 0)
-        )
+        out_tokens = s.out_tokens.at[:, s.step].set(tok)
         out_logps = s.out_logps.at[:, s.step].set(
             jnp.where(s.active, logp, 0.0)
         )
-        active = s.active & ~is_stop(tok, n_gen)
-        active &= cache.lengths + 1 < cache_len
+        active = s.active & ~is_stop(tok)
+        active &= cache.lengths < cache_len
         return GenState(
             cache=cache,
             cur_tokens=tok,

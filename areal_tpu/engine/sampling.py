@@ -32,7 +32,10 @@ def sample_logits(
     top-k/p filtering — matching what inference servers report and what PPO
     treats as the behavioral logprob.
     """
-    if params.temperature != 1.0 and not params.greedy:
+    # Scale even in greedy mode: argmax is temperature-invariant but the
+    # reported behavioral logprob must match the trainer's recompute, which
+    # always applies temperature.
+    if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-5)
     base_logprobs = jax.nn.log_softmax(logits, axis=-1)
 

@@ -162,10 +162,12 @@ class PPOActorInterface(model_api.ModelInterface):
         adv, ret = gae_advantages_returns(
             rewards, values, bootstrap, trans_mask, self.discount, self.gae_lambda
         )
-        kl_sum = jnp.sum(-kl_rewards) / jnp.maximum(kl_ctl, 1e-8)
+        # true behav-vs-ref KL, independent of kl_ctl (so the monitoring stat
+        # stays meaningful at kl_ctl=0)
+        kl_sum = jnp.sum((logp - ref_logp) * loss_mask)
         return adv, ret, loss_mask, kl_sum
 
-    def _prepare_batch(self, engine, sample: SequenceSample) -> Dict[str, float]:
+    def _prepare_batch(self, sample: SequenceSample) -> Dict[str, float]:
         """Compute advantages/returns for the whole batch, amend the sample
         with packed keys, and apply advantage normalization."""
         pb = batching.pad_batch(
@@ -239,7 +241,7 @@ class PPOActorInterface(model_api.ModelInterface):
         mb_spec: MicroBatchSpec,
     ) -> Dict:
         engine = model.engine
-        prep_stats = self._prepare_batch(engine, data)
+        prep_stats = self._prepare_batch(data)
 
         all_stats: Dict[str, float] = {}
         mbs, *_ = data.split(MicroBatchSpec(n_mbs=self.n_minibatches))
@@ -329,7 +331,7 @@ def _actor_loss(params, cfg, batch, iface: PPOActorInterface):
     count = jnp.maximum(jnp.sum(loss_mask), 1.0)
     mask_b = loss_mask.astype(bool)
     stats = {
-        "actor_clip_frac": jnp.sum(stat["clip_mask"]),
+        "actor_clip_frac": jnp.sum(stat["clip_mask"]) / count,
         "approx_kl_sum": jnp.sum(stat["approx_kl"]),
         "entropy_sum": jnp.sum(
             jnp.pad(entropy.reshape(B, T - 1), ((0, 0), (0, 1))) * loss_mask
@@ -391,7 +393,7 @@ class PPOCriticInterface(model_api.ModelInterface):
     ) -> Dict:
         engine = model.engine
         if "returns" not in data.keys:
-            self._prep._prepare_batch(engine, data)
+            self._prep._prepare_batch(data)
         all_stats: Dict[str, float] = {}
         mbs, *_ = data.split(MicroBatchSpec(n_mbs=self.n_minibatches))
         for mb in mbs:
@@ -431,7 +433,7 @@ def _critic_loss(params, cfg, batch, iface: PPOCriticInterface):
         loss_fn_type=iface.value_loss_type,
     )
     count = jnp.maximum(jnp.sum(loss_mask), 1.0)
-    stats = {"value_clip_frac": jnp.sum(stat["clip_mask"])}
+    stats = {"value_clip_frac": jnp.sum(stat["clip_mask"]) / count}
     return loss * count, count, stats
 
 

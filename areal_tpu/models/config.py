@@ -51,6 +51,9 @@ class TransformerConfig:
     # numerics
     dtype: str = "bfloat16"  # activation/param dtype on device
     logits_dtype: str = "float32"
+    # rematerialize each layer in backward (jax.checkpoint over the layer
+    # scan) — trades FLOPs for activation memory, standard for training.
+    remat: bool = False
 
     def __post_init__(self):
         assert self.n_q_heads % self.n_kv_heads == 0

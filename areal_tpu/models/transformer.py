@@ -260,21 +260,17 @@ def reference_attention(q, k, v, mask, logits_dtype=jnp.float32):
     return out
 
 
-def _attention_dispatch(q, k, v, mask, cfg: TransformerConfig):
+def _attention_dispatch(q, k, v, mask, cfg: TransformerConfig, seg_ids=None):
     """Pick the attention implementation: Pallas flash on TPU for the
     self-attention (no-cache) path; jnp reference elsewhere."""
-    use_pallas = (
-        jax.default_backend() == "tpu"
-        and q.shape[1] == k.shape[1]
-        and q.shape[1] >= 128
-    )
-    if use_pallas:
-        try:
-            from areal_tpu.ops.flash_attention import flash_attention
+    from areal_tpu.ops import flash_attention as fa
 
-            return flash_attention(q, k, v, mask=mask)
-        except Exception:  # pragma: no cover - fallback safety
-            pass
+    if (
+        seg_ids is not None
+        and jax.default_backend() == "tpu"
+        and fa.supported(q.shape[1], k.shape[1], cfg.sliding_window)
+    ):
+        return fa.flash_attention(q, k, v, seg_ids)
     return reference_attention(q, k, v, mask)
 
 
@@ -320,6 +316,7 @@ def _layer(
     mask: jax.Array,
     kv: Optional[Tuple[jax.Array, jax.Array]] = None,
     kv_write_pos: Optional[jax.Array] = None,
+    seg_ids: Optional[jax.Array] = None,
 ):
     """One transformer block. Returns (y, (k_full, v_full)) where k/v_full
     include cached history when provided."""
@@ -356,7 +353,7 @@ def _layer(
         attn_out = reference_attention(q, k_full, v_full, mask)
     else:
         k_full = v_full = None
-        attn_out = _attention_dispatch(q, k, v, mask, cfg)
+        attn_out = _attention_dispatch(q, k, v, mask, cfg, seg_ids=seg_ids)
 
     attn_out = attn_out.reshape(B, T, cfg.n_q_heads * cfg.head_dim)
     x = x + proj(lp["attn"]["o"], attn_out)
@@ -373,6 +370,19 @@ def _layer(
         mlp_out = proj(lp["mlp"]["down"], gate)
     x = x + mlp_out
     return x, (k_full, v_full)
+
+
+def _run_layers(params, cfg: TransformerConfig, x, positions, mask, seg_ids):
+    """Scan over stacked layers (self-attention path, no cache)."""
+
+    def body(carry, lp):
+        y, _ = _layer(cfg, carry, lp, positions, mask, seg_ids=seg_ids)
+        return y, None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
 
 
 def _embed(params, cfg: TransformerConfig, tokens, positions):
@@ -411,12 +421,7 @@ def forward(
     mask = make_attention_mask(
         seg_ids, positions, seg_ids, positions, cfg.sliding_window
     )
-
-    def body(carry, lp):
-        y, _ = _layer(cfg, carry, lp, positions, mask)
-        return y, None
-
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _run_layers(params, cfg, x, positions, mask, seg_ids)
     return _head(params, cfg, x)
 
 
@@ -522,12 +527,7 @@ def hidden_states(
     mask = make_attention_mask(
         seg_ids, positions, seg_ids, positions, cfg.sliding_window
     )
-
-    def body(carry, lp):
-        y, _ = _layer(cfg, carry, lp, positions, mask)
-        return y, None
-
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _run_layers(params, cfg, x, positions, mask, seg_ids)
     return _norm(x, params["final_norm"], cfg)
 
 
@@ -555,12 +555,7 @@ def logprobs_of_labels(
     mask = make_attention_mask(
         seg_ids, positions, seg_ids, positions, cfg.sliding_window
     )
-
-    def body(carry, lp):
-        y, _ = _layer(cfg, carry, lp, positions, mask)
-        return y, None
-
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _run_layers(params, cfg, x, positions, mask, seg_ids)
     x = _norm(x, params["final_norm"], cfg)
     if cfg.tied_embedding:
         w = params["embed"]["weight"].astype(x.dtype).T

@@ -1,0 +1,151 @@
+"""Benchmark driver: one JSON line with the headline metric.
+
+Measures training-step MFU (model FLOPs utilization) of the sharded train
+engine on the local chip: a dense Qwen2.5-flavor model, packed 2k sequences,
+full forward+backward+optimizer step via ``TrainEngine.train_batch``.
+
+``vs_baseline`` normalizes our MFU against the reference system's assumed
+training MFU on H800 (0.35 — typical of Megatron-backed dense-model RL
+trainers at this scale; the reference publishes no per-GPU tok/s, see
+SURVEY.md §6), making the comparison hardware-neutral.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_TRAIN_MFU = 0.35
+
+# bf16 peak TFLOP/s per chip
+PEAK_TFLOPS = {
+    "v3": 123,
+    "v4": 275,
+    "v5e": 197,
+    "v5 lite": 197,
+    "v5p": 459,
+    "v6e": 918,
+    "trillium": 918,
+    "cpu": 0.2,  # nominal, so the script degrades gracefully off-TPU
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for name, tf in PEAK_TFLOPS.items():
+        if name in kind:
+            return tf * 1e12
+    return PEAK_TFLOPS["cpu"] * 1e12
+
+
+def param_count(params) -> int:
+    import jax
+
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+
+
+def main():
+    import jax
+
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.engine.train_engine import TrainEngine
+    from areal_tpu.interfaces.sft_interface import sft_loss_fn
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import TransformerConfig
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        # ~0.5B dense model (fits v5e 16G HBM with fp32 adam states)
+        cfg = TransformerConfig(
+            n_layers=24,
+            hidden_dim=1024,
+            n_q_heads=16,
+            n_kv_heads=8,
+            head_dim=64,
+            intermediate_dim=5504,
+            vocab_size=32768,
+            max_position_embeddings=4096,
+            use_attention_bias=True,
+            dtype="bfloat16",
+            remat=True,
+        )
+        seq_len, n_seqs, timed_steps = 2048, 16, 3
+    else:
+        cfg = TransformerConfig(
+            n_layers=4,
+            hidden_dim=256,
+            n_q_heads=4,
+            n_kv_heads=2,
+            head_dim=64,
+            intermediate_dim=1024,
+            vocab_size=2048,
+            max_position_embeddings=1024,
+            dtype="float32",
+        )
+        seq_len, n_seqs, timed_steps = 512, 4, 2
+
+    # fp32 master weights; the model casts to cfg.dtype (bf16) at use, so
+    # compute runs on the MXU in bf16 while adam states stay fp32.
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = param_count(params)
+
+    mesh = MeshSpec().make_mesh(jax.devices()[:1])
+    engine = TrainEngine(
+        cfg,
+        mesh,
+        params,
+        optimizer_cfg=OptimizerConfig(lr=1e-5),
+        total_train_steps=100,
+    )
+
+    rng = np.random.default_rng(0)
+    tokens_per_step = n_seqs * seq_len
+    sample = SequenceSample.from_default(
+        seqlens=[seq_len] * n_seqs,
+        ids=list(range(n_seqs)),
+        data={
+            "packed_input_ids": rng.integers(
+                0, cfg.vocab_size, (tokens_per_step,)
+            ).astype(np.int64),
+            "prompt_mask": np.zeros((tokens_per_step,), bool),
+        },
+    )
+    mb_spec = MicroBatchSpec(n_mbs=1)
+
+    engine.train_batch(sample, sft_loss_fn, mb_spec)  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        engine.train_batch(sample, sft_loss_fn, mb_spec)
+    dt = (time.perf_counter() - t0) / timed_steps
+
+    toks_per_sec = tokens_per_step / dt
+    flops_per_tok = 6 * n_params  # dense fwd+bwd
+    mfu = toks_per_sec * flops_per_tok / peak_flops(dev)
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_step_mfu",
+                "value": round(mfu, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(mfu / REFERENCE_TRAIN_MFU, 4),
+                "detail": {
+                    "device": getattr(dev, "device_kind", dev.platform),
+                    "n_params": n_params,
+                    "tokens_per_sec": round(toks_per_sec, 1),
+                    "step_time_s": round(dt, 4),
+                    "tokens_per_step": tokens_per_step,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
