@@ -175,6 +175,9 @@ class Model:
     )
     ft_spec: Optional[FinetuneSpec] = None
     backend_name: str = ""
+    # set by make_model, consumed by backend initialize
+    model_cfg: Any = None
+    init_params: Any = None
 
 
 class ModelBackend(abc.ABC):

@@ -1,0 +1,204 @@
+"""Experiment/worker configuration dataclasses.
+
+Rebuild of the reference's system API (reference:
+realhf/api/core/system_api.py — ``ModelWorker`` :95, ``GenerationServer``
+:124, ``GserverManager`` :134, ``RolloutWorker`` :146, ``MasterWorker``
+:159, ``ExperimentConfig`` :190 with DFG lazy-init, ``Experiment`` ABC +
+registry :457-488).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from areal_tpu.api.config import (
+    AgentAbstraction,
+    DatasetAbstraction,
+    EnvServiceAbstraction,
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+)
+from areal_tpu.api.dfg import MFCDef, build_graph
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.base.topology import MeshSpec
+
+
+@dataclasses.dataclass
+class ExperimentSaveEvalControl:
+    """Frequency control for save/eval/recover-ckpt
+    (reference: realhf/api/cli_args.py:702)."""
+
+    total_train_epochs: int = 1
+    save_freq_epochs: Optional[int] = None
+    save_freq_steps: Optional[int] = None
+    save_freq_secs: Optional[int] = None
+    ckpt_freq_epochs: Optional[int] = None
+    ckpt_freq_steps: Optional[int] = None
+    ckpt_freq_secs: Optional[int] = None
+    eval_freq_epochs: Optional[int] = None
+    eval_freq_steps: Optional[int] = None
+    eval_freq_secs: Optional[int] = None
+    benchmark_steps: Optional[int] = None  # early exit for profiling runs
+
+
+@dataclasses.dataclass
+class ModelShard:
+    """One model role hosted by a model worker (reference: system_api.py
+    ``StandaloneModelShard``)."""
+
+    model_name: ModelName
+    model: ModelAbstraction
+    backend: ModelBackendAbstraction
+    mesh_spec: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    eval_dataset: Optional[DatasetAbstraction] = None
+
+
+@dataclasses.dataclass
+class ModelWorkerConfig:
+    worker_name: str
+    shards: List[ModelShard] = dataclasses.field(default_factory=list)
+    # interfaces per MFC name (the worker instantiates them lazily)
+    interfaces: Dict[str, ModelInterfaceAbstraction] = dataclasses.field(
+        default_factory=dict
+    )
+    datasets: List[DatasetAbstraction] = dataclasses.field(
+        default_factory=list
+    )
+    tokenizer_path: Optional[str] = None
+    dataset_seed: int = 1
+    # which DP shard of the dataset this worker loads (dp_rank, dp_size)
+    dataset_shard: Tuple[int, int] = (0, 1)
+    use_stream_dataset: bool = False  # async mode: data arrives by push
+    seed: int = 1
+
+
+@dataclasses.dataclass
+class MasterWorkerConfig:
+    worker_name: str = "master"
+    model_rpcs: List[MFCDef] = dataclasses.field(default_factory=list)
+    model_worker_names: List[str] = dataclasses.field(default_factory=list)
+    # worker names hosting each model role (requests broadcast to the group)
+    model_groups: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict
+    )
+    exp_ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    # the MFC whose n_seqs defines one train iteration
+    train_rpc_name: str = ""
+    seed: int = 1
+
+
+@dataclasses.dataclass
+class RolloutWorkerConfig:
+    worker_name: str
+    agent: AgentAbstraction = None
+    env: EnvServiceAbstraction = None
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    datasets: List[DatasetAbstraction] = dataclasses.field(
+        default_factory=list
+    )
+    tokenizer_path: Optional[str] = None
+    dataset_shard: Tuple[int, int] = (0, 1)
+    dataset_seed: int = 1
+    rollout_request_timeout: float = 600.0
+
+
+@dataclasses.dataclass
+class GenServerConfig:
+    worker_name: str
+    model: ModelAbstraction = None
+    mesh_spec: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    tokenizer_path: Optional[str] = None
+    max_concurrent_batch: int = 64
+    kv_cache_len: int = 32768
+
+
+@dataclasses.dataclass
+class GserverManagerConfig:
+    worker_name: str = "gserver_manager"
+    n_servers: int = 1
+    schedule_policy: str = "round_robin"
+    max_head_offpolicyness: int = 0
+    train_batch_size: int = 1
+    max_concurrent_rollouts: Optional[int] = None
+    flush_request_timeout: float = 120.0
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    experiment_name: str
+    trial_name: str
+    master: MasterWorkerConfig
+    model_workers: List[ModelWorkerConfig] = dataclasses.field(
+        default_factory=list
+    )
+    rollout_workers: List[RolloutWorkerConfig] = dataclasses.field(
+        default_factory=list
+    )
+    gen_servers: List[GenServerConfig] = dataclasses.field(
+        default_factory=list
+    )
+    gserver_manager: Optional[GserverManagerConfig] = None
+
+    def lazy_init(self):
+        """Build the MFC graph and sanity-check worker wiring
+        (reference: system_api.py ExperimentConfig.lazy_init :190)."""
+        build_graph(self.master.model_rpcs)
+        self.master.model_worker_names = [
+            w.worker_name for w in self.model_workers
+        ]
+        if not self.master.model_groups:
+            groups: Dict[str, List[str]] = {}
+            for w in self.model_workers:
+                for s in w.shards:
+                    groups.setdefault(str(s.model_name), []).append(
+                        w.worker_name
+                    )
+            self.master.model_groups = groups
+        for rpc in self.master.model_rpcs:
+            if str(rpc.model_name) not in self.master.model_groups:
+                raise ValueError(
+                    f"MFC {rpc.name}: no worker hosts {rpc.model_name}"
+                )
+        if not self.master.train_rpc_name:
+            from areal_tpu.api.dfg import ModelInterfaceType
+
+            trains = [
+                r
+                for r in self.master.model_rpcs
+                if r.interface_type == ModelInterfaceType.TRAIN_STEP
+            ]
+            if trains:
+                self.master.train_rpc_name = trains[0].name
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Experiment registry (reference :457-488)
+# ---------------------------------------------------------------------------
+
+
+class Experiment:
+    """User-facing experiment: produces an ExperimentConfig."""
+
+    def initial_setup(self) -> ExperimentConfig:
+        raise NotImplementedError()
+
+
+_EXPERIMENTS: Dict[str, Callable[[], Experiment]] = {}
+
+
+def register_experiment(name: str, cls: Callable[[], Experiment]):
+    if name in _EXPERIMENTS:
+        raise KeyError(f"experiment {name} already registered")
+    _EXPERIMENTS[name] = cls
+
+
+def make_experiment(name: str, *args, **kwargs) -> Experiment:
+    return _EXPERIMENTS[name](*args, **kwargs)

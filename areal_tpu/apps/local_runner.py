@@ -1,0 +1,138 @@
+"""In-process experiment runner: master + workers as threads.
+
+Rebuild of the reference's local launch path (reference:
+realhf/apps/main.py ``main_start`` + realhf/system/controller.py; the
+threaded mode mirrors the CPU e2e test harness
+tests/experiments/utils.py:52 ``run_test_exp``).  Suitable for single-host
+experiments — which on TPU covers a whole slice, since one process drives
+all local chips.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from areal_tpu.api import system_api
+from areal_tpu.base import constants, logging_, name_resolve
+from areal_tpu.system.master_worker import MasterWorker
+from areal_tpu.system.model_worker import ModelWorker
+from areal_tpu.system.worker_base import WorkerServerStatus
+
+logger = logging_.getLogger("local_runner")
+
+
+def register_impls():
+    """Import all implementation modules so their registries populate
+    (reference: realhf/apps/remote.py ``_patch_external_impl``)."""
+    import areal_tpu.data.math_code_dataset  # noqa: F401
+    import areal_tpu.data.prompt_answer_dataset  # noqa: F401
+    import areal_tpu.data.prompt_dataset  # noqa: F401
+    import areal_tpu.data.rw_paired_dataset  # noqa: F401
+    import areal_tpu.engine.backend  # noqa: F401
+    import areal_tpu.interfaces.ppo_interface  # noqa: F401
+    import areal_tpu.interfaces.rw_interface  # noqa: F401
+    import areal_tpu.interfaces.sft_interface  # noqa: F401
+
+
+def run_experiment_local(
+    cfg: system_api.ExperimentConfig,
+    timeout: Optional[float] = None,
+) -> MasterWorker:
+    """Run to completion in this process; returns the master (stats inside)."""
+    register_impls()
+    constants.set_experiment_trial_names(cfg.experiment_name, cfg.trial_name)
+
+    workers: List[ModelWorker] = []
+    threads: List[threading.Thread] = []
+    errors: List[BaseException] = []
+
+    def _run_worker(w, wcfg):
+        try:
+            w.run(wcfg)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    for wcfg in cfg.model_workers:
+        w = ModelWorker()
+        workers.append(w)
+        t = threading.Thread(
+            target=_run_worker, args=(w, wcfg), daemon=True,
+            name=wcfg.worker_name,
+        )
+        t.start()
+        threads.append(t)
+
+    # rollout stack (async experiments)
+    aux_threads, aux_workers = _start_rollout_stack(cfg, errors)
+
+    master = MasterWorker()
+    master_err: List[BaseException] = []
+
+    def _run_master():
+        try:
+            master.run_async(cfg.master)
+        except BaseException as e:  # noqa: BLE001
+            master_err.append(e)
+
+    mt = threading.Thread(target=_run_master, daemon=True, name="master")
+    mt.start()
+    deadline = time.monotonic() + timeout if timeout else None
+    while mt.is_alive():
+        mt.join(timeout=0.5)
+        if errors:
+            for w in workers:
+                w.exit()
+            raise RuntimeError("worker failed") from errors[0]
+        if deadline and time.monotonic() > deadline:
+            raise TimeoutError("experiment timed out")
+    if master_err:
+        raise RuntimeError("master failed") from master_err[0]
+
+    for w in workers + aux_workers:
+        w.exit()
+    for t in threads + aux_threads:
+        t.join(timeout=10)
+    return master
+
+
+def _start_rollout_stack(cfg: system_api.ExperimentConfig, errors):
+    threads = []
+    aux = []
+    if cfg.gen_servers:
+        from areal_tpu.system.generation_server import GenerationServerWorker
+
+        for gcfg in cfg.gen_servers:
+            aux.append((GenerationServerWorker(), gcfg))
+    if cfg.gserver_manager is not None:
+        from areal_tpu.system.gserver_manager import GserverManager
+
+        aux.append((GserverManager(), cfg.gserver_manager))
+    if cfg.rollout_workers:
+        from areal_tpu.system.rollout_worker import RolloutWorker
+
+        for rcfg in cfg.rollout_workers:
+            aux.append((RolloutWorker(), rcfg))
+
+    from areal_tpu.system.worker_base import AsyncWorker
+
+    def _run(w, wcfg):
+        try:
+            if isinstance(w, AsyncWorker):
+                w.run_async(wcfg)
+            else:
+                w.run(wcfg)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    worker_objs = []
+    for w, wcfg in aux:
+        worker_objs.append(w)
+        t = threading.Thread(
+            target=_run, args=(w, wcfg), daemon=True,
+            name=getattr(wcfg, "worker_name", "aux"),
+        )
+        t.start()
+        threads.append(t)
+    return threads, worker_objs

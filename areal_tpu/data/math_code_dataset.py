@@ -96,6 +96,7 @@ class MATHCodePromptDataset(torch.utils.data.Dataset):
         )
         self.tasks_ids = [d["task"] for d in data]
         self.ids = [str(d["query_id"]) for d in data]
+        self.solutions = [d.get("solutions", []) for d in data]
         util.tokenizer.padding_side = "left"
         encodings = util.tokenizer(
             [d["prompt"] for d in data],
@@ -124,7 +125,10 @@ class MATHCodePromptDataset(torch.utils.data.Dataset):
             seqlens=[len(tokens)],
             ids=[self.ids[i]],
             data={"packed_prompts": tokens},
-            metadata={"task": [self.tasks_ids[i]]},
+            metadata={
+                "task": [self.tasks_ids[i]],
+                "solutions": [self.solutions[i]],
+            },
         )
 
     def filter(self, eval_scores: Dict[str, float]):

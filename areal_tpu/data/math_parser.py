@@ -1,0 +1,130 @@
+"""Math answer verification.
+
+Rebuild of the reference's math parser (reference:
+realhf/impl/dataset/math_parser.py — latex/sympy normalization + equivalence
+check, process-pool parallel ``parse_lines_in_parallel``; the reference
+vendors latex2sympy, we use plain sympy with a latex-lite normalizer).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("math_parser")
+
+_BOXED_RE = re.compile(r"\\boxed\s*\{")
+
+
+def extract_boxed(text: str) -> Optional[str]:
+    """Last \\boxed{...} content (brace-balanced)."""
+    last = None
+    for m in _BOXED_RE.finditer(text):
+        depth = 1
+        i = m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        if depth == 0:
+            last = text[m.end() : i - 1]
+    return last
+
+
+def extract_answer(text: str) -> Optional[str]:
+    """Final answer from a solution string: \\boxed{} first, then the last
+    'answer is' clause, then the last number."""
+    boxed = extract_boxed(text)
+    if boxed is not None:
+        return boxed
+    m = re.findall(r"(?:answer is|answer:)\s*([^\n.]+)", text, re.IGNORECASE)
+    if m:
+        return m[-1].strip()
+    nums = re.findall(r"-?\d+(?:\.\d+)?(?:/\d+)?", text)
+    return nums[-1] if nums else None
+
+
+def _normalize(ans: str) -> str:
+    ans = ans.strip()
+    ans = re.sub(r"\\(left|right|,|;|!|:)\b", "", ans)
+    ans = ans.replace("\\$", "").replace("$", "").replace("%", "")
+    ans = re.sub(r"\\text\s*\{[^}]*\}", "", ans)
+    ans = re.sub(r"\\mathrm\s*\{[^}]*\}", "", ans)
+    ans = ans.replace("\\dfrac", "\\frac").replace("\\tfrac", "\\frac")
+    ans = ans.replace(" ", "").rstrip(".").rstrip(",")
+    ans = ans.replace("^\\circ", "").replace("^{\\circ}", "")
+    return ans
+
+
+def _latex_to_expr(s: str):
+    """Latex-lite -> sympy expression (handles frac/sqrt/pi/cdot/times)."""
+    import sympy
+
+    t = s
+    # \frac{a}{b} -> ((a)/(b)), innermost-first
+    frac = re.compile(r"\\frac\s*\{([^{}]*)\}\s*\{([^{}]*)\}")
+    while frac.search(t):
+        t = frac.sub(r"((\1)/(\2))", t)
+    sqrt = re.compile(r"\\sqrt\s*\{([^{}]*)\}")
+    while sqrt.search(t):
+        t = sqrt.sub(r"(sqrt(\1))", t)
+    t = t.replace("\\pi", "pi").replace("\\cdot", "*").replace("\\times", "*")
+    t = t.replace("{", "(").replace("}", ")")
+    t = re.sub(r"(\d)\(", r"\1*(", t)  # 2(x) -> 2*(x)
+    t = re.sub(r"\)(\d)", r")*\1", t)
+    t = re.sub(r"(\d)(pi|sqrt)", r"\1*\2", t)
+    t = t.replace("^", "**")
+    return sympy.sympify(t)
+
+
+def math_equal(pred: str, ref: str) -> bool:
+    """Equivalence: string match after normalization, then numeric/symbolic."""
+    if pred is None or ref is None:
+        return False
+    p, r = _normalize(pred), _normalize(ref)
+    if not p or not r:
+        return False
+    if p == r or p.lower() == r.lower():
+        return True
+    try:
+        ep, er = _latex_to_expr(p), _latex_to_expr(r)
+        diff = (ep - er).simplify() if hasattr(ep - er, "simplify") else ep - er
+        if diff == 0:
+            return True
+        # numeric fallback
+        import sympy
+
+        return bool(abs(sympy.N(ep) - sympy.N(er)) < 1e-6)
+    except Exception:
+        return False
+
+
+def verify_math_solution(generated: str, solutions: List[str]) -> float:
+    """1.0 if the generated final answer matches any reference solution."""
+    pred = extract_answer(generated)
+    if pred is None:
+        return 0.0
+    for sol in solutions:
+        ref = extract_boxed(sol) or extract_answer(sol) or sol
+        if math_equal(pred, ref):
+            return 1.0
+    return 0.0
+
+
+def parse_lines_in_parallel(
+    generateds: List[str], solutions_list: List[List[str]], max_workers: int = 8
+) -> List[float]:
+    """Verify many answers concurrently (sympy can be slow per-item)."""
+    if len(generateds) <= 4:
+        return [
+            verify_math_solution(g, s)
+            for g, s in zip(generateds, solutions_list)
+        ]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=max_workers) as ex:
+        return list(ex.map(verify_math_solution, generateds, solutions_list))

@@ -1,0 +1,151 @@
+"""Model factory + train/inference backends.
+
+Rebuild of the reference's backend layer (reference:
+realhf/impl/model/backend/megatron.py ``MegatronTrainBackend`` :561,
+realhf/impl/model/backend/inference.py ``PipelinableInferenceEngine`` :230,
+realhf/api/core/model_api.py ``make_model`` :928): a backend turns a raw
+(config, params) bundle into an engine with train_batch/forward_batch; on
+TPU both are the sharded ``TrainEngine`` (the inference variant simply has
+no optimizer state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from areal_tpu.api import model_api
+from areal_tpu.api.config import ModelAbstraction, ModelName
+from areal_tpu.base import logging_
+from areal_tpu.engine.optimizer import OptimizerConfig
+from areal_tpu.engine.train_engine import TrainEngine
+from areal_tpu.models.config import TransformerConfig, tiny_config
+
+logger = logging_.getLogger("backend")
+
+
+def make_model(
+    cfg: ModelAbstraction,
+    name: ModelName,
+    mesh,
+    tokenizer=None,
+) -> model_api.Model:
+    """Build an uninitialized Model bundle.
+
+    Abstraction types:
+      - ``hf``: args {path, is_critic?, dtype?, remat?} — HF checkpoint dir
+      - ``random``: args {config: dict | TransformerConfig kwargs, seed?} —
+        random init (tests / from-scratch)
+    """
+    if cfg.type_ == "null":
+        # engine-less bundle for rule-based interfaces (e.g. the math reward
+        # verifier needs only the tokenizer)
+        model = model_api.Model(
+            name=name, engine=None, tokenizer=tokenizer, mesh=mesh
+        )
+        model.model_cfg = tiny_config()
+        return model
+    if cfg.type_ == "hf":
+        from areal_tpu.models.hf.registry import load_hf_config, load_hf_model
+
+        overrides = {
+            k: v for k, v in cfg.args.items() if k in ("is_critic", "dtype")
+        }
+        model_cfg, params = load_hf_model(cfg.args["path"], **overrides)
+        if cfg.args.get("remat"):
+            model_cfg = dataclasses.replace(model_cfg, remat=True)
+        family, _, _ = load_hf_config(cfg.args["path"])
+        backend_name = family.name
+    elif cfg.type_ == "random":
+        args = dict(cfg.args)
+        seed = args.pop("seed", 0)
+        conf = args.pop("config", None)
+        if isinstance(conf, TransformerConfig):
+            model_cfg = conf
+        elif conf is not None:
+            model_cfg = TransformerConfig(**conf)
+        else:
+            model_cfg = tiny_config(**args)
+        from areal_tpu.models.transformer import init_params
+
+        params = init_params(model_cfg, jax.random.PRNGKey(seed))
+        backend_name = "llama"
+    else:
+        raise ValueError(f"unknown model abstraction {cfg.type_}")
+
+    model = model_api.Model(
+        name=name,
+        engine=None,
+        tokenizer=tokenizer,
+        mesh=mesh,
+        backend_name=backend_name,
+    )
+    model.model_cfg = model_cfg
+    model.init_params = params
+    return model
+
+
+@dataclasses.dataclass
+class TrainBackend(model_api.ModelBackend):
+    """Sharded train engine with optimizer (reference: megatron.py:561)."""
+
+    optimizer: OptimizerConfig = dataclasses.field(
+        default_factory=OptimizerConfig
+    )
+
+    def _initialize(self, model, spec):
+        model.engine = TrainEngine(
+            model.model_cfg,
+            model.mesh,
+            model.init_params,
+            optimizer_cfg=self.optimizer,
+            total_train_steps=max(1, spec.total_train_steps),
+        )
+        model.init_params = None
+        return model
+
+    def save(self, model, save_dir: str):
+        import os
+
+        os.makedirs(save_dir, exist_ok=True)
+        model.engine.save_optimizer_state(
+            os.path.join(save_dir, "optimizer.pkl")
+        )
+
+    def load(self, model, load_dir: str):
+        import os
+
+        path = os.path.join(load_dir, "optimizer.pkl")
+        if os.path.exists(path):
+            model.engine.load_optimizer_state(path)
+
+
+@dataclasses.dataclass
+class InferenceBackend(model_api.ModelBackend):
+    """Engine without optimizer state (reference: inference.py:230)."""
+
+    def _initialize(self, model, spec):
+        model.engine = TrainEngine(
+            model.model_cfg,
+            model.mesh,
+            model.init_params,
+            optimizer_cfg=None,
+        )
+        model.init_params = None
+        return model
+
+
+@dataclasses.dataclass
+class NullBackend(model_api.ModelBackend):
+    """No-op backend for engine-less roles (rule-based reward)."""
+
+    def _initialize(self, model, spec):
+        return model
+
+
+model_api.register_backend("train", TrainBackend)
+model_api.register_backend("inference", InferenceBackend)
+model_api.register_backend("null", NullBackend)

@@ -106,22 +106,22 @@ def generate_loop(
             stop |= tok == s
         return stop
 
-    def suppress_stops(logits, n_prev):
-        """Ban stop tokens from sampling until min_new_tokens are generated
-        (reference: genstep's min-length logit ban,
-        realhf/impl/model/nn/real_llm_generate.py:30)."""
+    def stop_ban_mask(n_prev):
+        """[B, V] True where stop tokens are banned from *sampling* (not from
+        the reported logprob) until min_new_tokens are generated (reference:
+        genstep's min-length logit ban, real_llm_generate.py:30)."""
         if min_new_tokens <= 0 or not stop_tokens:
-            return logits
+            return None
         allow = (n_prev + 1 >= min_new_tokens)[:, None]  # [B,1]
-        banned = jnp.zeros((logits.shape[-1],), bool)
+        banned = np.zeros((cfg.vocab_size,), bool)
         for s in stop_tokens:
-            banned = banned.at[s].set(True)
-        return jnp.where(~allow & banned[None, :], -jnp.inf, logits)
+            banned[s] = True
+        return ~allow & jnp.asarray(banned)[None, :]
 
     rng, sub = jax.random.split(rng)
     n_prev0 = jnp.zeros((B,), jnp.int32)
     first_tok, first_logp = sample_logits(
-        suppress_stops(last_logits, n_prev0), sub, sampling
+        last_logits, sub, sampling, ban_mask=stop_ban_mask(n_prev0)
     )
 
     out_tokens = jnp.zeros((B, max_new_tokens), jnp.int32)
@@ -157,9 +157,10 @@ def generate_loop(
         )
         rng, sub = jax.random.split(s.rng)
         tok, logp = sample_logits(
-            suppress_stops(logits.astype(jnp.float32), s.n_generated),
+            logits.astype(jnp.float32),
             sub,
             sampling,
+            ban_mask=stop_ban_mask(s.n_generated),
         )
         tok = jnp.where(s.active, tok, 0)
         n_gen = s.n_generated + s.active.astype(jnp.int32)

@@ -25,12 +25,14 @@ def sample_logits(
     logits: jax.Array,  # [B, V] float32
     rng: jax.Array,
     params: SamplingParams,
+    ban_mask: jax.Array = None,  # [B, V] or [V] bool: True = never sample
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (tokens [B], logprob-of-sampled-token [B]).
 
     The reported logprob is from the *post-temperature* distribution without
-    top-k/p filtering — matching what inference servers report and what PPO
-    treats as the behavioral logprob.
+    top-k/p filtering or bans — matching what inference servers report and
+    what PPO treats as the behavioral logprob (the trainer's recompute knows
+    nothing about sampling-time filters, so parity requires excluding them).
     """
     # Scale even in greedy mode: argmax is temperature-invariant but the
     # reported behavioral logprob must match the trainer's recompute, which
@@ -38,11 +40,14 @@ def sample_logits(
     if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-5)
     base_logprobs = jax.nn.log_softmax(logits, axis=-1)
+    sample_from = logits
+    if ban_mask is not None:
+        sample_from = jnp.where(ban_mask, -jnp.inf, sample_from)
 
     if params.greedy:
-        tokens = jnp.argmax(logits, axis=-1)
+        tokens = jnp.argmax(sample_from, axis=-1)
     else:
-        filtered = logits
+        filtered = sample_from
         V = logits.shape[-1]
         if params.top_k and params.top_k < V:
             kth = jnp.sort(filtered, axis=-1)[:, V - params.top_k][:, None]

@@ -1,0 +1,89 @@
+"""Shared experiment-building helpers
+(reference: realhf/experiments/common/common.py ``CommonExperimentConfig``
+:72 — allocation parsing, worker-config building, sanity checks)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from areal_tpu.api import system_api
+from areal_tpu.api.config import DatasetAbstraction, ModelAbstraction
+from areal_tpu.api.system_api import (
+    ExperimentConfig,
+    ExperimentSaveEvalControl,
+    MasterWorkerConfig,
+    ModelWorkerConfig,
+)
+from areal_tpu.base.topology import MeshSpec
+
+
+@dataclasses.dataclass
+class CommonExperimentConfig(system_api.Experiment):
+    """Base options shared by quickstart experiments."""
+
+    experiment_name: str = "test-exp"
+    trial_name: str = "test-trial"
+    seed: int = 1
+    # number of model-worker processes (hosts); each drives its local chips
+    n_model_workers: int = 1
+    mesh_spec: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    exp_ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    tokenizer_path: Optional[str] = None
+    # run on N virtual CPU devices instead of the accelerator (debug/CI mode,
+    # mirrors the reference's CPU test harness realhf/base/testing.py)
+    force_cpu_devices: Optional[int] = None
+
+    def apply_device_overrides(self):
+        if self.force_cpu_devices:
+            import jax
+
+            if (
+                jax.devices()[0].platform != "cpu"
+                or len(jax.devices()) < self.force_cpu_devices
+            ):
+                import jax.extend.backend as jeb
+
+                jeb.clear_backends()
+                jax.config.update("jax_platforms", "cpu")
+                jax.config.update(
+                    "jax_num_cpu_devices", self.force_cpu_devices
+                )
+
+    def model_worker_names(self) -> List[str]:
+        return [f"model_worker_{i}" for i in range(self.n_model_workers)]
+
+    def build_model_workers(
+        self,
+        shards: List[system_api.ModelShard],
+        interfaces: Dict,
+        datasets: List[DatasetAbstraction],
+    ) -> List[ModelWorkerConfig]:
+        names = self.model_worker_names()
+        return [
+            ModelWorkerConfig(
+                worker_name=name,
+                shards=shards,
+                interfaces=interfaces,
+                datasets=datasets,
+                tokenizer_path=self.tokenizer_path,
+                dataset_seed=self.seed,
+                dataset_shard=(i, len(names)),
+                seed=self.seed,
+            )
+            for i, name in enumerate(names)
+        ]
+
+    def make_config(self, rpcs, model_workers) -> ExperimentConfig:
+        return ExperimentConfig(
+            experiment_name=self.experiment_name,
+            trial_name=self.trial_name,
+            master=MasterWorkerConfig(
+                model_rpcs=rpcs,
+                exp_ctrl=self.exp_ctrl,
+                seed=self.seed,
+            ),
+            model_workers=model_workers,
+        ).lazy_init()

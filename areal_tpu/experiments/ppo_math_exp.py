@@ -1,0 +1,312 @@
+"""Sync PPO math experiment: the 7-node MFC graph with pruning options
+(reference: realhf/experiments/common/ppo_math_exp.py:29,120-341 —
+actor_gen -> {rew_inf, ref_inf, critic_inf, actor_inf} ->
+{actor_train, critic_train}; options prune nodes: disable_value drops the
+critic pair, kl_ctl=0 drops ref_inf, use_decoupled_loss adds actor_inf;
+EMA ref update via ParamReallocHook)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from areal_tpu.api import system_api
+from areal_tpu.api.config import (
+    DatasetAbstraction,
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+)
+from areal_tpu.api.data import MicroBatchSpec
+from areal_tpu.api.dfg import (
+    MFCDef,
+    ModelInterfaceType,
+    ParamReallocHook,
+)
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.api.system_api import ModelShard
+from areal_tpu.engine.optimizer import OptimizerConfig
+from areal_tpu.experiments.common import CommonExperimentConfig
+
+
+@dataclasses.dataclass
+class PPOHyperparameters:
+    """(reference: realhf/api/cli_args.py:597)"""
+
+    gen: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.2
+    c_clip: Optional[float] = None
+    value_eps_clip: float = 0.2
+    disable_value: bool = False
+    reward_output_scaling: float = 1.0
+    reward_output_bias: float = 0.0
+    max_reward_clip: float = 20.0
+    mask_no_eos_with_zero: bool = False
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    adv_norm: bool = True
+    group_adv_norm: bool = False
+    kl_ctl: float = 0.1
+    adaptive_kl_ctl: bool = False
+    use_decoupled_loss: bool = False
+    behav_imp_weight_cap: Optional[float] = None
+    recompute_logprob: bool = False
+    ref_ema_eta: Optional[float] = None  # EMA trainer->ref update
+
+
+@dataclasses.dataclass
+class PPOMathExperiment(CommonExperimentConfig):
+    actor: ModelAbstraction = None
+    critic: ModelAbstraction = None  # derived from actor if None
+    ref: ModelAbstraction = None  # derived from actor if None
+    dataset: DatasetAbstraction = None
+    ppo: PPOHyperparameters = dataclasses.field(
+        default_factory=PPOHyperparameters
+    )
+    group_size: int = 1
+    train_bs_n_seqs: int = 8
+    mb_spec: MicroBatchSpec = dataclasses.field(default_factory=MicroBatchSpec)
+    actor_optimizer: OptimizerConfig = dataclasses.field(
+        default_factory=lambda: OptimizerConfig(lr=1e-6)
+    )
+    critic_optimizer: OptimizerConfig = dataclasses.field(
+        default_factory=lambda: OptimizerConfig(lr=5e-6)
+    )
+
+    @property
+    def use_critic(self) -> bool:
+        return not self.ppo.disable_value
+
+    @property
+    def use_ref(self) -> bool:
+        return self.ppo.kl_ctl != 0.0
+
+    def initial_setup(self) -> system_api.ExperimentConfig:
+        ppo = self.ppo
+        actor = ModelName("actor")
+        critic = ModelName("critic")
+        ref = ModelName("ref")
+        reward = ModelName("reward")
+
+        actor_iface_args = dict(
+            n_minibatches=ppo.ppo_n_minibatches,
+            gconfig=ppo.gen,
+            kl_ctl=ppo.kl_ctl,
+            adaptive_kl_ctl=ppo.adaptive_kl_ctl,
+            eps_clip=ppo.eps_clip,
+            c_clip=ppo.c_clip,
+            discount=ppo.discount,
+            gae_lambda=ppo.gae_lambda,
+            max_reward_clip=ppo.max_reward_clip,
+            reward_scaling=ppo.reward_output_scaling,
+            reward_bias=ppo.reward_output_bias,
+            mask_no_eos_with_zero=ppo.mask_no_eos_with_zero,
+            adv_norm=ppo.adv_norm,
+            group_adv_norm=ppo.group_adv_norm,
+            group_size=self.group_size,
+            disable_value=ppo.disable_value,
+            temperature=ppo.gen.temperature,
+            use_decoupled_loss=ppo.use_decoupled_loss,
+            behav_imp_weight_cap=ppo.behav_imp_weight_cap,
+        )
+        actor_iface = ModelInterfaceAbstraction("ppo_actor", actor_iface_args)
+        ref_iface = ModelInterfaceAbstraction(
+            "ppo_actor",
+            {**actor_iface_args, "use_decoupled_loss": False},
+        )
+        prox_iface = ModelInterfaceAbstraction(
+            "ppo_actor",
+            {**actor_iface_args, "use_decoupled_loss": True},
+        )
+        critic_iface = ModelInterfaceAbstraction(
+            "ppo_critic",
+            dict(
+                n_minibatches=ppo.ppo_n_minibatches,
+                value_eps_clip=ppo.value_eps_clip,
+                kl_ctl=ppo.kl_ctl,
+                discount=ppo.discount,
+                gae_lambda=ppo.gae_lambda,
+                max_reward_clip=ppo.max_reward_clip,
+                mask_no_eos_with_zero=ppo.mask_no_eos_with_zero,
+            ),
+        )
+        rw_iface = ModelInterfaceAbstraction(
+            "rw_math", {"group_size": self.group_size}
+        )
+
+        n = self.train_bs_n_seqs
+        rpcs = []
+        interfaces = {}
+
+        actor_gen = MFCDef(
+            name="actor_gen",
+            model_name=actor,
+            interface_type=ModelInterfaceType.GENERATE,
+            interface_impl=actor_iface,
+            input_keys=("packed_prompts",),
+            output_keys=(
+                "packed_input_ids",
+                "packed_logprobs",
+                "prompt_mask",
+                "seq_no_eos_mask",
+            ),
+            n_seqs=n,
+        )
+        rpcs.append(actor_gen)
+        interfaces["actor_gen"] = actor_iface
+
+        rew_inf = MFCDef(
+            name="rew_inf",
+            model_name=reward,
+            interface_type=ModelInterfaceType.INFERENCE,
+            interface_impl=rw_iface,
+            input_keys=("packed_input_ids", "prompt_mask"),
+            output_keys=("rewards",),
+            n_seqs=n,
+        )
+        rpcs.append(rew_inf)
+        interfaces["rew_inf"] = rw_iface
+
+        train_input_keys = [
+            "packed_input_ids",
+            "packed_logprobs",
+            "prompt_mask",
+            "rewards",
+            "seq_no_eos_mask",
+        ]
+        if self.use_ref:
+            rpcs.append(
+                MFCDef(
+                    name="ref_inf",
+                    model_name=ref,
+                    interface_type=ModelInterfaceType.INFERENCE,
+                    interface_impl=ref_iface,
+                    input_keys=("packed_input_ids", "prompt_mask"),
+                    output_keys=("packed_ref_logprobs",),
+                    n_seqs=n,
+                )
+            )
+            interfaces["ref_inf"] = ref_iface
+            train_input_keys.append("packed_ref_logprobs")
+        if self.use_critic:
+            rpcs.append(
+                MFCDef(
+                    name="critic_inf",
+                    model_name=critic,
+                    interface_type=ModelInterfaceType.INFERENCE,
+                    interface_impl=critic_iface,
+                    input_keys=("packed_input_ids",),
+                    output_keys=("values",),
+                    n_seqs=n,
+                )
+            )
+            interfaces["critic_inf"] = critic_iface
+            train_input_keys.append("values")
+        if ppo.use_decoupled_loss or ppo.recompute_logprob:
+            rpcs.append(
+                MFCDef(
+                    name="actor_inf",
+                    model_name=actor,
+                    interface_type=ModelInterfaceType.INFERENCE,
+                    interface_impl=prox_iface,
+                    input_keys=("packed_input_ids", "prompt_mask"),
+                    output_keys=("prox_logp",),
+                    n_seqs=n,
+                )
+            )
+            interfaces["actor_inf"] = prox_iface
+            train_input_keys.append("prox_logp")
+
+        actor_post_hooks = []
+        if ppo.ref_ema_eta is not None and self.use_ref:
+            actor_post_hooks.append(
+                ParamReallocHook(target=ref, eta=ppo.ref_ema_eta)
+            )
+        actor_train = MFCDef(
+            name="actor_train",
+            model_name=actor,
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=actor_iface,
+            input_keys=tuple(train_input_keys),
+            n_seqs=n,
+            mb_spec=self.mb_spec,
+            log_return_value=True,
+            post_hooks=actor_post_hooks,
+        )
+        rpcs.append(actor_train)
+        interfaces["actor_train"] = actor_iface
+        if self.use_critic:
+            rpcs.append(
+                MFCDef(
+                    name="critic_train",
+                    model_name=critic,
+                    interface_type=ModelInterfaceType.TRAIN_STEP,
+                    interface_impl=critic_iface,
+                    input_keys=tuple(train_input_keys),
+                    n_seqs=n,
+                    mb_spec=self.mb_spec,
+                )
+            )
+            interfaces["critic_train"] = critic_iface
+
+        # -- model shards ---------------------------------------------------
+        def critic_model_from(actor_model: ModelAbstraction):
+            if actor_model.type_ == "hf":
+                return ModelAbstraction(
+                    "hf", {**actor_model.args, "is_critic": True}
+                )
+            args = dict(actor_model.args)
+            if "config" in args and hasattr(args["config"], "__dict__"):
+                args["config"] = dataclasses.replace(
+                    args["config"], is_critic=True, tied_embedding=False
+                )
+            else:
+                args["is_critic"] = True
+            return ModelAbstraction(actor_model.type_, args)
+
+        shards = [
+            ModelShard(
+                model_name=actor,
+                model=self.actor,
+                backend=ModelBackendAbstraction(
+                    "train", {"optimizer": self.actor_optimizer}
+                ),
+                mesh_spec=self.mesh_spec,
+            ),
+            ModelShard(
+                model_name=reward,
+                model=ModelAbstraction("null"),
+                backend=ModelBackendAbstraction("null"),
+                mesh_spec=self.mesh_spec,
+            ),
+        ]
+        if self.use_ref:
+            shards.append(
+                ModelShard(
+                    model_name=ref,
+                    model=self.ref or self.actor,
+                    backend=ModelBackendAbstraction("inference"),
+                    mesh_spec=self.mesh_spec,
+                )
+            )
+        if self.use_critic:
+            shards.append(
+                ModelShard(
+                    model_name=critic,
+                    model=self.critic or critic_model_from(self.actor),
+                    backend=ModelBackendAbstraction(
+                        "train", {"optimizer": self.critic_optimizer}
+                    ),
+                    mesh_spec=self.mesh_spec,
+                )
+            )
+
+        workers = self.build_model_workers(shards, interfaces, [self.dataset])
+        return self.make_config(rpcs, workers)
+
+
+system_api.register_experiment("ppo_math", PPOMathExperiment)

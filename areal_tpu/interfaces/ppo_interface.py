@@ -243,14 +243,14 @@ class PPOActorInterface(model_api.ModelInterface):
         engine = model.engine
         prep_stats = self._prepare_batch(data)
 
-        all_stats: Dict[str, float] = {}
         mbs, *_ = data.split(MicroBatchSpec(n_mbs=self.n_minibatches))
-        for mb in mbs:
-            stats = engine.train_batch(
+        all_stats = _aggregate_minibatch_stats(
+            engine.train_batch(
                 mb, self._loss_fn, mb_spec, token_key=self.token_key
             )
-            for k, v in stats.items():
-                all_stats[k] = all_stats.get(k, 0.0) + v / len(mbs)
+            for mb in mbs
+        )
+        all_stats["actor_clip_frac"] = all_stats.pop("clip_frac", 0.0)
         self.kl_controller.update(
             prep_stats["kl"], int(prep_stats["n_response_tokens"])
         )
@@ -303,6 +303,36 @@ class PPOActorInterface(model_api.ModelInterface):
         return generate_for_sample(model, data, self.gconfig)
 
 
+def _aggregate_minibatch_stats(stats_iter) -> Dict[str, float]:
+    """Sum-keys (``*_sum``, counts) add across minibatches; the rest are
+    token-weighted means.  Derives ``clip_frac``/``entropy``/``approx_kl``
+    from the accumulated sums so grad-accum micro-batching and minibatch
+    splits cannot skew the reported fractions."""
+    sums: Dict[str, float] = {}
+    weighted: Dict[str, float] = {}
+    total_tokens = 0.0
+    n = 0
+    for stats in stats_iter:
+        n += 1
+        toks = stats.get("n_tokens", 1.0)
+        total_tokens += toks
+        for k, v in stats.items():
+            if k.endswith("_sum") or k in ("n_tokens", "n_mbs"):
+                sums[k] = sums.get(k, 0.0) + v
+            else:
+                weighted[k] = weighted.get(k, 0.0) + v * toks
+    out = {k: v / max(total_tokens, 1e-8) for k, v in weighted.items()}
+    out.update(sums)
+    denom = max(total_tokens, 1e-8)
+    if "clip_count_sum" in out:
+        out["clip_frac"] = out.pop("clip_count_sum") / denom
+    if "entropy_sum" in out:
+        out["entropy"] = out["entropy_sum"] / denom
+    if "approx_kl_sum" in out:
+        out["approx_kl"] = out["approx_kl_sum"] / denom
+    return out
+
+
 def _actor_loss(params, cfg, batch, iface: PPOActorInterface):
     hidden = hidden_states(
         params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
@@ -330,8 +360,10 @@ def _actor_loss(params, cfg, batch, iface: PPOActorInterface):
     )
     count = jnp.maximum(jnp.sum(loss_mask), 1.0)
     mask_b = loss_mask.astype(bool)
+    # raw sums only: train_batch adds stats across grad-accum micro-batches
+    # and train_step across minibatches, so fractions are derived at the end
     stats = {
-        "actor_clip_frac": jnp.sum(stat["clip_mask"]) / count,
+        "clip_count_sum": jnp.sum(stat["clip_mask"]),
         "approx_kl_sum": jnp.sum(stat["approx_kl"]),
         "entropy_sum": jnp.sum(
             jnp.pad(entropy.reshape(B, T - 1), ((0, 0), (0, 1))) * loss_mask
@@ -394,14 +426,14 @@ class PPOCriticInterface(model_api.ModelInterface):
         engine = model.engine
         if "returns" not in data.keys:
             self._prep._prepare_batch(data)
-        all_stats: Dict[str, float] = {}
         mbs, *_ = data.split(MicroBatchSpec(n_mbs=self.n_minibatches))
-        for mb in mbs:
-            stats = engine.train_batch(
+        all_stats = _aggregate_minibatch_stats(
+            engine.train_batch(
                 mb, self._loss_fn, mb_spec, token_key=self.token_key
             )
-            for k, v in stats.items():
-                all_stats[k] = all_stats.get(k, 0.0) + v / len(mbs)
+            for mb in mbs
+        )
+        all_stats["value_clip_frac"] = all_stats.pop("clip_frac", 0.0)
         model.version.advance(
             model.ft_spec.steps_per_epoch if model.ft_spec else int(1e9)
         )
@@ -433,7 +465,7 @@ def _critic_loss(params, cfg, batch, iface: PPOCriticInterface):
         loss_fn_type=iface.value_loss_type,
     )
     count = jnp.maximum(jnp.sum(loss_mask), 1.0)
-    stats = {"value_clip_frac": jnp.sum(stat["clip_mask"]) / count}
+    stats = {"clip_count_sum": jnp.sum(stat["clip_mask"])}
     return loss * count, count, stats
 
 

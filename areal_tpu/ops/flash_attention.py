@@ -27,12 +27,10 @@ _BLOCK = 512
 
 
 def supported(q_len: int, kv_len: int, sliding_window) -> bool:
-    return (
-        sliding_window is None
-        and q_len == kv_len
-        and q_len >= 128
-        and q_len % 128 == 0
-    )
+    if sliding_window is not None or q_len != kv_len or q_len < 128:
+        return False
+    # the kernel requires seq_len divisible by the block size we pick
+    return q_len % min(_BLOCK, q_len) == 0
 
 
 def flash_attention(

@@ -1,0 +1,255 @@
+"""Master worker: walks the MFC graph, controls save/eval/recover.
+
+Rebuild of the reference's master (reference: realhf/system/master_worker.py
+— ``_configure`` :52, lazy init :251 building streams + initializing
+backends, ``__poll_async`` :381 running ``FunctionExecutor.execute_step``,
+save/eval/ckpt frequency control, recover save :585, benchmark early exit
+:455).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, Optional
+
+from areal_tpu.api import model_api, system_api
+from areal_tpu.api.dfg import ModelInterfaceType
+from areal_tpu.base import (
+    constants,
+    logging_,
+    recover,
+    seeding,
+    stats_tracker,
+    timeutil,
+)
+from areal_tpu.system import worker_base
+from areal_tpu.system.buffer import AsyncIOSequenceBuffer
+from areal_tpu.system.function_executor import (
+    FunctionExecutor,
+    ReplyRouter,
+    group_request,
+)
+from areal_tpu.system.request_reply_stream import MasterRequestReplyStream
+
+logger = logging_.getLogger("master_worker")
+
+
+class MasterWorker(worker_base.AsyncWorker):
+    def _configure(self, config: system_api.MasterWorkerConfig):
+        self.config = config
+        self.worker_name = config.worker_name
+        self.logger = logging_.getLogger(self.worker_name)
+        seeding.set_random_seed(config.seed, "master")
+
+        self._initialized = False
+        self._step_info = recover.StepInfo()
+        self._ft_spec: Optional[model_api.FinetuneSpec] = None
+        self._start_time = time.monotonic()
+
+        ctrl = config.exp_ctrl
+        self._save_ctl = timeutil.EpochStepTimeFreqCtl(
+            freq_epoch=ctrl.save_freq_epochs,
+            freq_step=ctrl.save_freq_steps,
+            freq_sec=ctrl.save_freq_secs,
+        )
+        self._ckpt_ctl = timeutil.EpochStepTimeFreqCtl(
+            freq_epoch=ctrl.ckpt_freq_epochs,
+            freq_step=ctrl.ckpt_freq_steps,
+            freq_sec=ctrl.ckpt_freq_secs,
+        )
+        self._eval_ctl = timeutil.EpochStepTimeFreqCtl(
+            freq_epoch=ctrl.eval_freq_epochs,
+            freq_step=ctrl.eval_freq_steps,
+            freq_sec=ctrl.eval_freq_secs,
+        )
+        self.stats: Dict[str, Any] = {}
+        self.stats_history = []
+
+    async def _lazy_init(self):
+        cfg = self.config
+        self._stream = MasterRequestReplyStream(
+            constants.experiment_name(), constants.trial_name()
+        )
+        self._stream.connect(cfg.model_worker_names)
+        self._router = ReplyRouter(self._stream)
+        self._router.start()
+
+        # dataset spec -> FinetuneSpec
+        data_workers = self._data_owner_workers()
+        specs = await group_request(
+            self._router, self._stream, data_workers, "spec"
+        )
+        dataset_size = sum(r.data["dataset_size"] for r in specs.values())
+        train_rpc = next(
+            r for r in cfg.model_rpcs if r.name == cfg.train_rpc_name
+        )
+        self._ft_spec = model_api.FinetuneSpec(
+            total_train_epochs=cfg.exp_ctrl.total_train_epochs,
+            dataset_size=max(dataset_size, train_rpc.n_seqs),
+            train_batch_size=train_rpc.n_seqs,
+        )
+
+        # initialize all model shards everywhere
+        await group_request(
+            self._router,
+            self._stream,
+            cfg.model_worker_names,
+            "initialize_all",
+            data={"ft_spec": self._ft_spec},
+        )
+
+        self._buffer = AsyncIOSequenceBuffer()
+        src_rpcs = [r for r in cfg.model_rpcs if r.is_src]
+        self._executor = FunctionExecutor(
+            rpcs=cfg.model_rpcs,
+            stream=self._stream,
+            router=self._router,
+            buffer=self._buffer,
+            model_groups=cfg.model_groups,
+            data_owner_workers=data_workers,
+            src_rpc_name=src_rpcs[0].name,
+            fetch_batch_size=max(
+                1, src_rpcs[0].n_seqs // max(1, len(data_workers))
+            ),
+        )
+
+        # recover?
+        info = recover.discover()
+        if info is not None:
+            self._step_info = info.recover_start
+            self._save_ctl.load_state_dict(info.save_ctl_states)
+            self._eval_ctl.load_state_dict(info.eval_ctl_states)
+            self._ckpt_ctl.load_state_dict(info.ckpt_ctl_states)
+            self.logger.info(
+                "recovered at step %s", self._step_info
+            )
+        self._initialized = True
+        self.logger.info(
+            "master initialized: dataset_size=%d steps/epoch=%d total=%d",
+            dataset_size,
+            self._ft_spec.steps_per_epoch,
+            self._ft_spec.total_train_steps,
+        )
+
+    def _data_owner_workers(self):
+        return [w for w in self.config.model_worker_names]
+
+    def _train_models(self):
+        return sorted(
+            {
+                str(r.model_name)
+                for r in self.config.model_rpcs
+                if r.interface_type == ModelInterfaceType.TRAIN_STEP
+            }
+        )
+
+    async def _save_models(self, tag: str):
+        import os
+
+        base = constants.get_save_path()
+        for mname in self._train_models():
+            path = os.path.join(
+                base,
+                mname,
+                f"epoch{self._step_info.epoch}"
+                f"epochstep{self._step_info.epoch_step}"
+                f"globalstep{self._step_info.global_step}",
+            )
+            workers = self.config.model_groups[mname]
+            await group_request(
+                self._router,
+                self._stream,
+                workers[:1],
+                "save",
+                data={"model_name": mname, "path": path},
+            )
+            self.logger.info("saved %s (%s) -> %s", mname, tag, path)
+
+    def _recover_save(self):
+        info = recover.RecoverInfo(
+            recover_start=self._step_info.next(self._ft_spec.steps_per_epoch),
+            last_step_info=self._step_info,
+            save_ctl_states=self._save_ctl.state_dict(),
+            eval_ctl_states=self._eval_ctl.state_dict(),
+            ckpt_ctl_states=self._ckpt_ctl.state_dict(),
+        )
+        recover.dump(info)
+
+    async def _poll_async(self) -> worker_base.PollResult:
+        if not self._initialized:
+            await self._lazy_init()
+
+        tik = time.monotonic()
+        stats = await self._executor.execute_step()
+        elapsed = time.monotonic() - tik
+
+        epochs_passed = 1 if self._executor.is_new_epoch else 0
+        self._step_info = self._step_info.next(self._ft_spec.steps_per_epoch)
+        step = self._step_info
+
+        stats["time_perf/e2e"] = elapsed
+        self.stats = stats
+        self.stats_history.append(stats)
+        tracked = stats_tracker.export()
+        self.logger.info(
+            "step %d (epoch %d, %.2fs): %s",
+            step.global_step,
+            step.epoch,
+            elapsed,
+            {k: round(v, 4) for k, v in stats.items() if isinstance(v, float)},
+        )
+        del tracked
+
+        if self._eval_ctl.check(epochs=epochs_passed, steps=1):
+            await self._run_eval()
+        if self._save_ctl.check(epochs=epochs_passed, steps=1):
+            await self._save_models("save")
+        if self._ckpt_ctl.check(epochs=epochs_passed, steps=1):
+            await self._save_models("ckpt")
+            self._recover_save()
+
+        bench = self.config.exp_ctrl.benchmark_steps
+        done = step.global_step >= self._ft_spec.total_train_steps or (
+            bench is not None and step.global_step >= bench
+        )
+        if done:
+            self.logger.info(
+                "training complete at step %d (%.1fs total)",
+                step.global_step,
+                time.monotonic() - self._start_time,
+            )
+            self.exit()
+        return worker_base.PollResult(batch_count=1)
+
+    async def _run_eval(self):
+        evals = [
+            r
+            for r in self.config.model_rpcs
+            if r.interface_type == ModelInterfaceType.EVALUATE
+        ]
+        for rpc in evals:
+            workers = self.config.model_groups[str(rpc.model_name)]
+            replies = await group_request(
+                self._router,
+                self._stream,
+                workers[:1],
+                "evaluate",
+                data={
+                    "rpc_name": rpc.name,
+                    "model_name": str(rpc.model_name),
+                    "handle_name": "evaluate",
+                    "ids": [],
+                    "input_keys": [],
+                    "mb_spec": rpc.mb_spec,
+                },
+            )
+            self.logger.info(
+                "eval %s -> %s", rpc.name, replies[workers[0]].data
+            )
+
+    def _exit_hook(self):
+        if hasattr(self, "_router"):
+            self._router.stop()
+        if hasattr(self, "_stream"):
+            self._stream.close()

@@ -1,0 +1,342 @@
+"""Worker lifecycle base classes.
+
+Rebuild of the reference's worker substrate (reference:
+realhf/system/worker_base.py — ``Worker`` :474 / ``AsyncWorker`` :710 with
+the ``_configure`` + ``_poll`` contract, ``WorkerServer`` command channel,
+heartbeat keys in name_resolve, run loop :658).
+
+Control transport is ZMQ REQ/REP with discovery via name_resolve; the same
+classes run as OS processes, threads (tests), or standalone hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import zmq
+
+from areal_tpu.base import constants, logging_, name_resolve, names, network
+
+logger = logging_.getLogger("worker_base")
+
+
+class WorkerServerStatus(str, enum.Enum):
+    IDLE = "IDLE"
+    CONFIGURING = "CONFIGURING"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    COMPLETED = "COMPLETED"
+    ERROR = "ERROR"
+    LOST = "LOST"
+
+
+@dataclasses.dataclass
+class PollResult:
+    sample_count: int = 0
+    batch_count: int = 0
+
+
+class WorkerException(Exception):
+    def __init__(self, worker_name, worker_status, scenario):
+        super().__init__(
+            f"Worker {worker_name} is {worker_status} while {scenario}"
+        )
+        self.worker_name = worker_name
+        self.worker_status = worker_status
+
+
+class WorkerServer:
+    """Per-worker ZMQ REP command socket; address registered in name_resolve
+    (reference: worker_base.py WorkerServer + worker_control.py)."""
+
+    def __init__(self, worker_name: str, experiment_name: str, trial_name: str):
+        self.worker_name = worker_name
+        self._handlers: Dict[str, Any] = {}
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.REP)
+        port = self._sock.bind_to_random_port("tcp://*")
+        addr = f"{network.gethostip()}:{port}"
+        name_resolve.add(
+            names.worker(experiment_name, trial_name, worker_name),
+            addr,
+            keepalive_ttl=None,
+            replace=True,
+        )
+        self._status = WorkerServerStatus.IDLE
+        self._status_key = names.worker_status(
+            experiment_name, trial_name, worker_name
+        )
+        name_resolve.add(self._status_key, self._status.value, replace=True)
+
+    def register_handler(self, command: str, fn):
+        self._handlers[command] = fn
+
+    def set_status(self, status: WorkerServerStatus):
+        self._status = status
+        name_resolve.add(self._status_key, status.value, replace=True)
+
+    @property
+    def status(self) -> WorkerServerStatus:
+        return self._status
+
+    def handle_requests(self, max_requests: int = 8):
+        """Non-blocking: serve up to ``max_requests`` queued commands."""
+        import pickle
+
+        for _ in range(max_requests):
+            try:
+                msg = self._sock.recv(flags=zmq.NOBLOCK)
+            except zmq.ZMQError:
+                return
+            try:
+                command, kwargs = pickle.loads(msg)
+                if command == "status":
+                    resp = ("ok", self._status.value)
+                elif command in self._handlers:
+                    resp = ("ok", self._handlers[command](**kwargs))
+                else:
+                    resp = ("error", f"unknown command {command}")
+            except Exception as e:  # noqa: BLE001 - report to controller
+                logger.exception("command %s failed", msg[:64])
+                resp = ("error", repr(e))
+            self._sock.send(pickle.dumps(resp))
+
+    def close(self):
+        self._sock.close(linger=0)
+
+
+class WorkerControlPanel:
+    """Controller-side: REQ sockets to every worker's server
+    (reference: worker_base.py ``WorkerControlPanel`` :218)."""
+
+    def __init__(self, experiment_name: str, trial_name: str):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self._ctx = zmq.Context.instance()
+        self._socks: Dict[str, zmq.Socket] = {}
+
+    def connect(self, worker_names: List[str], timeout: float = 60.0):
+        deadline = time.monotonic() + timeout
+        for wname in worker_names:
+            addr = name_resolve.wait(
+                names.worker(self.experiment_name, self.trial_name, wname),
+                timeout=max(0.1, deadline - time.monotonic()),
+            )
+            sock = self._ctx.socket(zmq.REQ)
+            sock.connect(f"tcp://{addr}")
+            self._socks[wname] = sock
+
+    @property
+    def worker_names(self) -> List[str]:
+        return list(self._socks)
+
+    def request(
+        self, worker_name: str, command: str, timeout: float = 300.0, **kwargs
+    ):
+        import pickle
+
+        sock = self._socks[worker_name]
+        sock.send(pickle.dumps((command, kwargs)))
+        if not sock.poll(timeout=int(timeout * 1000)):
+            raise TimeoutError(
+                f"worker {worker_name} did not reply to {command}"
+            )
+        status, payload = pickle.loads(sock.recv())
+        if status != "ok":
+            raise WorkerException(worker_name, payload, f"requesting {command}")
+        return payload
+
+    def group_request(self, command: str, timeout: float = 300.0, **kwargs):
+        return {
+            w: self.request(w, command, timeout=timeout, **kwargs)
+            for w in self.worker_names
+        }
+
+    def get_worker_status(self, worker_name: str) -> WorkerServerStatus:
+        try:
+            val = name_resolve.get(
+                names.worker_status(
+                    self.experiment_name, self.trial_name, worker_name
+                )
+            )
+            return WorkerServerStatus(val)
+        except name_resolve.NameEntryNotFoundError:
+            return WorkerServerStatus.LOST
+
+    def close(self):
+        for s in self._socks.values():
+            s.close(linger=0)
+
+
+class Worker:
+    """Synchronous worker: subclass implements ``_configure`` and ``_poll``.
+
+    ``run()`` drives the lifecycle: wait for configure, then poll until an
+    exit condition (reference: worker_base.py:658)."""
+
+    def __init__(self, server: Optional[WorkerServer] = None):
+        self._server = server
+        self._configured = False
+        self.__running = False
+        self.__exiting = False
+        self._exit_status: Optional[WorkerServerStatus] = None
+        self.worker_name = server.worker_name if server else "worker"
+        self.logger = logging_.getLogger(self.worker_name)
+        self._config_queue: "queue.Queue" = queue.Queue()
+        if server is not None:
+            server.register_handler("configure", self._on_configure_cmd)
+            server.register_handler("start", self._on_start)
+            server.register_handler("pause", self._on_pause)
+            server.register_handler("exit", self._on_exit)
+            server.register_handler("ping", lambda: "pong")
+
+    # -- command handlers ---------------------------------------------------
+
+    def _on_configure_cmd(self, config=None):
+        self._config_queue.put(config)
+        return "configured"
+
+    def _on_start(self):
+        self.__running = True
+        if self._server:
+            self._server.set_status(WorkerServerStatus.RUNNING)
+        return "started"
+
+    def _on_pause(self):
+        self.__running = False
+        if self._server:
+            self._server.set_status(WorkerServerStatus.PAUSED)
+        return "paused"
+
+    def _on_exit(self):
+        self.__exiting = True
+        return "exiting"
+
+    # -- subclass contract --------------------------------------------------
+
+    def _configure(self, config) -> None:
+        raise NotImplementedError()
+
+    def _poll(self) -> PollResult:
+        raise NotImplementedError()
+
+    def _exit_hook(self):
+        pass
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def configure(self, config):
+        if self._server:
+            self._server.set_status(WorkerServerStatus.CONFIGURING)
+        self._configure(config)
+        self._configured = True
+        if self._server:
+            self._server.set_status(WorkerServerStatus.IDLE)
+        self.logger.debug("%s configured", self.worker_name)
+
+    def exit(self, status: WorkerServerStatus = WorkerServerStatus.COMPLETED):
+        self.__exiting = True
+        self._exit_status = status
+
+    def run(self, config=None) -> WorkerServerStatus:
+        if config is not None:
+            self.configure(config)
+            self.__running = True
+        try:
+            while not self.__exiting:
+                if self._server:
+                    self._server.handle_requests()
+                if not self._configured:
+                    try:
+                        cfg = self._config_queue.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    self.configure(cfg)
+                    continue
+                if not self.__running:
+                    time.sleep(0.02)
+                    continue
+                r = self._poll()
+                if r.sample_count == r.batch_count == 0:
+                    time.sleep(0.002)
+            status = self._exit_status or WorkerServerStatus.COMPLETED
+            if self._server:
+                self._server.set_status(status)
+            self._exit_hook()
+            return status
+        except Exception:
+            logger.exception("worker %s failed", self.worker_name)
+            if self._server:
+                self._server.set_status(WorkerServerStatus.ERROR)
+            self._exit_hook()
+            raise
+        finally:
+            if self._server:
+                self._server.close()
+
+
+class AsyncWorker(Worker):
+    """Worker whose poll is a coroutine (reference: worker_base.py:710)."""
+
+    async def _poll_async(self) -> PollResult:
+        raise NotImplementedError()
+
+    def _poll(self) -> PollResult:  # pragma: no cover - sync fallback
+        raise RuntimeError("AsyncWorker must be run with run_async()")
+
+    def run_async(self, config=None) -> WorkerServerStatus:
+        import asyncio
+
+        async def _main():
+            if config is not None:
+                self.configure(config)
+                self._Worker__running = True  # noqa: SLF001
+            while not self._Worker__exiting:  # noqa: SLF001
+                if self._server:
+                    self._server.handle_requests()
+                if not self._configured:
+                    try:
+                        cfg = self._config_queue.get_nowait()
+                        self.configure(cfg)
+                    except queue.Empty:
+                        await asyncio.sleep(0.05)
+                    continue
+                if not self._Worker__running:  # noqa: SLF001
+                    await asyncio.sleep(0.02)
+                    continue
+                r = await self._poll_async()
+                if r.sample_count == r.batch_count == 0:
+                    await asyncio.sleep(0.002)
+            status = self._exit_status or WorkerServerStatus.COMPLETED
+            if self._server:
+                self._server.set_status(status)
+            self._exit_hook()
+            return status
+
+        try:
+            return asyncio.run(_main())
+        except Exception:
+            logger.exception("worker %s failed", self.worker_name)
+            if self._server:
+                self._server.set_status(WorkerServerStatus.ERROR)
+            raise
+        finally:
+            if self._server:
+                self._server.close()
+
+
+def make_server(
+    worker_name: str,
+    experiment_name: Optional[str] = None,
+    trial_name: Optional[str] = None,
+) -> WorkerServer:
+    return WorkerServer(
+        worker_name,
+        experiment_name or constants.experiment_name(),
+        trial_name or constants.trial_name(),
+    )

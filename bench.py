@@ -27,6 +27,7 @@ PEAK_TFLOPS = {
     "v5 lite": 197,
     "v5p": 459,
     "v6e": 918,
+    "v6 lite": 918,
     "trillium": 918,
     "cpu": 0.2,  # nominal, so the script degrades gracefully off-TPU
 }

@@ -1,0 +1,90 @@
+"""End-to-end sync PPO experiment on the threaded local runner
+(mirrors the reference's CPU e2e test tests/experiments/test_math_ppo.py)."""
+
+import numpy as np
+import pytest
+
+from tests.fixtures import dataset, dataset_path, save_path, tokenizer  # noqa: F401
+
+
+@pytest.fixture
+def tokenizer_path(tokenizer, save_path):
+    p = str(save_path / "tokenizer")
+    tokenizer.save_pretrained(p)
+    return p
+
+
+def _make_exp(dataset_path, tokenizer_path, **ppo_kwargs):
+    from areal_tpu.api.config import DatasetAbstraction, ModelAbstraction
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.api.system_api import ExperimentSaveEvalControl
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.experiments.ppo_math_exp import (
+        PPOHyperparameters,
+        PPOMathExperiment,
+    )
+
+    gen = GenerationHyperparameters(
+        max_new_tokens=16, min_new_tokens=2, temperature=1.0
+    )
+    return PPOMathExperiment(
+        experiment_name="test-ppo",
+        trial_name="e2e",
+        n_model_workers=1,
+        mesh_spec=MeshSpec(data=2, model=2),
+        exp_ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=1, benchmark_steps=2
+        ),
+        tokenizer_path=tokenizer_path,
+        actor=ModelAbstraction(
+            "random", {"vocab_size": 256, "max_position_embeddings": 512}
+        ),
+        dataset=DatasetAbstraction(
+            "math_code_prompt",
+            {"dataset_path": dataset_path, "max_length": 64},
+        ),
+        train_bs_n_seqs=4,
+        actor_optimizer=OptimizerConfig(lr=1e-4),
+        critic_optimizer=OptimizerConfig(lr=1e-4),
+        ppo=PPOHyperparameters(gen=gen, ppo_n_minibatches=2, **ppo_kwargs),
+    )
+
+
+def _run(exp, tmp_path, monkeypatch):
+    monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
+    monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
+    from areal_tpu.apps.local_runner import run_experiment_local
+
+    cfg = exp.initial_setup()
+    return run_experiment_local(cfg, timeout=600)
+
+
+def test_sync_ppo_full_graph(dataset_path, tokenizer_path, tmp_path, monkeypatch):
+    """Full 7-node graph: gen -> rew/ref/critic inf -> actor/critic train."""
+    exp = _make_exp(dataset_path, tokenizer_path, kl_ctl=0.1)
+    master = _run(exp, tmp_path, monkeypatch)
+    assert len(master.stats_history) >= 2
+    s = master.stats_history[-1]
+    assert np.isfinite(s["actor_train/loss"])
+    assert np.isfinite(s["critic_train/loss"])
+    assert "actor_train/kl" in s
+    assert "rew_inf/elapsed" not in s  # stats come from worker stats dicts
+
+
+def test_sync_ppo_grpo_style(dataset_path, tokenizer_path, tmp_path, monkeypatch):
+    """disable_value + kl_ctl=0 prunes critic and ref (GRPO-style graph)."""
+    exp = _make_exp(
+        dataset_path,
+        tokenizer_path,
+        kl_ctl=0.0,
+        disable_value=True,
+        use_decoupled_loss=True,
+    )
+    cfg = exp.initial_setup()
+    names = [r.name for r in cfg.master.model_rpcs]
+    assert "critic_train" not in names and "ref_inf" not in names
+    assert "actor_inf" in names
+    master = _run(exp, tmp_path, monkeypatch)
+    s = master.stats_history[-1]
+    assert np.isfinite(s["actor_train/loss"])
