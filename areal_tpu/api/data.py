@@ -90,6 +90,8 @@ _SCALAR_KEYS = frozenset(
         "base_scores",
         "task_ids",
         "version",
+        "version_start",
+        "version_end",
         "birth_time",
     ]
 )
@@ -119,8 +121,6 @@ _SHIFTED_KEYS = frozenset(
         "ppo_loss_mask",
         "kl_rewards",
         "returns",
-        "version_start",
-        "version_end",
     ]
 )
 

@@ -72,6 +72,7 @@ class ModelWorkerConfig:
     # which DP shard of the dataset this worker loads (dp_rank, dp_size)
     dataset_shard: Tuple[int, int] = (0, 1)
     use_stream_dataset: bool = False  # async mode: data arrives by push
+    stream_group_size: int = 1  # trajectories per prompt (epoch accounting)
     seed: int = 1
 
 
@@ -107,6 +108,7 @@ class RolloutWorkerConfig:
     dataset_shard: Tuple[int, int] = (0, 1)
     dataset_seed: int = 1
     rollout_request_timeout: float = 600.0
+    new_tokens_per_chunk: int = 1 << 30  # interruptible-generation chunking
 
 
 @dataclasses.dataclass
@@ -117,6 +119,10 @@ class GenServerConfig:
     tokenizer_path: Optional[str] = None
     max_concurrent_batch: int = 64
     kv_cache_len: int = 32768
+    temperature: float = 1.0
+    # which local device hosts this server's engine (trainer/generation
+    # device split on one host; None = default device)
+    device_idx: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -125,7 +131,8 @@ class GserverManagerConfig:
     n_servers: int = 1
     schedule_policy: str = "round_robin"
     max_head_offpolicyness: int = 0
-    train_batch_size: int = 1
+    train_batch_size: int = 1  # in sequences (train_bs_n_seqs)
+    group_size: int = 1  # sequences per rollout (staleness unit conversion)
     max_concurrent_rollouts: Optional[int] = None
     flush_request_timeout: float = 120.0
 

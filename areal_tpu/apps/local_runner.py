@@ -30,10 +30,17 @@ def register_impls():
     import areal_tpu.data.prompt_answer_dataset  # noqa: F401
     import areal_tpu.data.prompt_dataset  # noqa: F401
     import areal_tpu.data.rw_paired_dataset  # noqa: F401
+    import areal_tpu.agents.math_single_step_agent  # noqa: F401
     import areal_tpu.engine.backend  # noqa: F401
+    import areal_tpu.envs.math_code_single_step_env  # noqa: F401
     import areal_tpu.interfaces.ppo_interface  # noqa: F401
     import areal_tpu.interfaces.rw_interface  # noqa: F401
     import areal_tpu.interfaces.sft_interface  # noqa: F401
+
+    # pre-resolve transformers' lazy attributes in the main thread: its lazy
+    # module loader is not thread-safe, and worker threads load tokenizers
+    # concurrently at configure time
+    from transformers import AutoConfig, AutoTokenizer  # noqa: F401
 
 
 def run_experiment_local(

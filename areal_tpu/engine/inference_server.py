@@ -1,0 +1,441 @@
+"""Continuous-batching TPU inference engine with interruptible weight update.
+
+This is the TPU-native replacement for the reference's patched SGLang server
+(reference: realhf/impl/model/backend/sglang.py + patch/sglang/
+v0.4.6.post2.patch — the ``interrupt_all_requests`` + ``allow_interrupt``
+weight-update mechanism, and realhf/impl/model/nn/real_llm_generate.py:670
+``InflightBatchingGenerator``).
+
+Design:
+* One shared KV cache of ``max_batch`` independent rows (the model's
+  ``KVCache`` rows advance independently, so admission is a per-row prefill
+  scatter and decoding is one jitted multi-token chunk over all rows).
+* The host loop alternates: admit pending requests into free rows ->
+  run a ``decode_chunk`` (``chunk_size`` tokens fully device-side) ->
+  harvest finished rows.  Host<->device sync happens once per chunk, the
+  XLA analogue of the reference's CUDA-graphed decode.
+* ``update_weights(params)`` interrupts between chunks: the current chunk
+  finishes, weights swap, and every in-flight row's KV is recomputed by
+  re-prefilling its tokens under the new weights (the patch's
+  pause -> load -> resume semantics).  ``version_start``/``version_end``
+  record the weight versions a request sampled under (decoupled PPO's
+  staleness bookkeeping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api import model_api
+from areal_tpu.base import logging_
+from areal_tpu.engine.batching import bucket_len
+from areal_tpu.engine.sampling import SamplingParams, sample_logits
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.transformer import KVCache, decode_step, prefill
+
+logger = logging_.getLogger("inference_server")
+
+
+class _nullctx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+@dataclasses.dataclass
+class _Row:
+    """Host-side state of one in-flight request."""
+
+    req: model_api.APIGenerateInput
+    prompt: List[int]
+    generated: List[int]
+    logprobs: List[float]
+    version_start: int
+    no_eos: bool = False
+    cur_token: int = -1  # pending token (KV not yet in cache)
+
+
+@partial(jax.jit, static_argnames=("cfg", "sampling"))
+def _admit_row(
+    params,
+    cfg: TransformerConfig,
+    cache: KVCache,
+    tokens: jax.Array,  # [1, T] right-padded prompt
+    length: jax.Array,  # scalar
+    row: jax.Array,  # scalar
+    rng: jax.Array,
+    sampling: SamplingParams,
+) -> Tuple[KVCache, jax.Array, jax.Array]:
+    """Prefill one prompt into cache row ``row``; sample the first token."""
+    S = cache.k.shape[2]
+    T = tokens.shape[1]
+    mini = KVCache.zeros(cfg, 1, S, dtype=cache.k.dtype)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    seg = (positions < length).astype(jnp.int32)
+    logits, mini = prefill(params, cfg, tokens, positions, seg, mini)
+    k = jax.lax.dynamic_update_slice(
+        cache.k, mini.k, (0, row, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        cache.v, mini.v, (0, row, 0, 0, 0)
+    )
+    lengths = cache.lengths.at[row].set(length)
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(length - 1, 0)[None, None, None], axis=1
+    )[0, 0]
+    tok, logp = sample_logits(
+        last[None, :].astype(jnp.float32), rng, sampling
+    )
+    return KVCache(k=k, v=v, lengths=lengths), tok[0], logp[0]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "chunk_size", "stop_tokens", "sampling"),
+)
+def _decode_chunk(
+    params,
+    cfg: TransformerConfig,
+    cache: KVCache,
+    cur_tokens: jax.Array,  # [B]
+    active: jax.Array,  # [B] bool
+    budgets: jax.Array,  # [B] remaining new tokens (incl. pending cur)
+    rng: jax.Array,
+    chunk_size: int,
+    stop_tokens: Tuple[int, ...],
+    sampling: SamplingParams,
+):
+    """Generate up to ``chunk_size`` tokens for all active rows device-side.
+
+    Returns (cache, out_tokens [B,K], out_logps [B,K], emitted [B,K] bool,
+    cur_tokens, active, budgets, rng).
+    """
+    B = cur_tokens.shape[0]
+    S = cache.k.shape[2]
+
+    def is_stop(tok):
+        stop = jnp.zeros_like(tok, dtype=bool)
+        for s in stop_tokens:
+            stop |= tok == s
+        return stop
+
+    def body(i, state):
+        cache, cur, active, budgets, out_t, out_l, emitted, rng = state
+        logits, new_cache = decode_step(params, cfg, cur, cache, active=active)
+        rng, sub = jax.random.split(rng)
+        tok, logp = sample_logits(
+            logits.astype(jnp.float32), sub, sampling
+        )
+        tok = jnp.where(active, tok, 0)
+        out_t = out_t.at[:, i].set(tok)
+        out_l = out_l.at[:, i].set(jnp.where(active, logp, 0.0))
+        emitted = emitted.at[:, i].set(active)
+        budgets = budgets - active.astype(jnp.int32)
+        active = active & ~is_stop(tok) & (budgets > 0)
+        active &= new_cache.lengths < S
+        return (new_cache, tok, active, budgets, out_t, out_l, emitted, rng)
+
+    out_t = jnp.zeros((B, chunk_size), jnp.int32)
+    out_l = jnp.zeros((B, chunk_size), jnp.float32)
+    emitted = jnp.zeros((B, chunk_size), bool)
+    state = (cache, cur_tokens, active, budgets, out_t, out_l, emitted, rng)
+    cache, cur, active, budgets, out_t, out_l, emitted, rng = jax.lax.fori_loop(
+        0, chunk_size, body, state
+    )
+    return cache, out_t, out_l, emitted, cur, active, budgets, rng
+
+
+class ContinuousBatchingEngine:
+    """Thread-safe continuous-batching generation over one model mesh."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params,
+        tokenizer=None,
+        max_batch: int = 8,
+        kv_cache_len: int = 4096,
+        chunk_size: int = 16,
+        sampling: Optional[SamplingParams] = None,
+        stop_tokens: Sequence[int] = (),
+        seed: int = 0,
+        device=None,
+    ):
+        self.cfg = cfg
+        self.device = device
+        if device is not None:
+            params = jax.device_put(params, device)
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_batch = max_batch
+        self.kv_cache_len = kv_cache_len
+        self.chunk_size = chunk_size
+        self.sampling = sampling or SamplingParams()
+        stop = set(stop_tokens)
+        if tokenizer is not None and tokenizer.eos_token_id is not None:
+            stop.add(int(tokenizer.eos_token_id))
+        self.stop_tokens = tuple(sorted(stop))
+        self.version = 0
+
+        with jax.default_device(device) if device is not None else _nullctx():
+            self.cache = KVCache.zeros(cfg, max_batch, kv_cache_len)
+            self.cur_tokens = jnp.zeros((max_batch,), jnp.int32)
+            self.active = jnp.zeros((max_batch,), bool)
+            self.budgets = jnp.zeros((max_batch,), jnp.int32)
+            self.rng = jax.random.PRNGKey(seed)
+
+        self.rows: List[Optional[_Row]] = [None] * max_batch
+        self._pending: List[model_api.APIGenerateInput] = []
+        self._results: Dict[str, model_api.APIGenerateOutput] = {}
+        self._result_events: Dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._new_params = None
+        self._paused = threading.Event()
+        self.gen_tokens_total = 0
+
+    # -- client API (any thread) -------------------------------------------
+
+    def submit(self, req: model_api.APIGenerateInput) -> str:
+        with self._lock:
+            self._pending.append(req)
+            ev = threading.Event()
+            self._result_events[req.qid] = ev
+        return req.qid
+
+    def wait_result(
+        self, qid: str, timeout: float = 600.0
+    ) -> model_api.APIGenerateOutput:
+        ev = self._result_events.get(qid)
+        assert ev is not None, f"unknown qid {qid}"
+        if not ev.wait(timeout):
+            raise TimeoutError(f"generation {qid} timed out")
+        with self._lock:
+            self._result_events.pop(qid, None)
+            return self._results.pop(qid)
+
+    def try_get_result(self, qid: str) -> Optional[model_api.APIGenerateOutput]:
+        """Non-blocking result fetch (server loop polls this)."""
+        with self._lock:
+            if qid in self._results:
+                self._result_events.pop(qid, None)
+                return self._results.pop(qid)
+        return None
+
+    def update_weights(self, params, version: Optional[int] = None) -> int:
+        """Swap weights between chunks; in-flight rows' KV is recomputed under
+        the new weights on the next loop iteration.  Returns the number of
+        interrupted (in-flight) requests — the patch's return contract."""
+        with self._lock:
+            self._new_params = params
+            n_inflight = sum(r is not None for r in self.rows)
+            if version is not None:
+                self._target_version = version
+        return n_inflight
+
+    def pause(self):
+        self._paused.set()
+
+    def resume(self):
+        self._paused.clear()
+
+    @property
+    def n_inflight(self) -> int:
+        return sum(r is not None for r in self.rows)
+
+    @property
+    def n_pending(self) -> int:
+        return len(self._pending)
+
+    @property
+    def has_work(self) -> bool:
+        return self.n_pending > 0 or bool(np.any(np.asarray(self.active)))
+
+    # -- engine loop (owner thread) ----------------------------------------
+
+    def _apply_pending_weights(self):
+        with self._lock:
+            new_params = self._new_params
+            self._new_params = None
+        if new_params is None:
+            return
+        if self.device is not None:
+            new_params = jax.device_put(new_params, self.device)
+        self.params = new_params
+        self.version = getattr(self, "_target_version", self.version + 1)
+        # recompute in-flight KV under the new weights (pause -> reload ->
+        # resume; reference patch interrupts and re-prefills continuations)
+        for row_id, row in enumerate(self.rows):
+            if row is None:
+                continue
+            # the pending cur_token (last generated) must stay OUT of the
+            # cache — the next decode_step writes its KV; re-prefill the rest
+            seq = (row.prompt + row.generated)[:-1]
+            self._prefill_into_row(row_id, seq, row.cur_token)
+        logger.info(
+            "weights updated to v%d (%d in-flight recomputed)",
+            self.version,
+            self.n_inflight,
+        )
+
+    def _prefill_into_row(self, row_id: int, seq: List[int], cur_token: int):
+        T = bucket_len(max(len(seq), 1))
+        toks = np.zeros((1, T), np.int32)
+        toks[0, : len(seq)] = seq
+        self.rng, sub = jax.random.split(self.rng)
+        cache, tok, logp = _admit_row(
+            self.params,
+            self.cfg,
+            self.cache,
+            jnp.asarray(toks),
+            jnp.asarray(len(seq), jnp.int32),
+            jnp.asarray(row_id, jnp.int32),
+            sub,
+            self.sampling,
+        )
+        self.cache = cache
+        # keep the already-sampled pending token, discard the resample
+        self.cur_tokens = self.cur_tokens.at[row_id].set(cur_token)
+
+    def _admit(self):
+        free = [i for i, r in enumerate(self.rows) if r is None]
+        while free:
+            with self._lock:
+                if not self._pending:
+                    break
+                req = self._pending.pop(0)
+            row_id = free.pop(0)
+            # input_ids = prompt + previously generated tokens (chunked
+            # continuation); falls back to the bare prompt
+            prompt = list(req.input_ids or req.prompt_ids)
+            if len(prompt) + 1 >= self.kv_cache_len:
+                # context exhausted: finish immediately with no output so the
+                # chunked-rollout client stops resubmitting continuations
+                row = _Row(
+                    req=req,
+                    prompt=prompt,
+                    generated=[],
+                    logprobs=[],
+                    version_start=self.version,
+                    no_eos=True,
+                )
+                free.insert(0, row_id)
+                self._finish(row_id, row, started=False)
+                continue
+            max_new = req.gconfig.max_new_tokens
+            if len(prompt) + max_new > self.kv_cache_len:
+                max_new = max(1, self.kv_cache_len - len(prompt))
+            T = bucket_len(len(prompt))
+            toks = np.zeros((1, T), np.int32)
+            toks[0, : len(prompt)] = prompt
+            self.rng, sub = jax.random.split(self.rng)
+            cache, tok, logp = _admit_row(
+                self.params,
+                self.cfg,
+                self.cache,
+                jnp.asarray(toks),
+                jnp.asarray(len(prompt), jnp.int32),
+                jnp.asarray(row_id, jnp.int32),
+                sub,
+                self.sampling,
+            )
+            self.cache = cache
+            tok_i = int(tok)
+            row = _Row(
+                req=req,
+                prompt=prompt,
+                generated=[tok_i],
+                logprobs=[float(logp)],
+                version_start=self.version,
+            )
+            if tok_i in self.stop_tokens or max_new <= 1:
+                row.no_eos = tok_i not in self.stop_tokens
+                self._finish(row_id, row, started=False)
+                continue
+            row.cur_token = tok_i
+            self.rows[row_id] = row
+            self.cur_tokens = self.cur_tokens.at[row_id].set(tok_i)
+            self.active = self.active.at[row_id].set(True)
+            self.budgets = self.budgets.at[row_id].set(max_new - 1)
+
+    def _finish(self, row_id: int, row: _Row, started: bool = True):
+        out = model_api.APIGenerateOutput.from_input(row.req)
+        out.output_ids = row.generated
+        out.output_logprobs = row.logprobs
+        out.no_eos = row.no_eos
+        out.version_start = row.version_start
+        out.version_end = self.version
+        self.gen_tokens_total += len(row.generated)
+        if started:
+            self.rows[row_id] = None
+            self.active = self.active.at[row_id].set(False)
+        with self._lock:
+            self._results[row.req.qid] = out
+            ev = self._result_events.get(row.req.qid)
+        if ev:
+            ev.set()
+
+    def step(self) -> int:
+        """One engine iteration: weight swap (if requested), admit, one decode
+        chunk, harvest.  Returns number of tokens emitted this step."""
+        if self._paused.is_set():
+            time.sleep(0.01)
+            return 0
+        self._apply_pending_weights()
+        self._admit()
+        if not bool(np.any(np.asarray(self.active))):
+            return 0
+        self.rng, sub = jax.random.split(self.rng)
+        (
+            self.cache,
+            out_t,
+            out_l,
+            emitted,
+            self.cur_tokens,
+            self.active,
+            self.budgets,
+            self.rng,
+        ) = _decode_chunk(
+            self.params,
+            self.cfg,
+            self.cache,
+            self.cur_tokens,
+            self.active,
+            self.budgets,
+            sub,
+            self.chunk_size,
+            self.stop_tokens,
+            self.sampling,
+        )
+        out_t = np.asarray(out_t)
+        out_l = np.asarray(out_l)
+        emitted = np.asarray(emitted)
+        active = np.asarray(self.active)
+        cur = np.asarray(self.cur_tokens)
+        n_tokens = 0
+        for row_id, row in enumerate(self.rows):
+            if row is None:
+                continue
+            cols = emitted[row_id]
+            toks = out_t[row_id][cols].tolist()
+            lps = out_l[row_id][cols].tolist()
+            row.generated.extend(toks)
+            row.logprobs.extend(lps)
+            n_tokens += len(toks)
+            if not active[row_id]:
+                last = row.generated[-1] if row.generated else -1
+                row.no_eos = last not in self.stop_tokens
+                self._finish(row_id, row)
+            else:
+                row.cur_token = int(cur[row_id])
+        return n_tokens

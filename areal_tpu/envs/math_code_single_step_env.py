@@ -1,0 +1,46 @@
+"""Single-step math/code verification environment
+(reference: realhf/impl/environment/math_code_single_step_env.py:42 — an
+async env whose step() scores generated answers via the math/code verifier,
+local fallback here; the functioncall HTTP service plugs in transparently).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Tuple
+
+from areal_tpu.api import dataset_api, env_api
+from areal_tpu.base import logging_
+from areal_tpu.data.math_parser import parse_lines_in_parallel
+
+logger = logging_.getLogger("math_env")
+
+
+class MathCodeSingleStepEnv(env_api.EnvironmentService):
+    def __init__(self, tokenizer_path: str = None, dataset_path: str = None):
+        self._tokenizer = (
+            dataset_api.load_hf_tokenizer(tokenizer_path)
+            if tokenizer_path
+            else None
+        )
+
+    async def reset(self, seed=None, options=None):
+        return None, {}
+
+    async def step(self, action) -> Tuple[None, List[float], bool, bool, dict]:
+        """action = (qid, seqs [list of token lists], solutions, prompt_len).
+        Returns (obs, per-answer rewards, terminated, truncated, info)."""
+        qid, seqs, solutions, prompt_len = action
+        assert self._tokenizer is not None, "math env needs a tokenizer"
+        texts = await asyncio.to_thread(
+            self._tokenizer.batch_decode,
+            [s[prompt_len:] for s in seqs],
+            skip_special_tokens=True,
+        )
+        rewards = await asyncio.to_thread(
+            parse_lines_in_parallel, texts, [solutions] * len(texts)
+        )
+        return None, rewards, True, False, {}
+
+
+env_api.register_environment("math-code-single-step", MathCodeSingleStepEnv)

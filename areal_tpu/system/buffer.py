@@ -57,8 +57,11 @@ class AsyncIOSequenceBuffer:
                     if len(self._slots) >= self.max_size:
                         raise RuntimeError("buffer full")
                     idx = next(self._next_idx)
-                    birth = one.metadata.get("birth_time", [time.monotonic()])[0] \
-                        if one.metadata and "birth_time" in one.metadata else time.monotonic()
+                    birth = (
+                        one.metadata["birth_time"][0]
+                        if one.metadata and "birth_time" in one.metadata
+                        else time.time()
+                    )
                     self._slots[idx] = _Slot(
                         sample=one, birth_time=birth, keys=set(one.keys)
                     )

@@ -1,0 +1,187 @@
+"""Generation server worker: hosts the continuous-batching engine.
+
+Rebuild of the reference's generation server (reference:
+realhf/system/generation_server.py :120 — launches patched SGLang
+subprocesses and registers URLs; here the TPU engine runs in-process).
+
+API is a ZMQ ROUTER socket (replacing SGLang's HTTP):
+  ("generate", APIGenerateInput)          -> APIGenerateOutput (async reply)
+  ("update_weights", {path | version})    -> {"num_interrupted": n}
+  ("pause"/"resume"/"metrics", {})        -> ack / metrics dict
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Dict, Optional
+
+import zmq
+
+from areal_tpu.api import dataset_api, system_api
+from areal_tpu.base import constants, logging_, name_resolve, names, network
+from areal_tpu.system import worker_base
+
+logger = logging_.getLogger("generation_server")
+
+
+class GenerationServerWorker(worker_base.Worker):
+    def _configure(self, config: system_api.GenServerConfig):
+        self.config = config
+        self.worker_name = config.worker_name
+        self.logger = logging_.getLogger(self.worker_name)
+
+        from areal_tpu.engine.backend import make_model
+        from areal_tpu.engine.inference_server import ContinuousBatchingEngine
+        from areal_tpu.engine.sampling import SamplingParams
+
+        tokenizer = None
+        if config.tokenizer_path:
+            tokenizer = dataset_api.load_hf_tokenizer(config.tokenizer_path)
+        import jax
+
+        device = None
+        if config.device_idx is not None:
+            device = jax.devices()[config.device_idx % len(jax.devices())]
+        model = make_model(config.model, None, None, tokenizer=tokenizer)
+        sampling = SamplingParams(temperature=config.temperature)
+        self.engine = ContinuousBatchingEngine(
+            model.model_cfg,
+            model.init_params,
+            tokenizer=tokenizer,
+            max_batch=config.max_concurrent_batch,
+            kv_cache_len=config.kv_cache_len,
+            sampling=sampling,
+            device=device,
+        )
+
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        port = self._sock.bind_to_random_port("tcp://*")
+        self.addr = f"{network.gethostip()}:{port}"
+        name_resolve.add(
+            names.gen_server(
+                constants.experiment_name(),
+                constants.trial_name(),
+                config.worker_name,
+            ),
+            self.addr,
+            replace=True,
+        )
+        # qid -> ROUTER identity awaiting the result
+        self._waiting: Dict[str, bytes] = {}
+        self._start_time = time.monotonic()
+
+    # -- API ---------------------------------------------------------------
+
+    def _serve_api(self):
+        for _ in range(64):
+            try:
+                ident, _, msg = self._sock.recv_multipart(flags=zmq.NOBLOCK)
+            except zmq.ZMQError:
+                break
+            try:
+                cmd, payload = pickle.loads(msg)
+                if cmd == "generate":
+                    self.engine.submit(payload)
+                    self._waiting[payload.qid] = ident
+                    continue  # reply when the result is ready
+                elif cmd == "update_weights":
+                    n = self._update_weights(payload)
+                    resp = {"num_interrupted": n, "version": self.engine.version}
+                elif cmd == "pause":
+                    self.engine.pause()
+                    resp = "paused"
+                elif cmd == "resume":
+                    self.engine.resume()
+                    resp = "resumed"
+                elif cmd == "metrics":
+                    resp = self.metrics()
+                else:
+                    resp = {"error": f"unknown command {cmd}"}
+            except Exception as e:  # noqa: BLE001
+                self.logger.exception("api request failed")
+                resp = {"error": repr(e)}
+            self._sock.send_multipart([ident, b"", pickle.dumps(resp)])
+
+    def _reply_finished(self):
+        if not self._waiting:
+            return
+        for qid in list(self._waiting):
+            out = self.engine.try_get_result(qid)
+            if out is not None:
+                ident = self._waiting.pop(qid)
+                self._sock.send_multipart([ident, b"", pickle.dumps(out)])
+
+    def _update_weights(self, payload: Dict) -> int:
+        """Load new weights (from the trainer's realloc dir) and hot-swap."""
+        path = payload.get("path")
+        version = payload.get("version")
+        from areal_tpu.models.hf.registry import load_hf_model
+
+        cfg, params = load_hf_model(path)
+        return self.engine.update_weights(params, version=version)
+
+    def metrics(self) -> Dict:
+        return {
+            "n_inflight": self.engine.n_inflight,
+            "n_pending": self.engine.n_pending,
+            "gen_tokens_total": self.engine.gen_tokens_total,
+            "version": self.engine.version,
+            "uptime": time.monotonic() - self._start_time,
+        }
+
+    # -- poll ---------------------------------------------------------------
+
+    def _poll(self) -> worker_base.PollResult:
+        self._serve_api()
+        n = self.engine.step()
+        self._reply_finished()
+        return worker_base.PollResult(sample_count=n)
+
+    def _exit_hook(self):
+        if hasattr(self, "_sock"):
+            self._sock.close(linger=0)
+
+
+class GenServerClient:
+    """Blocking client for the server API (used via asyncio.to_thread from
+    rollout workers — replaces the reference's aiohttp SGLangAPIClient,
+    realhf/impl/model/backend/sglang.py:62)."""
+
+    def __init__(self, addr: str, timeout: float = 600.0):
+        self.addr = addr
+        self.timeout = timeout
+        self._ctx = zmq.Context.instance()
+        self._local = threading.local()
+
+    def _sock(self) -> zmq.Socket:
+        # one DEALER per thread: safe concurrent requests over one client
+        if not hasattr(self._local, "sock"):
+            s = self._ctx.socket(zmq.DEALER)
+            s.connect(f"tcp://{self.addr}")
+            self._local.sock = s
+        return self._local.sock
+
+    def call(self, cmd: str, payload) -> object:
+        sock = self._sock()
+        sock.send_multipart([b"", pickle.dumps((cmd, payload))])
+        if not sock.poll(timeout=int(self.timeout * 1000)):
+            # discard the socket so a late reply can't be read by (and
+            # mismatched with) the next request on this thread
+            sock.close(linger=0)
+            del self._local.sock
+            raise TimeoutError(f"{cmd} to {self.addr} timed out")
+        _, msg = sock.recv_multipart()
+        resp = pickle.loads(msg)
+        if isinstance(resp, dict) and "error" in resp:
+            raise RuntimeError(f"server error: {resp['error']}")
+        return resp
+
+    def generate(self, inp) -> object:
+        return self.call("generate", inp)
+
+    def close(self):
+        if hasattr(self._local, "sock"):
+            self._local.sock.close(linger=0)

@@ -1,0 +1,250 @@
+"""Generation-server manager: routing, staleness gating, weight updates.
+
+Rebuild of the reference's gserver manager (reference:
+realhf/system/gserver_manager.py :32 — FastAPI ``/schedule_request``
+(sticky-by-qid, round_robin / least_requests) :371-409,
+``/allocate_rollout`` (max_concurrent_rollouts + ``is_staled()``:
+expected_version = (trained_samples + running) / train_bs vs
+version + max_head_offpolicyness) :417-453, ``/finish_rollout`` :455,
+weight-update trigger on name_resolve model_version :158-190).
+
+The service is a ZMQ REP socket (the control plane's HTTP equivalent):
+  ("schedule_request", {qid})            -> {"url": addr, "version": v}
+  ("allocate_rollout", {qid})            -> {"ok": bool, "reason": str}
+  ("finish_rollout", {qid, accepted})    -> "ok"
+  ("get_status", {})                     -> counters
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Dict, List, Optional
+
+import zmq
+
+from areal_tpu.api import system_api
+from areal_tpu.base import constants, logging_, name_resolve, names, network
+from areal_tpu.system import worker_base
+from areal_tpu.system.generation_server import GenServerClient
+
+logger = logging_.getLogger("gserver_manager")
+
+
+class GserverManager(worker_base.Worker):
+    def _configure(self, config: system_api.GserverManagerConfig):
+        self.config = config
+        self.worker_name = config.worker_name
+        self.logger = logging_.getLogger(self.worker_name)
+
+        self._expr = constants.experiment_name()
+        self._trial = constants.trial_name()
+
+        # discover generation servers
+        self.server_addrs: List[str] = []
+        deadline = time.monotonic() + 120
+        while len(self.server_addrs) < config.n_servers:
+            self.server_addrs = sorted(
+                name_resolve.get_subtree(
+                    names.gen_servers(self._expr, self._trial)
+                )
+            )
+            if len(self.server_addrs) >= config.n_servers:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {len(self.server_addrs)}/{config.n_servers} "
+                    "generation servers registered"
+                )
+            time.sleep(0.1)
+        self._clients = {a: GenServerClient(a) for a in self.server_addrs}
+
+        # rollout accounting
+        self._round_robin = 0
+        self._qid_server: Dict[str, str] = {}
+        self._server_load: Dict[str, int] = {a: 0 for a in self.server_addrs}
+        self.n_running_rollouts = 0
+        self.accepted_rollouts = 0  # finished & accepted (trained samples)
+        self._model_version = 0
+
+        # service socket
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.REP)
+        port = self._sock.bind_to_random_port("tcp://*")
+        self.addr = f"{network.gethostip()}:{port}"
+        name_resolve.add(
+            names.gen_server_manager(self._expr, self._trial),
+            self.addr,
+            replace=True,
+        )
+        self._last_version_check = 0.0
+
+    # -- scheduling / staleness --------------------------------------------
+
+    def _schedule(self, qid: str) -> str:
+        if qid in self._qid_server:  # sticky: KV reuse on continuation
+            return self._qid_server[qid]
+        if self.config.schedule_policy == "least_requests":
+            addr = min(self.server_addrs, key=lambda a: self._server_load[a])
+        else:  # round_robin
+            addr = self.server_addrs[self._round_robin % len(self.server_addrs)]
+            self._round_robin += 1
+        self._qid_server[qid] = addr
+        self._server_load[addr] += 1
+        return addr
+
+    def is_staled(self) -> bool:
+        """Would a rollout started now exceed the staleness bound?
+        (reference :417-453).  Rollouts are counted in sequences
+        (``group_size`` per rollout) to match ``train_batch_size`` units."""
+        n_seqs = (
+            self.accepted_rollouts + self.n_running_rollouts
+        ) * max(1, self.config.group_size)
+        expected_version = n_seqs // max(1, self.config.train_batch_size)
+        return (
+            expected_version
+            > self._model_version + self.config.max_head_offpolicyness
+        )
+
+    def _allocate_rollout(self, qid: str) -> Dict:
+        cap = self.config.max_concurrent_rollouts or 10**9
+        if self.n_running_rollouts >= cap:
+            return {"ok": False, "reason": "capacity"}
+        if self.is_staled():
+            return {"ok": False, "reason": "staled"}
+        self.n_running_rollouts += 1
+        return {"ok": True, "reason": ""}
+
+    def _finish_rollout(self, qid: str, accepted: bool):
+        self.n_running_rollouts = max(0, self.n_running_rollouts - 1)
+        if accepted:
+            self.accepted_rollouts += 1
+        # scheduling registered per-group-member qids "{qid}-{i}"
+        for k in [
+            k
+            for k in self._qid_server
+            if k == qid or k.startswith(qid + "-")
+        ]:
+            srv = self._qid_server.pop(k)
+            self._server_load[srv] = max(0, self._server_load[srv] - 1)
+
+    # -- weight updates -----------------------------------------------------
+
+    def _check_new_params(self) -> Optional[Dict]:
+        """Poll name_resolve for a newly-published model version
+        (reference :131; the trainer publishes after each train step)."""
+        try:
+            raw = name_resolve.get(
+                names.model_version(self._expr, self._trial, "actor")
+            )
+        except name_resolve.NameEntryNotFoundError:
+            return None
+        info = pickle.loads(bytes.fromhex(raw)) if isinstance(raw, str) else raw
+        if info["version"] <= self._model_version:
+            return None
+        return info
+
+    def _flush_and_update(self, info: Dict):
+        version = info["version"]
+        for addr, client in self._clients.items():
+            client.call("pause", {})
+        n_interrupted = 0
+        for addr, client in self._clients.items():
+            resp = client.call(
+                "update_weights", {"path": info["path"], "version": version}
+            )
+            n_interrupted += resp["num_interrupted"]
+        for addr, client in self._clients.items():
+            client.call("resume", {})
+        self._model_version = version
+        self.logger.info(
+            "weights updated to v%d on %d servers (%d interrupted)",
+            version,
+            len(self._clients),
+            n_interrupted,
+        )
+
+    # -- poll ---------------------------------------------------------------
+
+    def _serve(self):
+        for _ in range(64):
+            try:
+                msg = self._sock.recv(flags=zmq.NOBLOCK)
+            except zmq.ZMQError:
+                return
+            try:
+                cmd, payload = pickle.loads(msg)
+                if cmd == "schedule_request":
+                    addr = self._schedule(payload["qid"])
+                    resp = {"url": addr, "version": self._model_version}
+                elif cmd == "allocate_rollout":
+                    resp = self._allocate_rollout(payload["qid"])
+                elif cmd == "finish_rollout":
+                    self._finish_rollout(
+                        payload["qid"], payload.get("accepted", True)
+                    )
+                    resp = "ok"
+                elif cmd == "get_status":
+                    resp = {
+                        "version": self._model_version,
+                        "n_running_rollouts": self.n_running_rollouts,
+                        "accepted_rollouts": self.accepted_rollouts,
+                        "server_load": dict(self._server_load),
+                    }
+                else:
+                    resp = {"error": f"unknown command {cmd}"}
+            except Exception as e:  # noqa: BLE001
+                self.logger.exception("request failed")
+                resp = {"error": repr(e)}
+            self._sock.send(pickle.dumps(resp))
+
+    def _poll(self) -> worker_base.PollResult:
+        self._serve()
+        if time.monotonic() - self._last_version_check > 0.5:
+            self._last_version_check = time.monotonic()
+            info = self._check_new_params()
+            if info is not None:
+                self._flush_and_update(info)
+        return worker_base.PollResult(sample_count=1)
+
+    def _exit_hook(self):
+        if hasattr(self, "_sock"):
+            self._sock.close(linger=0)
+
+
+class GserverManagerClient:
+    """Blocking REQ client used by rollout workers."""
+
+    def __init__(self, experiment_name: str, trial_name: str, timeout=60.0):
+        addr = name_resolve.wait(
+            names.gen_server_manager(experiment_name, trial_name), timeout=120
+        )
+        self._ctx = zmq.Context.instance()
+        import threading
+
+        self._local = threading.local()
+        self.addr = addr
+        self.timeout = timeout
+
+    def _sock(self):
+        import threading
+
+        if not hasattr(self._local, "sock"):
+            s = self._ctx.socket(zmq.REQ)
+            s.connect(f"tcp://{self.addr}")
+            self._local.sock = s
+        return self._local.sock
+
+    def call(self, cmd: str, payload: Dict):
+        sock = self._sock()
+        sock.send(pickle.dumps((cmd, payload)))
+        if not sock.poll(timeout=int(self.timeout * 1000)):
+            # a REQ socket is stuck in recv state after a timeout: discard it
+            # so the next call starts clean (the late reply is dropped)
+            sock.close(linger=0)
+            del self._local.sock
+            raise TimeoutError(f"{cmd} to gserver manager timed out")
+        resp = pickle.loads(sock.recv())
+        if isinstance(resp, dict) and "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp

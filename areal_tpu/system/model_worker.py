@@ -40,6 +40,20 @@ logger = logging_.getLogger("model_worker")
 NON_BLOCKING_RPCS = ("fetch", "spec", "clear_data_cache", "model_config")
 
 
+def _count_dataset_rows(d) -> int:
+    """Row count of a jsonl/json dataset abstraction without building it."""
+    path = (d.args or {}).get("dataset_path")
+    if not path or not os.path.exists(path):
+        return 0
+    if path.endswith(".jsonl"):
+        with open(path) as f:
+            return sum(1 for line in f if line.strip())
+    import json
+
+    with open(path) as f:
+        return len(json.load(f))
+
+
 class ModelWorker(worker_base.Worker):
     def _configure(self, config: system_api.ModelWorkerConfig):
         self.config = config
@@ -92,10 +106,20 @@ class ModelWorker(worker_base.Worker):
         elif config.use_stream_dataset:
             from areal_tpu.system.stream_dataset import PullerStreamDataset
 
+            # epoch accounting mirrors the underlying prompt dataset size
+            # (reference: stream_dataset.py:23 __len__ contract); count rows
+            # cheaply instead of constructing (tokenizing) the full dataset
+            size = 10**9
+            if config.datasets:
+                dp_rank, dp_size = config.dataset_shard
+                n_rows = sum(_count_dataset_rows(d) for d in config.datasets)
+                size = max(1, n_rows // max(1, dp_size))
+                size *= config.stream_group_size
             self._dataset = PullerStreamDataset(
                 experiment_name=constants.experiment_name(),
                 trial_name=constants.trial_name(),
                 puller_index=config.dataset_shard[0],
+                dataset_size=size,
             )
 
     # -- dataset ------------------------------------------------------------
@@ -182,6 +206,8 @@ class ModelWorker(worker_base.Worker):
             )
         elif htype == "save":
             self._save_model(hook["model_name"], hook["path"])
+        elif htype == "publish_weights":
+            self._publish_weights(hook["model_name"])
         elif htype == "offload":
             pass  # device arrays are dropped with the engine's arrays; no-op
         else:
@@ -213,6 +239,44 @@ class ModelWorker(worker_base.Worker):
 
             new = _ema(src.params, dst.params)
         dst.set_params(new)
+
+    def _publish_weights(self, model_name: str):
+        """Save current weights to the realloc dir and publish the version in
+        name_resolve — the train->generation weight sync trigger (reference:
+        realhf/system/model_worker.py:787-812 post-train realloc save +
+        version publish; gserver manager picks it up and hot-swaps)."""
+        import pickle as _pickle
+
+        from areal_tpu.base import name_resolve, names
+
+        model = self._models[model_name]
+        version = model.version.global_step
+        path = os.path.join(
+            constants.get_param_realloc_path(),
+            model.name.role,
+            f"v{version}",
+        )
+        os.makedirs(path, exist_ok=True)
+        model.engine.save_hf(path, model.backend_name, model.tokenizer)
+        name_resolve.add(
+            names.model_version(
+                constants.experiment_name(),
+                constants.trial_name(),
+                model.name.role,
+            ),
+            _pickle.dumps({"version": version, "path": path}).hex(),
+            replace=True,
+        )
+        # gc older snapshots (keep last 2; reference gserver_manager:287-305)
+        base = os.path.dirname(path)
+        snaps = sorted(
+            (d for d in os.listdir(base) if d.startswith("v")),
+            key=lambda d: int(d[1:]),
+        )
+        for d in snaps[:-2]:
+            import shutil
+
+            shutil.rmtree(os.path.join(base, d), ignore_errors=True)
 
     def _save_model(self, model_name: str, path: str):
         model = self._models[model_name]

@@ -1,0 +1,106 @@
+"""Chunked (interruptible) generation client.
+
+Rebuild of the reference's partial rollout manager (reference:
+realhf/system/partial_rollout.py :29 — splits each group member's generation
+into ``new_tokens_per_chunk`` chunks; when a chunk ends without EOS the
+continuation is re-scheduled (the server may have new weights by then),
+accumulating prev logprobs and tracking version_start/version_end; groups
+are reassembled before replying).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from areal_tpu.api import model_api
+from areal_tpu.base import logging_
+from areal_tpu.system.generation_server import GenServerClient
+
+logger = logging_.getLogger("partial_rollout")
+
+
+class PartialRolloutManager:
+    def __init__(
+        self,
+        manager_client,  # GserverManagerClient
+        gconfig: model_api.GenerationHyperparameters,
+        new_tokens_per_chunk: int = 1 << 30,
+        request_timeout: float = 600.0,
+    ):
+        self.manager_client = manager_client
+        self.gconfig = gconfig
+        self.new_tokens_per_chunk = max(1, new_tokens_per_chunk)
+        self.request_timeout = request_timeout
+        self._server_clients: Dict[str, GenServerClient] = {}
+
+    def _client(self, addr: str) -> GenServerClient:
+        if addr not in self._server_clients:
+            self._server_clients[addr] = GenServerClient(
+                addr, timeout=self.request_timeout
+            )
+        return self._server_clients[addr]
+
+    async def _gen_one(
+        self, qid: str, prompt_ids: List[int]
+    ) -> model_api.APIGenerateOutput:
+        remaining = self.gconfig.max_new_tokens
+        cur = list(prompt_ids)
+        out_ids: List[int] = []
+        out_lps: List[float] = []
+        version_start: Optional[int] = None
+        version_end = -1
+        no_eos = True
+        while remaining > 0:
+            sched = await asyncio.to_thread(
+                self.manager_client.call, "schedule_request", {"qid": qid}
+            )
+            client = self._client(sched["url"])
+            chunk = min(self.new_tokens_per_chunk, remaining)
+            inp = model_api.APIGenerateInput(
+                qid=qid,
+                prompt_ids=prompt_ids,
+                input_ids=cur,
+                gconfig=self.gconfig.new(max_new_tokens=chunk, n=1),
+            )
+            out: model_api.APIGenerateOutput = await asyncio.to_thread(
+                client.generate, inp
+            )
+            if version_start is None:
+                version_start = out.version_start
+            version_end = out.version_end
+            out_ids.extend(out.output_ids)
+            out_lps.extend(out.output_logprobs)
+            cur = cur + list(out.output_ids)
+            remaining -= len(out.output_ids)
+            no_eos = out.no_eos
+            if not out.no_eos or not out.output_ids:
+                break
+        return model_api.APIGenerateOutput(
+            qid=qid,
+            prompt_ids=list(prompt_ids),
+            input_ids=list(prompt_ids),
+            output_ids=out_ids,
+            output_logprobs=out_lps,
+            no_eos=no_eos,
+            version_start=version_start if version_start is not None else -1,
+            version_end=version_end,
+        )
+
+    async def generate_group(
+        self, qid: str, prompt_ids: List[int], group_size: int
+    ) -> model_api.BundledGenerationOutputs:
+        outs = await asyncio.gather(
+            *(
+                self._gen_one(f"{qid}-{i}", prompt_ids)
+                for i in range(group_size)
+            )
+        )
+        outs = list(outs)
+        for o in outs:
+            o.qid = qid
+        return model_api.BundledGenerationOutputs.from_api_outputs(outs)
+
+    def close(self):
+        for c in self._server_clients.values():
+            c.close()

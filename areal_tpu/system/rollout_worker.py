@@ -1,0 +1,134 @@
+"""Rollout worker: drives agent/env loops against the generation cluster.
+
+Rebuild of the reference's rollout worker (reference:
+realhf/system/rollout_worker.py — ``_poll_async`` :204 loading one prompt per
+poll, ``/allocate_rollout`` gating :188, ``agent.collect_trajectory`` tasks
+with obs/act queues driving the PartialRolloutManager, trajectory push via
+ZMQ :293, ``/finish_rollout`` :304).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Dict, List, Optional, Set
+
+from areal_tpu.api import agent_api, dataset_api, env_api, system_api
+from areal_tpu.base import constants, logging_
+from areal_tpu.system import worker_base
+from areal_tpu.system.gserver_manager import GserverManagerClient
+from areal_tpu.system.partial_rollout import PartialRolloutManager
+from areal_tpu.system.push_pull_stream import NameResolvingZmqPusher
+
+logger = logging_.getLogger("rollout_worker")
+
+
+class RolloutWorker(worker_base.AsyncWorker):
+    def _configure(self, config: system_api.RolloutWorkerConfig):
+        self.config = config
+        self.worker_name = config.worker_name
+        self.logger = logging_.getLogger(self.worker_name)
+
+        self._expr = constants.experiment_name()
+        self._trial = constants.trial_name()
+
+        self.agent = agent_api.make_agent(config.agent)
+        self.env = env_api.make_env(config.env)
+
+        tokenizer = (
+            dataset_api.load_hf_tokenizer(config.tokenizer_path)
+            if config.tokenizer_path
+            else None
+        )
+        dp_rank, dp_size = config.dataset_shard
+        datasets = [
+            dataset_api.make_dataset(
+                d,
+                seed=config.dataset_seed,
+                dp_rank=dp_rank,
+                world_size=dp_size,
+                tokenizer_or_path=tokenizer,
+            )
+            for d in config.datasets
+        ]
+        self._dataset = datasets[0]
+        self._data_iter = itertools.cycle(range(len(self._dataset)))
+
+        self.manager_client = GserverManagerClient(self._expr, self._trial)
+        self.prm = PartialRolloutManager(
+            self.manager_client,
+            config.gconfig,
+            new_tokens_per_chunk=config.new_tokens_per_chunk,
+            request_timeout=config.rollout_request_timeout,
+        )
+        self.pusher = NameResolvingZmqPusher(
+            self._expr, self._trial, pusher_index=dp_rank
+        )
+        self._tasks: Set[asyncio.Task] = set()
+        self._gen_tasks: Set[asyncio.Task] = set()
+        self.rollout_count = 0
+        self.push_count = 0
+        self._alloc_counter = 0
+
+    async def _rollout_task(self, qid: str, prompt_sample):
+        obs_q: asyncio.Queue = asyncio.Queue()
+        act_q: asyncio.Queue = asyncio.Queue()
+
+        async def gen_pump():
+            q, prompt_ids, group_size = await obs_q.get()
+            bundle = await self.prm.generate_group(q, prompt_ids, group_size)
+            await act_q.put(bundle)
+
+        pump = asyncio.create_task(gen_pump())
+        self._gen_tasks.add(pump)
+        pump.add_done_callback(self._gen_tasks.discard)
+        accepted = False
+        try:
+            trajs = await self.agent.collect_trajectory(
+                prompt_sample, self.env, obs_q, act_q
+            )
+            accepted = len(trajs) > 0
+            if accepted:
+                self.pusher.push([t.as_json_compatible() for t in trajs])
+                self.push_count += len(trajs)
+        finally:
+            if not pump.done():
+                pump.cancel()
+            # always release the manager's rollout slot
+            await asyncio.to_thread(
+                self.manager_client.call,
+                "finish_rollout",
+                {"qid": qid, "accepted": accepted},
+            )
+            self.rollout_count += 1
+
+    async def _poll_async(self) -> worker_base.PollResult:
+        # harvest finished tasks (exceptions propagate)
+        done = [t for t in self._tasks if t.done()]
+        for t in done:
+            self._tasks.discard(t)
+            t.result()
+
+        idx = next(self._data_iter)
+        prompt_sample = self._dataset[idx]
+        # unique rollout id: the same prompt may roll out repeatedly across
+        # epochs, and trajectory ids derive from it (buffer ids must be
+        # unique; reference tracks used ids, rollout_worker.py:181)
+        qid = f"{prompt_sample.ids[0]}#{self.config.dataset_shard[0]}-{self._alloc_counter}"
+        self._alloc_counter += 1
+        prompt_sample.ids = [qid]
+        resp = await asyncio.to_thread(
+            self.manager_client.call, "allocate_rollout", {"qid": qid}
+        )
+        if not resp["ok"]:
+            await asyncio.sleep(0.05)
+            return worker_base.PollResult(sample_count=0)
+        task = asyncio.create_task(self._rollout_task(qid, prompt_sample))
+        self._tasks.add(task)
+        return worker_base.PollResult(sample_count=1)
+
+    def _exit_hook(self):
+        if hasattr(self, "prm"):
+            self.prm.close()
+        if hasattr(self, "pusher"):
+            self.pusher.close()

@@ -1,0 +1,157 @@
+"""Continuous-batching engine tests: greedy parity with the static batch
+generator, continuous admission, and interruptible weight update (the
+reference's patched-SGLang semantics, patch/sglang/v0.4.6.post2.patch)."""
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.api.model_api import (
+    APIGenerateInput,
+    GenerationHyperparameters,
+)
+from areal_tpu.engine.inference_server import ContinuousBatchingEngine
+from areal_tpu.engine.sampling import SamplingParams
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+
+EOS = 5
+
+
+def make_engine(params=None, cfg=None, **kw):
+    cfg = cfg or tiny_config(vocab_size=64, max_position_embeddings=256)
+    if params is None:
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    defaults = dict(
+        max_batch=4,
+        kv_cache_len=128,
+        chunk_size=8,
+        sampling=SamplingParams(greedy=True),
+        stop_tokens=(EOS,),
+    )
+    defaults.update(kw)
+    return ContinuousBatchingEngine(cfg, params, **defaults), cfg, params
+
+
+def run_until_done(eng, max_steps=200):
+    for _ in range(max_steps):
+        if not eng.has_work:
+            return
+        eng.step()
+    raise AssertionError("engine did not drain")
+
+
+def test_greedy_parity_with_batch_generator():
+    """The continuous engine must produce the same greedy tokens as the
+    static generate_loop for the same prompts."""
+    from areal_tpu.engine.generation import generate_tokens
+
+    eng, cfg, params = make_engine()
+    gconfig = GenerationHyperparameters(
+        max_new_tokens=12, greedy=True, n=1
+    )
+    prompts = [[7, 8, 9], [10, 11, 12, 13, 14], [3, 2]]
+    ref = generate_tokens(
+        params, cfg, prompts, gconfig, EOS, jax.random.PRNGKey(1)
+    )
+
+    qids = []
+    for i, p in enumerate(prompts):
+        qids.append(
+            eng.submit(
+                APIGenerateInput(
+                    qid=f"q{i}",
+                    prompt_ids=p,
+                    input_ids=p,
+                    gconfig=gconfig,
+                )
+            )
+        )
+    run_until_done(eng)
+    for i, qid in enumerate(qids):
+        out = eng.wait_result(qid, timeout=5)
+        assert out.output_ids == ref[i]["output_ids"], (
+            i,
+            out.output_ids,
+            ref[i]["output_ids"],
+        )
+        np.testing.assert_allclose(
+            out.output_logprobs, ref[i]["output_logprobs"], atol=1e-4
+        )
+
+
+def test_continuous_admission_more_requests_than_rows():
+    eng, cfg, params = make_engine(max_batch=2)
+    gconfig = GenerationHyperparameters(max_new_tokens=6, greedy=True)
+    qids = [
+        eng.submit(
+            APIGenerateInput(
+                qid=f"q{i}",
+                prompt_ids=[i + 1, i + 2],
+                input_ids=[i + 1, i + 2],
+                gconfig=gconfig,
+            )
+        )
+        for i in range(5)
+    ]
+    run_until_done(eng)
+    for qid in qids:
+        out = eng.wait_result(qid, timeout=5)
+        assert 1 <= len(out.output_ids) <= 6
+        assert len(out.output_logprobs) == len(out.output_ids)
+
+
+def test_weight_update_interrupts_and_recomputes():
+    """Swap weights mid-generation: in-flight rows continue under the new
+    weights and version_start/version_end record the transition."""
+    eng, cfg, params = make_engine(chunk_size=2)
+    gconfig = GenerationHyperparameters(max_new_tokens=20, greedy=True)
+    qid = eng.submit(
+        APIGenerateInput(
+            qid="q0", prompt_ids=[7, 8, 9], input_ids=[7, 8, 9],
+            gconfig=gconfig,
+        )
+    )
+    eng.step()  # admit + first chunk
+    assert eng.n_inflight == 1
+
+    params2 = transformer.init_params(cfg, jax.random.PRNGKey(42))
+    n_interrupted = eng.update_weights(params2, version=1)
+    assert n_interrupted == 1
+    run_until_done(eng)
+    out = eng.wait_result(qid, timeout=5)
+    assert out.version_start == 0
+    assert out.version_end == 1
+    assert len(out.output_ids) >= 3
+
+    # continuation under new weights must match a fresh greedy run of
+    # params2 on the same context (KV was recomputed correctly):
+    # generate from (prompt + tokens so far) with params2 and compare tail.
+    k = 3  # tokens sampled under v0 before the update (first chunk + admit)
+    from areal_tpu.engine.generation import generate_tokens
+
+    seed_ctx = [7, 8, 9] + out.output_ids[:k]
+    ref = generate_tokens(
+        params2,
+        cfg,
+        [seed_ctx],
+        GenerationHyperparameters(
+            max_new_tokens=len(out.output_ids) - k, greedy=True
+        ),
+        EOS,
+        jax.random.PRNGKey(3),
+    )
+    assert out.output_ids[k:] == ref[0]["output_ids"]
+
+
+def test_version_stamps_without_update():
+    eng, cfg, params = make_engine()
+    gconfig = GenerationHyperparameters(max_new_tokens=4, greedy=True)
+    qid = eng.submit(
+        APIGenerateInput(
+            qid="q0", prompt_ids=[4], input_ids=[4], gconfig=gconfig
+        )
+    )
+    run_until_done(eng)
+    out = eng.wait_result(qid, timeout=5)
+    assert out.version_start == 0 and out.version_end == 0
