@@ -70,7 +70,13 @@ class TrainEngine:
         )
         self.params = jax.device_put(params, self.param_shardings)
 
-        self.batch_sharding = NamedSharding(mesh, P(("data", "fsdp")))
+        # batch rows shard over data axes; the token axis shards over ``seq``
+        # when context parallelism is on (ring attention handles the halo)
+        seq_axis = "seq" if mesh.shape.get("seq", 1) > 1 else None
+        self.batch_sharding = NamedSharding(
+            mesh, P(("data", "fsdp"), seq_axis)
+        )
+        self.row_sharding = NamedSharding(mesh, P(("data", "fsdp")))
         self.scalar_sharding = NamedSharding(mesh, P())
 
         if optimizer_cfg is not None:
@@ -101,7 +107,10 @@ class TrainEngine:
         batch.update(pb.extras)
         out = {}
         for k, v in batch.items():
-            out[k] = jax.device_put(v, self.batch_sharding)
+            sharding = (
+                self.batch_sharding if v.ndim >= 2 else self.row_sharding
+            )
+            out[k] = jax.device_put(v, sharding)
         return out
 
     def _pad(self, sample: SequenceSample, token_key: str) -> batching.PaddedBatch:
@@ -115,6 +124,9 @@ class TrainEngine:
     # -- training -----------------------------------------------------------
 
     def _get_grad_step(self, loss_fn: LossFn):
+        from areal_tpu.models import transformer
+
+        transformer.set_ambient_mesh(self.mesh)  # for ring attention tracing
         key = id(loss_fn)
         if key not in self._grad_step_cache:
 
@@ -194,6 +206,9 @@ class TrainEngine:
     # -- inference ----------------------------------------------------------
 
     def _get_fwd_step(self, fwd_fn: FwdFn):
+        from areal_tpu.models import transformer
+
+        transformer.set_ambient_mesh(self.mesh)
         key = id(fwd_fn)
         if key not in self._fwd_step_cache:
             self._fwd_step_cache[key] = jax.jit(

@@ -260,11 +260,51 @@ def reference_attention(q, k, v, mask, logits_dtype=jnp.float32):
     return out
 
 
-def _attention_dispatch(q, k, v, mask, cfg: TransformerConfig, seg_ids=None):
-    """Pick the attention implementation: Pallas flash on TPU for the
-    self-attention (no-cache) path; jnp reference elsewhere."""
+# Mesh used for context-parallel (ring) attention inside jitted forwards.
+# Set by the train engine at trace time; None disables the ring path.
+_AMBIENT_MESH = None
+
+
+def set_ambient_mesh(mesh):
+    global _AMBIENT_MESH
+    _AMBIENT_MESH = mesh
+
+
+def _seq_parallel_mesh():
+    m = _AMBIENT_MESH
+    if m is not None and m.shape.get("seq", 1) > 1:
+        return m
+    return None
+
+
+def _attention_dispatch(
+    q, k, v, mask, cfg: TransformerConfig, seg_ids=None, positions=None
+):
+    """Pick the attention implementation: ring attention when the engine's
+    mesh shards the sequence axis (context parallelism — a capability the
+    reference lacks, SURVEY §2.9); Pallas flash on TPU for the dense
+    self-attention path; jnp reference elsewhere."""
     from areal_tpu.ops import flash_attention as fa
 
+    mesh = _seq_parallel_mesh()
+    if mesh is not None and seg_ids is not None and positions is not None:
+        from areal_tpu.ops.ring_attention import ring_attention
+
+        head_axis = (
+            "model"
+            if cfg.n_kv_heads % mesh.shape.get("model", 1) == 0
+            else None
+        )
+        return ring_attention(
+            q,
+            k,
+            v,
+            seg_ids,
+            positions,
+            mesh=mesh,
+            head_axis=head_axis,
+            sliding_window=cfg.sliding_window,
+        )
     if (
         seg_ids is not None
         and jax.default_backend() == "tpu"
@@ -353,7 +393,9 @@ def _layer(
         attn_out = reference_attention(q, k_full, v_full, mask)
     else:
         k_full = v_full = None
-        attn_out = _attention_dispatch(q, k, v, mask, cfg, seg_ids=seg_ids)
+        attn_out = _attention_dispatch(
+            q, k, v, mask, cfg, seg_ids=seg_ids, positions=positions
+        )
 
     attn_out = attn_out.reshape(B, T, cfg.n_q_heads * cfg.head_dim)
     x = x + proj(lp["attn"]["o"], attn_out)
