@@ -1,0 +1,229 @@
+"""Experiment launcher: one process per worker, monitored, restartable.
+
+Rebuild of the reference's classic launch path (reference:
+realhf/apps/main.py:78 ``main_start`` with the recover-restart loop
+:108-288, plus the controller's configure/monitor/panic role,
+realhf/system/controller.py:98).  Differences by design: workers read their
+config slice from the dumped ``ExperimentConfig`` cache instead of a
+controller push channel, and on TPU the launch unit is one process per HOST
+(each process drives its local chips; jax.distributed joins them into one
+SPMD world when ``AREAL_JAX_COORDINATOR`` is exported).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from areal_tpu.api import system_api
+from areal_tpu.apps import remote
+from areal_tpu.base import constants, logging_, name_resolve, names
+from areal_tpu.scheduler.client import (
+    JobException,
+    JobState,
+    make_scheduler,
+)
+from areal_tpu.system.worker_base import (
+    WorkerControlPanel,
+    WorkerServerStatus,
+)
+
+logger = logging_.getLogger("launcher")
+
+TERMINAL_STATUSES = (
+    WorkerServerStatus.COMPLETED,
+    WorkerServerStatus.ERROR,
+    WorkerServerStatus.LOST,
+)
+
+
+def _worker_specs(cfg: system_api.ExperimentConfig) -> List[Tuple[str, int, str]]:
+    """[(worker_type, index, worker_name)] for every worker process."""
+    specs = [("master", 0, cfg.master.worker_name)]
+    for i, w in enumerate(cfg.model_workers):
+        specs.append(("model_worker", i, w.worker_name))
+    for i, w in enumerate(cfg.gen_servers):
+        specs.append(("gen_server", i, w.worker_name))
+    if cfg.gserver_manager is not None:
+        specs.append(("gserver_manager", 0, cfg.gserver_manager.worker_name))
+    for i, w in enumerate(cfg.rollout_workers):
+        specs.append(("rollout_worker", i, w.worker_name))
+    return specs
+
+
+def launch_experiment(
+    cfg: system_api.ExperimentConfig,
+    mode: str = "local",
+    recover_retries: int = 0,
+    timeout: Optional[float] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> None:
+    """Launch every worker as its own process; monitor to completion.
+
+    Restarts the whole experiment up to ``recover_retries`` times when a
+    worker fails (the reference's experiment-level recovery policy,
+    realhf/apps/main.py:108-288; recover ckpt loading happens inside the
+    workers)."""
+    trials = recover_retries + 1
+    last_exc: Optional[BaseException] = None
+    for attempt in range(trials):
+        if attempt > 0:
+            logger.warning(
+                "restarting experiment (recover attempt %d/%d)",
+                attempt,
+                recover_retries,
+            )
+        try:
+            _launch_once(cfg, mode=mode, timeout=timeout, env=env, recover=attempt > 0)
+            return
+        except (JobException, TimeoutError) as e:
+            last_exc = e
+            if attempt == trials - 1:
+                raise
+    if last_exc:
+        raise last_exc
+
+
+def _launch_once(
+    cfg: system_api.ExperimentConfig,
+    mode: str,
+    timeout: Optional[float],
+    env: Optional[Dict[str, str]],
+    recover: bool = False,
+) -> None:
+    constants.set_experiment_trial_names(cfg.experiment_name, cfg.trial_name)
+    backend = os.environ.get("AREAL_NAME_RESOLVE", "nfs")
+    name_resolve.reconfigure(backend)
+    name_resolve.clear_subtree(
+        names.trial_root(cfg.experiment_name, cfg.trial_name)
+    )
+    remote.dump_experiment_config(cfg)
+
+    sched = make_scheduler(mode, cfg.experiment_name, cfg.trial_name)
+    wenv = {
+        "AREAL_NAME_RESOLVE": backend,
+        **({"AREAL_RECOVER": "1"} if recover else {}),
+        **(env or {}),
+    }
+    log_dir = constants.get_log_path()
+    specs = _worker_specs(cfg)
+    for wtype, idx, wname in specs:
+        sched.submit(
+            wtype,
+            [
+                sys.executable,
+                "-m",
+                "areal_tpu.apps.remote",
+                "--experiment_name",
+                cfg.experiment_name,
+                "--trial_name",
+                cfg.trial_name,
+                "--worker_type",
+                wtype,
+                "--worker_index",
+                str(idx),
+            ],
+            env=wenv,
+            log_path=os.path.join(log_dir, f"{wname}.log"),
+        )
+    try:
+        _monitor(sched, cfg, specs, timeout)
+    except BaseException:
+        sched.stop_all()
+        raise
+
+
+def _monitor(
+    sched,
+    cfg: system_api.ExperimentConfig,
+    specs: List[Tuple[str, int, str]],
+    timeout: Optional[float],
+) -> None:
+    """Controller role: watch job + worker statuses; panic on failure; when
+    the master completes, gracefully exit the remaining workers."""
+    deadline = time.monotonic() + timeout if timeout else None
+    master_name = cfg.master.worker_name
+    status_key = names.worker_status(
+        cfg.experiment_name, cfg.trial_name, master_name
+    )
+    while True:
+        for job in sched.find_all():
+            if job.state == JobState.FAILED:
+                raise JobException(
+                    sched.run_name, job.name, job.host, job.state
+                )
+        try:
+            master_status = name_resolve.get(status_key)
+        except name_resolve.NameEntryNotFoundError:
+            master_status = None
+        if master_status == WorkerServerStatus.COMPLETED.value:
+            break
+        if master_status == WorkerServerStatus.ERROR.value:
+            raise JobException(
+                sched.run_name, master_name, "?", JobState.FAILED
+            )
+        if deadline and time.monotonic() > deadline:
+            raise TimeoutError("experiment timed out")
+        time.sleep(0.5)
+
+    # master done: ask everyone else to exit, then reap
+    panel = WorkerControlPanel(cfg.experiment_name, cfg.trial_name)
+    others = [w for t, i, w in specs if w != master_name]
+    try:
+        panel.connect(others, timeout=10)
+        for w in others:
+            try:
+                panel.request(w, "exit", timeout=10)
+            except Exception:  # noqa: BLE001 - best-effort shutdown
+                logger.warning("worker %s did not ack exit", w)
+    except Exception:  # noqa: BLE001
+        logger.warning("could not connect control panel for shutdown")
+    finally:
+        panel.close()
+    try:
+        sched.wait(
+            timeout=30,
+            check_status=(JobState.FAILED,),
+            remove_status=(JobState.COMPLETED, JobState.CANCELLED),
+        )
+    except TimeoutError:
+        logger.warning("workers still running after master exit; killing")
+    finally:
+        sched.stop_all()
+
+
+def main_stop(experiment_name: str, trial_name: str, mode: str = "local"):
+    """Best-effort stop of a running trial (reference main.py ``main_stop``)."""
+    constants.set_experiment_trial_names(experiment_name, trial_name)
+    name_resolve.reconfigure(os.environ.get("AREAL_NAME_RESOLVE", "nfs"))
+    panel = WorkerControlPanel(experiment_name, trial_name)
+    root = names.worker_root(experiment_name, trial_name)
+    try:
+        workers = [k.rsplit("/", 1)[-1] for k in name_resolve.find_subtree(root)]
+        panel.connect(workers, timeout=5)
+        for w in workers:
+            try:
+                panel.request(w, "exit", timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+    finally:
+        panel.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description="areal_tpu experiment launcher")
+    p.add_argument("command", choices=["stop"])
+    p.add_argument("--experiment_name", required=True)
+    p.add_argument("--trial_name", required=True)
+    args = p.parse_args(argv)
+    if args.command == "stop":
+        main_stop(args.experiment_name, args.trial_name)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,11 +65,22 @@ def pad_batch(
     buckets: Sequence[int] = DEFAULT_BUCKETS,
     row_multiple: int = 1,
     min_rows: int = 1,
+    fixed_rows: int = 0,
+    fixed_len: int = 0,
 ) -> PaddedBatch:
-    """One sequence per row, right padding; extras aligned per class."""
+    """One sequence per row, right padding; extras aligned per class.
+
+    ``fixed_rows``/``fixed_len`` force the output shape (so several
+    micro-batches can share one compiled step / be stacked for a scan)."""
     seqlens = [l[0] for l in sample.seqlens[token_key]]
     B = max(pad_rows(max(len(seqlens), min_rows), row_multiple), min_rows)
     T = bucket_len(max(seqlens), buckets)
+    if fixed_rows:
+        assert len(seqlens) <= fixed_rows
+        B = fixed_rows
+    if fixed_len:
+        assert max(seqlens) <= fixed_len
+        T = fixed_len
 
     tokens = np.zeros((B, T), np.int32)
     positions = np.zeros((B, T), np.int32)

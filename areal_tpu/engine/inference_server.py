@@ -66,43 +66,42 @@ class _Row:
     cur_token: int = -1  # pending token (KV not yet in cache)
 
 
-@partial(jax.jit, static_argnames=("cfg", "sampling"))
-def _admit_row(
+@partial(jax.jit, static_argnames=("cfg", "sampling"), donate_argnums=(2,))
+def _admit_rows(
     params,
     cfg: TransformerConfig,
     cache: KVCache,
-    tokens: jax.Array,  # [1, T] right-padded prompt
-    length: jax.Array,  # scalar
-    row: jax.Array,  # scalar
+    tokens: jax.Array,  # [n, T] right-padded prompts
+    lengths: jax.Array,  # [n]
+    rows: jax.Array,  # [n] target cache rows; >= B entries are dropped
     rng: jax.Array,
     sampling: SamplingParams,
 ) -> Tuple[KVCache, jax.Array, jax.Array]:
-    """Prefill one prompt into cache row ``row``; sample the first token."""
-    S = cache.k.shape[2]
-    T = tokens.shape[1]
-    mini = KVCache.zeros(cfg, 1, S, dtype=cache.k.dtype)
-    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
-    seg = (positions < length).astype(jnp.int32)
+    """Batched prefill: fill ``rows`` of the (donated) cache with up to ``n``
+    prompts in ONE device call and sample each row's first token.
+
+    Replaces the round-1 one-request-at-a-time admission that copied the
+    full cache per request (reference analogue: SGLang's batched prefill
+    admission, realhf/impl/model/backend/sglang.py:369)."""
+    n, T = tokens.shape
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (n, 1))
+    seg = (positions < lengths[:, None]).astype(jnp.int32)
+    mini = KVCache.zeros(cfg, n, T, dtype=cache.k.dtype)
     logits, mini = prefill(params, cfg, tokens, positions, seg, mini)
-    k = jax.lax.dynamic_update_slice(
-        cache.k, mini.k, (0, row, 0, 0, 0)
-    )
-    v = jax.lax.dynamic_update_slice(
-        cache.v, mini.v, (0, row, 0, 0, 0)
-    )
-    lengths = cache.lengths.at[row].set(length)
+    k = cache.k.at[:, rows, :, :T].set(mini.k, mode="drop")
+    v = cache.v.at[:, rows, :, :T].set(mini.v, mode="drop")
+    new_lengths = cache.lengths.at[rows].set(lengths, mode="drop")
     last = jnp.take_along_axis(
-        logits, jnp.maximum(length - 1, 0)[None, None, None], axis=1
-    )[0, 0]
-    tok, logp = sample_logits(
-        last[None, :].astype(jnp.float32), rng, sampling
-    )
-    return KVCache(k=k, v=v, lengths=lengths), tok[0], logp[0]
+        logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+    )[:, 0]
+    tok, logp = sample_logits(last.astype(jnp.float32), rng, sampling)
+    return KVCache(k=k, v=v, lengths=new_lengths), tok, logp
 
 
 @partial(
     jax.jit,
     static_argnames=("cfg", "chunk_size", "stop_tokens", "sampling"),
+    donate_argnums=(2,),
 )
 def _decode_chunk(
     params,
@@ -118,17 +117,35 @@ def _decode_chunk(
 ):
     """Generate up to ``chunk_size`` tokens for all active rows device-side.
 
-    Returns (cache, out_tokens [B,K], out_logps [B,K], emitted [B,K] bool,
-    cur_tokens, active, budgets, rng).
+    Dispatches to the windowed :func:`transformer.decode_chunk` (one cache
+    scatter per chunk); sliding-window models fall back to the step-wise
+    loop.  Returns (cache, out_tokens [B,K], out_logps [B,K],
+    emitted [B,K] bool, cur_tokens, active, budgets, rng).
     """
     B = cur_tokens.shape[0]
-    S = cache.k.shape[2]
+    S = cache.max_len
 
     def is_stop(tok):
         stop = jnp.zeros_like(tok, dtype=bool)
         for s in stop_tokens:
             stop |= tok == s
         return stop
+
+    if cfg.sliding_window is None:
+        from areal_tpu.models.transformer import decode_chunk
+
+        return decode_chunk(
+            params,
+            cfg,
+            cache,
+            cur_tokens,
+            active,
+            budgets,
+            rng,
+            chunk_size,
+            lambda logits, sub: sample_logits(logits, sub, sampling),
+            is_stop,
+        )
 
     def body(i, state):
         cache, cur, active, budgets, out_t, out_l, emitted, rng = state
@@ -164,7 +181,7 @@ class ContinuousBatchingEngine:
         cfg: TransformerConfig,
         params,
         tokenizer=None,
-        max_batch: int = 8,
+        max_batch: int = 32,
         kv_cache_len: int = 4096,
         chunk_size: int = 16,
         sampling: Optional[SamplingParams] = None,
@@ -259,7 +276,8 @@ class ContinuousBatchingEngine:
 
     @property
     def has_work(self) -> bool:
-        return self.n_pending > 0 or bool(np.any(np.asarray(self.active)))
+        # host-side bookkeeping only — no device fetch
+        return self.n_pending > 0 or any(r is not None for r in self.rows)
 
     # -- engine loop (owner thread) ----------------------------------------
 
@@ -274,47 +292,63 @@ class ContinuousBatchingEngine:
         self.params = new_params
         self.version = getattr(self, "_target_version", self.version + 1)
         # recompute in-flight KV under the new weights (pause -> reload ->
-        # resume; reference patch interrupts and re-prefills continuations)
-        for row_id, row in enumerate(self.rows):
-            if row is None:
-                continue
-            # the pending cur_token (last generated) must stay OUT of the
-            # cache — the next decode_step writes its KV; re-prefill the rest
-            seq = (row.prompt + row.generated)[:-1]
-            self._prefill_into_row(row_id, seq, row.cur_token)
+        # resume; reference patch interrupts and re-prefills continuations).
+        # The pending cur_token (last generated) must stay OUT of the cache —
+        # the next decode_step writes its KV; re-prefill the rest, in ONE
+        # batched call for all in-flight rows.
+        entries = [
+            (row_id, (row.prompt + row.generated)[:-1])
+            for row_id, row in enumerate(self.rows)
+            if row is not None
+        ]
+        if entries:
+            self._prefill_rows(entries)
+            # keep the already-sampled pending tokens, discard the resamples
+            ids = np.array([rid for rid, _ in entries], np.int32)
+            curs = np.array(
+                [self.rows[rid].cur_token for rid, _ in entries], np.int32
+            )
+            self.cur_tokens = self.cur_tokens.at[ids].set(curs)
         logger.info(
             "weights updated to v%d (%d in-flight recomputed)",
             self.version,
             self.n_inflight,
         )
 
-    def _prefill_into_row(self, row_id: int, seq: List[int], cur_token: int):
-        T = bucket_len(max(len(seq), 1))
-        toks = np.zeros((1, T), np.int32)
-        toks[0, : len(seq)] = seq
+    def _prefill_rows(self, entries: List[Tuple[int, List[int]]]):
+        """Batched prefill of ``(row_id, token_seq)`` entries; returns the
+        per-entry sampled next token and its logprob (np arrays)."""
+        n = len(entries)
+        n_pad = 1 << (n - 1).bit_length()  # row-count bucket: fewer recompiles
+        T = bucket_len(max(max(len(seq) for _, seq in entries), 1))
+        toks = np.zeros((n_pad, T), np.int32)
+        lens = np.ones((n_pad,), np.int32)
+        rows = np.full((n_pad,), self.max_batch, np.int32)  # OOB -> dropped
+        for i, (rid, seq) in enumerate(entries):
+            toks[i, : len(seq)] = seq
+            lens[i] = len(seq)
+            rows[i] = rid
         self.rng, sub = jax.random.split(self.rng)
-        cache, tok, logp = _admit_row(
+        self.cache, tok, logp = _admit_rows(
             self.params,
             self.cfg,
             self.cache,
             jnp.asarray(toks),
-            jnp.asarray(len(seq), jnp.int32),
-            jnp.asarray(row_id, jnp.int32),
+            jnp.asarray(lens),
+            jnp.asarray(rows),
             sub,
             self.sampling,
         )
-        self.cache = cache
-        # keep the already-sampled pending token, discard the resample
-        self.cur_tokens = self.cur_tokens.at[row_id].set(cur_token)
+        return np.asarray(tok)[:n], np.asarray(logp)[:n]
 
     def _admit(self):
         free = [i for i, r in enumerate(self.rows) if r is None]
+        to_admit: List[Tuple[int, model_api.APIGenerateInput, List[int], int]] = []
         while free:
             with self._lock:
                 if not self._pending:
                     break
                 req = self._pending.pop(0)
-            row_id = free.pop(0)
             # input_ids = prompt + previously generated tokens (chunked
             # continuation); falls back to the bare prompt
             prompt = list(req.input_ids or req.prompt_ids)
@@ -329,28 +363,21 @@ class ContinuousBatchingEngine:
                     version_start=self.version,
                     no_eos=True,
                 )
-                free.insert(0, row_id)
-                self._finish(row_id, row, started=False)
+                self._finish(-1, row, started=False)
                 continue
             max_new = req.gconfig.max_new_tokens
             if len(prompt) + max_new > self.kv_cache_len:
                 max_new = max(1, self.kv_cache_len - len(prompt))
-            T = bucket_len(len(prompt))
-            toks = np.zeros((1, T), np.int32)
-            toks[0, : len(prompt)] = prompt
-            self.rng, sub = jax.random.split(self.rng)
-            cache, tok, logp = _admit_row(
-                self.params,
-                self.cfg,
-                self.cache,
-                jnp.asarray(toks),
-                jnp.asarray(len(prompt), jnp.int32),
-                jnp.asarray(row_id, jnp.int32),
-                sub,
-                self.sampling,
-            )
-            self.cache = cache
-            tok_i = int(tok)
+            to_admit.append((free.pop(0), req, prompt, max_new))
+        if not to_admit:
+            return
+        toks, logps = self._prefill_rows(
+            [(rid, prompt) for rid, _, prompt, _ in to_admit]
+        )
+        started_ids, started_curs, started_budgets = [], [], []
+        for (row_id, req, prompt, max_new), tok_i, logp in zip(
+            to_admit, toks.tolist(), logps.tolist()
+        ):
             row = _Row(
                 req=req,
                 prompt=prompt,
@@ -364,9 +391,18 @@ class ContinuousBatchingEngine:
                 continue
             row.cur_token = tok_i
             self.rows[row_id] = row
-            self.cur_tokens = self.cur_tokens.at[row_id].set(tok_i)
-            self.active = self.active.at[row_id].set(True)
-            self.budgets = self.budgets.at[row_id].set(max_new - 1)
+            started_ids.append(row_id)
+            started_curs.append(tok_i)
+            started_budgets.append(max_new - 1)
+        if started_ids:
+            ids = np.array(started_ids, np.int32)
+            self.cur_tokens = self.cur_tokens.at[ids].set(
+                np.array(started_curs, np.int32)
+            )
+            self.active = self.active.at[ids].set(True)
+            self.budgets = self.budgets.at[ids].set(
+                np.array(started_budgets, np.int32)
+            )
 
     def _finish(self, row_id: int, row: _Row, started: bool = True):
         out = model_api.APIGenerateOutput.from_input(row.req)
@@ -393,7 +429,7 @@ class ContinuousBatchingEngine:
             return 0
         self._apply_pending_weights()
         self._admit()
-        if not bool(np.any(np.asarray(self.active))):
+        if not any(r is not None for r in self.rows):
             return 0
         self.rng, sub = jax.random.split(self.rng)
         (
@@ -417,11 +453,11 @@ class ContinuousBatchingEngine:
             self.stop_tokens,
             self.sampling,
         )
-        out_t = np.asarray(out_t)
-        out_l = np.asarray(out_l)
-        emitted = np.asarray(emitted)
-        active = np.asarray(self.active)
-        cur = np.asarray(self.cur_tokens)
+        # ONE batched host fetch per chunk (separate np.asarray calls each
+        # paid a full tunnel/PCIe round-trip)
+        out_t, out_l, emitted, active, cur = jax.device_get(
+            (out_t, out_l, emitted, self.active, self.cur_tokens)
+        )
         n_tokens = 0
         for row_id, row in enumerate(self.rows):
             if row is None:

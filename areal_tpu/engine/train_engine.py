@@ -40,6 +40,13 @@ from areal_tpu.models.transformer import param_pspecs
 
 logger = logging_.getLogger("train_engine")
 
+def _fn_key(fn):
+    """Compile-cache key for a loss/fwd fn: closure factories set
+    ``fn._cache_key`` so fresh closures hit the cache; otherwise id() is used
+    (safe: the cache holds a strong reference, so ids are never recycled)."""
+    return getattr(fn, "_cache_key", None) or id(fn)
+
+
 # loss_fn(params, cfg, batch) -> (loss_sum, denom, stats_tree)
 LossFn = Callable[
     [Any, TransformerConfig, Dict[str, jax.Array]],
@@ -64,11 +71,14 @@ class TrainEngine:
         self.mesh = mesh
         self.optimizer_cfg = optimizer_cfg
 
+        from areal_tpu.parallel import distributed as dist
+
+        self._dist = dist
         self.pspecs = param_pspecs(model_cfg, params)
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), self.pspecs
         )
-        self.params = jax.device_put(params, self.param_shardings)
+        self.params = dist.tree_put_global(params, self.param_shardings)
 
         # batch rows shard over data axes; the token axis shards over ``seq``
         # when context parallelism is on (ring attention handles the halo)
@@ -86,9 +96,11 @@ class TrainEngine:
             self.tx = None
             self.opt_state = None
 
-        self._grad_step_cache: Dict[int, Callable] = {}
-        self._fwd_step_cache: Dict[int, Callable] = {}
-        self._apply_fn = None
+        # compiled-step caches hold a strong reference to the loss/fwd fn so
+        # the id()-based key can never be recycled by the GC (round-1 review
+        # flagged the bare-id() contract as fragile)
+        self._train_step_cache: Dict[Tuple, Tuple[Callable, Callable]] = {}
+        self._fwd_step_cache: Dict[int, Tuple[Callable, Callable]] = {}
         self.version = 0
 
     # -- helpers ------------------------------------------------------------
@@ -110,7 +122,7 @@ class TrainEngine:
             sharding = (
                 self.batch_sharding if v.ndim >= 2 else self.row_sharding
             )
-            out[k] = jax.device_put(v, sharding)
+            out[k] = self._dist.put_global(np.asarray(v), sharding)
         return out
 
     def _pad(self, sample: SequenceSample, token_key: str) -> batching.PaddedBatch:
@@ -123,16 +135,23 @@ class TrainEngine:
 
     # -- training -----------------------------------------------------------
 
-    def _get_grad_step(self, loss_fn: LossFn):
+    def _get_train_step(self, loss_fn: LossFn, n_mbs: int):
+        """One fused jitted step: grad-accumulate over ``n_mbs`` stacked
+        micro-batches (lax.scan), normalize, clip, and apply the optimizer
+        update — params/opt_state are donated, and every statistic stays on
+        device until the caller's single ``device_get``.
+
+        (Replaces the round-1 per-micro-batch dispatch whose ``float()``
+        syncs dominated the step time.)"""
         from areal_tpu.models import transformer
 
         transformer.set_ambient_mesh(self.mesh)  # for ring attention tracing
-        key = id(loss_fn)
-        if key not in self._grad_step_cache:
+        key = (_fn_key(loss_fn), n_mbs)
+        if key not in self._train_step_cache:
 
-            def step(params, batch):
+            def grad_of(params, mb):
                 def scalar_loss(p):
-                    loss_sum, denom, stats = loss_fn(p, self.model_cfg, batch)
+                    loss_sum, denom, stats = loss_fn(p, self.model_cfg, mb)
                     return loss_sum, (denom, stats)
 
                 (loss_sum, (denom, stats)), grads = jax.value_and_grad(
@@ -140,23 +159,95 @@ class TrainEngine:
                 )(params)
                 return grads, loss_sum, denom, stats
 
-            self._grad_step_cache[key] = jax.jit(
-                step, out_shardings=None
-            )
-        return self._grad_step_cache[key]
+            def step(params, opt_state, batch):
+                if n_mbs == 1:
+                    mb = jax.tree.map(lambda x: x[0], batch)
+                    grads, loss_sum, denom, stats = grad_of(params, mb)
+                else:
+                    mb0 = jax.tree.map(lambda x: x[0], batch)
+                    carry = grad_of(params, mb0)
 
-    def _get_apply(self):
-        if self._apply_fn is None:
+                    def body(carry, mb):
+                        g_acc, loss_acc, denom_acc, stats_acc = carry
+                        g, ls, dn, st = grad_of(params, mb)
+                        return (
+                            jax.tree.map(jnp.add, g_acc, g),
+                            loss_acc + ls,
+                            denom_acc + dn,
+                            jax.tree.map(jnp.add, stats_acc, st),
+                        ), None
 
-            def apply(params, opt_state, grads, denom):
-                grads = jax.tree.map(lambda g: g / denom, grads)
+                    rest = jax.tree.map(lambda x: x[1:], batch)
+                    (grads, loss_sum, denom, stats), _ = jax.lax.scan(
+                        body, carry, rest
+                    )
+                grads = jax.tree.map(
+                    lambda g: g / jnp.maximum(denom, 1e-8).astype(g.dtype),
+                    grads,
+                )
                 gnorm = optax.global_norm(grads)
                 updates, opt_state = self.tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
-                return params, opt_state, gnorm
+                out = {
+                    "stats": stats,
+                    "loss_sum": loss_sum,
+                    "denom": denom,
+                    "grad_norm": gnorm,
+                }
+                return params, opt_state, out
 
-            self._apply_fn = jax.jit(apply, donate_argnums=(0, 1, 2))
-        return self._apply_fn
+            self._train_step_cache[key] = (
+                jax.jit(step, donate_argnums=(0, 1)),
+                loss_fn,
+            )
+        return self._train_step_cache[key][0]
+
+    def _stack_batches(self, mbs, token_key: str):
+        """Pad every micro-batch to a common [B, T] and stack to [n, B, T]."""
+        seqlens = [
+            [l[0] for l in mb.seqlens[token_key]] for mb in mbs
+        ]
+        rows = max(
+            batching.pad_rows(max(len(s) for s in seqlens), self.dp_size),
+            self.dp_size,
+        )
+        T = batching.bucket_len(max(max(s) for s in seqlens))
+        pbs = [
+            batching.pad_batch(
+                mb, token_key=token_key, fixed_rows=rows, fixed_len=T
+            )
+            for mb in mbs
+        ]
+        batches = [
+            {
+                "tokens": pb.tokens,
+                "positions": pb.positions,
+                "seg_ids": pb.seg_ids,
+                "seq_lens": pb.seq_lens,
+                **pb.extras,
+            }
+            for pb in pbs
+        ]
+        # bucket the micro-batch count to the next power of two so
+        # token-budget splitting (data-dependent n_mbs) hits a bounded set
+        # of compiled steps; padding batches are all-zero (seg_ids 0 ->
+        # zero loss, zero denom, zero grads)
+        n_bucket = 1 << (len(batches) - 1).bit_length()
+        for _ in range(n_bucket - len(batches)):
+            batches.append(
+                {k: np.zeros_like(v) for k, v in batches[0].items()}
+            )
+        stacked = {
+            k: np.stack([b[k] for b in batches]) for k in batches[0]
+        }
+        out = {}
+        for k, v in stacked.items():
+            spec = (
+                self.batch_sharding.spec if v.ndim >= 3 else self.row_sharding.spec
+            )
+            sharding = NamedSharding(self.mesh, P(None, *spec))
+            out[k] = self._dist.put_global(v, sharding)
+        return out, pbs
 
     def train_batch(
         self,
@@ -168,36 +259,24 @@ class TrainEngine:
         """Micro-batched, grad-accumulated train step over ``sample``."""
         assert self.tx is not None, "engine built without an optimizer"
         mbs, *_ = sample.split(mb_spec)
-        grad_step = self._get_grad_step(loss_fn)
-
-        grads = None
-        total_loss = 0.0
-        total_denom = None
-        host_stats: Dict[str, float] = {}
-        for mb in mbs:
-            pb = self._pad(mb, token_key)
-            batch = self._device_batch(pb)
-            g, loss_sum, denom, stats = grad_step(self.params, batch)
-            if grads is None:
-                grads, total_denom = g, denom
-            else:
-                grads = jax.tree.map(jnp.add, grads, g)
-                total_denom = total_denom + denom
-            total_loss += float(loss_sum)
-            for k, v in jax.tree.leaves_with_path(stats):
-                name = "/".join(
-                    p.key if hasattr(p, "key") else str(p) for p in k
-                )
-                host_stats[name] = host_stats.get(name, 0.0) + float(v)
-
-        self.params, self.opt_state, gnorm = self._get_apply()(
-            self.params, self.opt_state, grads, total_denom
+        batch, _ = self._stack_batches(mbs, token_key)
+        n_mbs = next(iter(batch.values())).shape[0]  # bucketed count
+        step = self._get_train_step(loss_fn, n_mbs)
+        self.params, self.opt_state, out = step(
+            self.params, self.opt_state, batch
         )
         self.version += 1
-        denom_f = float(total_denom)
+        out = jax.device_get(out)  # ONE host sync per train step
+        denom_f = float(out["denom"])
+        host_stats: Dict[str, float] = {}
+        for k, v in jax.tree.leaves_with_path(out["stats"]):
+            name = "/".join(
+                p.key if hasattr(p, "key") else str(p) for p in k
+            )
+            host_stats[name] = float(v)
         host_stats.update(
-            loss=total_loss / max(denom_f, 1e-8),
-            grad_norm=float(gnorm),
+            loss=float(out["loss_sum"]) / max(denom_f, 1e-8),
+            grad_norm=float(out["grad_norm"]),
             n_tokens=denom_f,
             n_mbs=len(mbs),
         )
@@ -209,12 +288,15 @@ class TrainEngine:
         from areal_tpu.models import transformer
 
         transformer.set_ambient_mesh(self.mesh)
-        key = id(fwd_fn)
+        key = _fn_key(fwd_fn)
         if key not in self._fwd_step_cache:
-            self._fwd_step_cache[key] = jax.jit(
-                lambda params, batch: fwd_fn(params, self.model_cfg, batch)
+            self._fwd_step_cache[key] = (
+                jax.jit(
+                    lambda params, batch: fwd_fn(params, self.model_cfg, batch)
+                ),
+                fwd_fn,
             )
-        return self._fwd_step_cache[key]
+        return self._fwd_step_cache[key][0]
 
     def forward_batch(
         self,
@@ -234,7 +316,7 @@ class TrainEngine:
         for mb in mbs:
             pb = self._pad(mb, token_key)
             batch = self._device_batch(pb)
-            out = np.asarray(step(self.params, batch))
+            out = self._dist.host_gather(step(self.params, batch))
             packed_parts.append(
                 batching.unpad_per_token(
                     out, pb.seq_lens, pb.n_real, shift=output_shift
@@ -251,11 +333,13 @@ class TrainEngine:
     # -- weights ------------------------------------------------------------
 
     def get_host_params(self):
-        """Gather full params to host numpy (for HF export / weight sync)."""
-        return jax.tree.map(lambda x: np.asarray(x), self.params)
+        """Gather full params to host numpy (for HF export / weight sync);
+        multi-host safe (process_allgather under the hood when sharded
+        across processes)."""
+        return self._dist.tree_host_gather(self.params)
 
     def set_params(self, params):
-        self.params = jax.device_put(params, self.param_shardings)
+        self.params = self._dist.tree_put_global(params, self.param_shardings)
 
     def save_hf(self, path: str, family: str, tokenizer=None):
         from areal_tpu.models.hf import save_hf_model

@@ -67,6 +67,9 @@ def model_logprobs_fwd(temperature: float = 1.0):
         )
         return jnp.pad(logp.reshape(B, T - 1), ((0, 0), (0, 1)))
 
+    # stable compile-cache key: a fresh closure per call must NOT defeat the
+    # engine's jit cache (one recompile per PPO step otherwise)
+    fn._cache_key = ("model_logprobs_fwd", float(temperature))
     return fn
 
 

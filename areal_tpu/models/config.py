@@ -54,11 +54,19 @@ class TransformerConfig:
     # rematerialize each layer in backward (jax.checkpoint over the layer
     # scan) — trades FLOPs for activation memory, standard for training.
     remat: bool = False
+    # what the layer-checkpoint keeps: "none" = full recompute;
+    # "qkv_attn" = save q/k/v projections + attention output (skips the
+    # attention-block recompute in backward at ~200MB/layer for 32k tokens);
+    # "dots" = save every matmul output (cheapest backward, most memory).
+    remat_policy: str = "none"
 
     def __post_init__(self):
         assert self.n_q_heads % self.n_kv_heads == 0
         assert self.activation in ("silu", "gelu")
         assert self.norm_type in ("rms", "layer")
+        assert self.remat_policy in ("none", "qkv_attn", "dots"), (
+            f"unknown remat_policy {self.remat_policy!r}"
+        )
 
     @property
     def q_dim(self) -> int:

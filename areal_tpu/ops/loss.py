@@ -28,11 +28,22 @@ def _chunk_logp_ent(h, w, labels):
     return logp, entropy
 
 
+def _chunk_logp(h, w, labels):
+    """Logprob only — skips the full-vocab entropy passes (saves several
+    f32 [C, V] HBM round-trips when the caller discards entropy)."""
+    logits = (h @ w).astype(jnp.float32)  # [C, V]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    logp = tgt - lse
+    return logp, jnp.zeros_like(logp)
+
+
 def per_token_logprobs_entropy(
     hidden: jax.Array,  # [N, D] hidden states (pre final-head)
     head_w: jax.Array,  # [D, V]
     labels: jax.Array,  # [N]
     chunk_size: int = 1024,
+    with_entropy: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Chunk-scanned (logprob, entropy) per token; differentiable w.r.t.
     ``hidden`` and ``head_w`` with chunk-local logits rematerialized in the
@@ -45,7 +56,7 @@ def per_token_logprobs_entropy(
     h = h.reshape(n_chunks, chunk_size, D)
     lab = lab.reshape(n_chunks, chunk_size)
 
-    f = jax.checkpoint(partial(_chunk_logp_ent))
+    f = jax.checkpoint(_chunk_logp_ent if with_entropy else _chunk_logp)
 
     def body(_, xs):
         hc, lc = xs
@@ -63,6 +74,8 @@ def masked_cross_entropy(
     chunk_size: int = 1024,
 ) -> Tuple[jax.Array, jax.Array]:
     """(summed NLL over masked tokens, token count).  Mean = sum/count."""
-    logp, _ = per_token_logprobs_entropy(hidden, head_w, labels, chunk_size)
+    logp, _ = per_token_logprobs_entropy(
+        hidden, head_w, labels, chunk_size, with_entropy=False
+    )
     mask = mask.astype(jnp.float32)
     return -jnp.sum(logp * mask), jnp.sum(mask)

@@ -1,0 +1,94 @@
+"""Multi-host SPMD substrate: jax.distributed + global-array helpers.
+
+The reference bootstraps NCCL process groups by hand (reference:
+realhf/impl/model/comm/global_comm.py:48-150 — peers register in
+name_resolve, a master is elected, ``init_process_group``).  The TPU-native
+equivalent is ``jax.distributed.initialize`` + ONE global mesh whose axes
+span all hosts' devices: XLA inserts every collective (over ICI within a
+slice, DCN across slices) from sharding annotations; the per-(dp,tp,pp)
+subgroup zoo disappears.
+
+Every process must execute the same jitted computation (multi-controller
+SPMD); host data enters via :func:`put_global`, which handles shardings
+that span non-addressable devices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("distributed")
+
+
+def initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+) -> None:
+    """Join the jax.distributed cluster (idempotent)."""
+    from jax._src import distributed as _jd
+
+    if getattr(_jd.global_state, "client", None) is not None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed up: process %d/%d, %d global / %d local devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+        len(jax.local_devices()),
+    )
+
+
+def initialize_from_env() -> bool:
+    """Initialize from AREAL_JAX_* env vars (set by the launcher); returns
+    whether distributed mode is active."""
+    coord = os.environ.get("AREAL_JAX_COORDINATOR")
+    if not coord:
+        return False
+    initialize(
+        coord,
+        int(os.environ["AREAL_JAX_NUM_PROCESSES"]),
+        int(os.environ["AREAL_JAX_PROCESS_ID"]),
+    )
+    return True
+
+
+def put_global(value: np.ndarray, sharding) -> jax.Array:
+    """Place a host array onto a (possibly multi-host) sharding.
+
+    Every process passes the SAME full array (our MFC dispatch delivers the
+    full batch to every SPMD peer); each process donates only its
+    addressable shards."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(value, sharding)
+    return jax.make_array_from_callback(
+        value.shape, sharding, lambda idx: value[idx]
+    )
+
+
+def host_gather(x: jax.Array) -> np.ndarray:
+    """Fetch a (possibly multi-host-sharded) array fully to host."""
+    if x.is_fully_addressable:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def tree_put_global(tree, shardings):
+    return jax.tree.map(put_global, tree, shardings)
+
+
+def tree_host_gather(tree):
+    return jax.tree.map(host_gather, tree)

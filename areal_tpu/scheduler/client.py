@@ -1,0 +1,219 @@
+"""Scheduler clients: submit/monitor/stop arrays of worker processes.
+
+Rebuild of the reference's scheduler layer (reference:
+realhf/scheduler/client.py:52 ``SchedulerClient`` ABC,
+realhf/scheduler/local/client.py:71 ``LocalSchedulerClient`` — subprocess
+spawn + wait loop; the slurm client realhf/scheduler/slurm/client.py maps to
+whatever cluster scheduler fronts the TPU pod and is out of scope for a
+single-host image, its submit/wait contract is identical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("scheduler")
+
+
+class JobState(str, enum.Enum):
+    NOT_FOUND = "NOT_FOUND"
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+
+@dataclasses.dataclass
+class JobInfo:
+    name: str
+    state: JobState
+    host: str = "localhost"
+    pid: Optional[int] = None
+    exit_code: Optional[int] = None
+
+
+class JobException(Exception):
+    def __init__(self, run_name: str, worker_type: str, host: str, reason: JobState):
+        super().__init__(
+            f"Job {run_name}:{worker_type} {reason} on {host}"
+        )
+        self.run_name = run_name
+        self.worker_type = worker_type
+        self.host = host
+        self.reason = reason
+
+
+class SchedulerClient:
+    """Submit/stop/wait worker arrays (reference client.py:52)."""
+
+    def __init__(self, expr_name: str, trial_name: str):
+        self.expr_name = expr_name
+        self.trial_name = trial_name
+        self.run_name = f"{expr_name}/{trial_name}"
+
+    def submit(self, worker_type: str, cmd: Sequence[str], **kwargs) -> None:
+        raise NotImplementedError()
+
+    def submit_array(
+        self, worker_type: str, cmd_list: Sequence[Sequence[str]], **kwargs
+    ) -> None:
+        for cmd in cmd_list:
+            self.submit(worker_type, cmd, **kwargs)
+
+    def stop_all(self) -> None:
+        raise NotImplementedError()
+
+    def find_all(self) -> List[JobInfo]:
+        raise NotImplementedError()
+
+    def wait(
+        self,
+        timeout: Optional[float] = None,
+        check_status: Sequence[JobState] = (
+            JobState.CANCELLED,
+            JobState.FAILED,
+            JobState.NOT_FOUND,
+        ),
+        remove_status: Sequence[JobState] = (JobState.COMPLETED,),
+        update: bool = False,
+    ) -> None:
+        raise NotImplementedError()
+
+
+class LocalSchedulerClient(SchedulerClient):
+    """Spawn each worker as a local subprocess (reference local/client.py:71).
+
+    On a TPU pod one process per HOST is the launch unit (each process
+    drives all its local chips via jax); this client is both the dev-box
+    scheduler and the per-host agent a cluster scheduler would invoke.
+    """
+
+    def __init__(self, expr_name: str, trial_name: str, env: Optional[Dict] = None):
+        super().__init__(expr_name, trial_name)
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._jobs: Dict[str, JobInfo] = {}
+        self._env = dict(os.environ)
+        if env:
+            self._env.update(env)
+        self._counter: Dict[str, int] = {}
+
+    def submit(
+        self,
+        worker_type: str,
+        cmd: Sequence[str],
+        env: Optional[Dict] = None,
+        log_path: Optional[str] = None,
+        **kwargs,
+    ) -> None:
+        idx = self._counter.get(worker_type, 0)
+        self._counter[worker_type] = idx + 1
+        name = f"{worker_type}/{idx}"
+        penv = dict(self._env)
+        if env:
+            penv.update(env)
+        stdout = None
+        if log_path:
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            stdout = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                list(cmd),
+                env=penv,
+                stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None,
+                start_new_session=True,
+            )
+        finally:
+            if stdout is not None:
+                stdout.close()  # the child holds its own copy
+        self._procs[name] = proc
+        self._jobs[name] = JobInfo(
+            name=name, state=JobState.RUNNING, pid=proc.pid
+        )
+        logger.info("submitted %s pid=%d: %s", name, proc.pid, " ".join(cmd))
+
+    def _refresh(self):
+        for name, proc in self._procs.items():
+            job = self._jobs[name]
+            if job.state not in (JobState.RUNNING, JobState.PENDING):
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue
+            job.exit_code = rc
+            job.state = JobState.COMPLETED if rc == 0 else JobState.FAILED
+
+    def stop_all(self) -> None:
+        self._refresh()
+        for name, proc in self._procs.items():
+            if self._jobs[name].state == JobState.RUNNING:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.monotonic() + 10
+        for name, proc in self._procs.items():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            if self._jobs[name].state == JobState.RUNNING:
+                self._jobs[name].state = JobState.CANCELLED
+
+    def find_all(self) -> List[JobInfo]:
+        self._refresh()
+        return list(self._jobs.values())
+
+    def wait(
+        self,
+        timeout: Optional[float] = None,
+        check_status: Sequence[JobState] = (
+            JobState.CANCELLED,
+            JobState.FAILED,
+            JobState.NOT_FOUND,
+        ),
+        remove_status: Sequence[JobState] = (JobState.COMPLETED,),
+        update: bool = False,
+    ) -> None:
+        """Block until every job leaves via ``remove_status``; raise
+        ``JobException`` the moment any job hits a ``check_status``."""
+        deadline = time.monotonic() + timeout if timeout else None
+        remaining = set(self._jobs)
+        while remaining:
+            self._refresh()
+            for name in list(remaining):
+                job = self._jobs[name]
+                if job.state in check_status:
+                    raise JobException(
+                        self.run_name, name, job.host, job.state
+                    )
+                if job.state in remove_status:
+                    remaining.discard(name)
+            if not remaining:
+                return
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"jobs still running at timeout: {sorted(remaining)}"
+                )
+            time.sleep(0.2)
+
+
+def make_scheduler(
+    mode: str, expr_name: str, trial_name: str, **kwargs
+) -> SchedulerClient:
+    if mode == "local":
+        return LocalSchedulerClient(expr_name, trial_name, **kwargs)
+    raise ValueError(f"unknown scheduler mode {mode!r}")

@@ -123,7 +123,12 @@ def test_decode_inactive_rows_frozen(cfg, params):
     )
     assert int(cache2.lengths[0]) == 4
     assert int(cache2.lengths[1]) == 3
-    np.testing.assert_array_equal(cache2.k[:, 1], cache.k[:, 1])
+    # the VALID region [0, length) of the inactive row must be untouched
+    # (slots beyond it may hold garbage by design — they are overwritten
+    # before ever becoming visible to attention)
+    np.testing.assert_array_equal(
+        cache2.k[:, 1, :, :3], cache.k[:, 1, :, :3]
+    )
 
 
 def test_logprobs_of_labels(cfg, params):
