@@ -148,6 +148,13 @@ def _monitor(
     status_key = names.worker_status(
         cfg.experiment_name, cfg.trial_name, master_name
     )
+    all_names = [w for _, _, w in specs]
+    # beats come from a daemon thread, so this is a process-liveness bound
+    # (not an MFC-duration bound); the scheduler catches clean process death
+    # faster, heartbeats catch hosts that vanish without reaping
+    hb_timeout = float(os.environ.get("AREAL_HEARTBEAT_TIMEOUT", "60"))
+    panel = WorkerControlPanel(cfg.experiment_name, cfg.trial_name)
+    last_hb_check = time.monotonic()
     while True:
         for job in sched.find_all():
             if job.state == JobState.FAILED:
@@ -164,12 +171,24 @@ def _monitor(
             raise JobException(
                 sched.run_name, master_name, "?", JobState.FAILED
             )
+        if time.monotonic() - last_hb_check > 10.0:
+            last_hb_check = time.monotonic()
+            stale = panel.find_stale_workers(all_names, timeout=hb_timeout)
+            if stale:
+                for w in stale:
+                    logger.error(
+                        "worker %s heartbeat stale > %.0fs; declaring LOST",
+                        w,
+                        hb_timeout,
+                    )
+                raise JobException(
+                    sched.run_name, stale[0], "?", JobState.FAILED
+                )
         if deadline and time.monotonic() > deadline:
             raise TimeoutError("experiment timed out")
         time.sleep(0.5)
 
     # master done: ask everyone else to exit, then reap
-    panel = WorkerControlPanel(cfg.experiment_name, cfg.trial_name)
     others = [w for t, i, w in specs if w != master_name]
     try:
         panel.connect(others, timeout=10)

@@ -23,6 +23,12 @@ def worker_status(experiment_name: str, trial_name: str, worker_name: str) -> st
     return f"{trial_root(experiment_name, trial_name)}/status/{worker_name}"
 
 
+def worker_heartbeat(
+    experiment_name: str, trial_name: str, worker_name: str
+) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/heartbeat/{worker_name}"
+
+
 def worker_root(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/worker/"
 

@@ -1,0 +1,96 @@
+"""Analytic FLOPs accounting per model function call.
+
+Rebuild of the reference's FLOPs counter (reference:
+realhf/system/flops_counter.py — per-MFC llama FLOPs used by the master's
+throughput logging, surfaced via master_worker._log_training_stats :497).
+Ours computes from :class:`TransformerConfig` directly (no hardcoded llama
+shape assumptions), counts GQA and MoE correctly, and runs worker-side where
+the exact packed seqlens are known; the master only aggregates.
+
+Conventions: one MAC = 2 FLOPs; causal attention scores/values cost
+``2 * 2 * T_kv/2`` per query token on average (the causal triangle); the
+backward pass is 2x forward (grads wrt inputs and weights).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from areal_tpu.models.config import TransformerConfig
+
+
+def matmul_params_per_layer(cfg: TransformerConfig) -> int:
+    """Weight-matrix parameters touched per token per layer (excludes
+    norms/embeddings; MoE counts only the activated experts)."""
+    attn = cfg.hidden_dim * (cfg.q_dim + 2 * cfg.kv_dim) + cfg.q_dim * cfg.hidden_dim
+    if cfg.is_moe:
+        inter = cfg.moe_intermediate_dim or cfg.intermediate_dim
+        n_mats = 3 if cfg.gated_mlp else 2
+        mlp = cfg.n_experts_per_tok * n_mats * cfg.hidden_dim * inter
+        router = cfg.hidden_dim * cfg.n_experts
+        return attn + mlp + router
+    n_mats = 3 if cfg.gated_mlp else 2
+    return attn + n_mats * cfg.hidden_dim * cfg.intermediate_dim
+
+
+def forward_flops(
+    cfg: TransformerConfig,
+    seqlens: Sequence[int],
+    with_head: bool = True,
+) -> int:
+    """FLOPs of one forward pass over packed sequences.
+
+    Per token: 2 * (matmul params) for the projections, plus causal
+    attention ~ 2 * 2 * (t/2) * q_dim accumulated over each sequence of
+    length t, plus the output head."""
+    total_tokens = sum(seqlens)
+    flops = 2 * matmul_params_per_layer(cfg) * cfg.n_layers * total_tokens
+    # causal attention: sum_t 4 * q_dim * t/2 = q_dim * t*(t+1) ~= q_dim*t^2
+    for t in seqlens:
+        flops += 2 * cfg.n_layers * cfg.q_dim * t * t
+    if with_head:
+        out_dim = 1 if cfg.is_critic else cfg.vocab_size
+        flops += 2 * cfg.hidden_dim * out_dim * total_tokens
+    return flops
+
+
+def train_flops(cfg: TransformerConfig, seqlens: Sequence[int]) -> int:
+    """Forward + backward (2x forward)."""
+    return 3 * forward_flops(cfg, seqlens)
+
+
+def generate_flops(
+    cfg: TransformerConfig,
+    prompt_lens: Sequence[int],
+    gen_lens: Sequence[int],
+) -> int:
+    """Prefill of each prompt + per-token decode over the growing cache."""
+    flops = forward_flops(cfg, prompt_lens, with_head=False)
+    per_tok_mats = 2 * matmul_params_per_layer(cfg) * cfg.n_layers
+    out_dim = 1 if cfg.is_critic else cfg.vocab_size
+    head = 2 * cfg.hidden_dim * out_dim
+    for p, g in zip(prompt_lens, gen_lens):
+        # decode token i attends to p+i cached positions
+        avg_ctx = p + g / 2.0
+        flops += int(
+            g * (per_tok_mats + head + 4 * cfg.n_layers * cfg.q_dim * avg_ctx)
+        )
+    return flops
+
+
+def mfc_flops(
+    handle: str,
+    cfg: TransformerConfig,
+    seqlens: Sequence[int],
+    prompt_lens: Sequence[int] | None = None,
+) -> int:
+    """FLOPs for one MFC given the handle kind and the *output* seqlens.
+
+    For ``generate``, ``seqlens`` are the full prompt+response lengths and
+    ``prompt_lens`` the prompt parts."""
+    if handle == "train_step":
+        return train_flops(cfg, seqlens)
+    if handle == "generate" and prompt_lens is not None:
+        gen_lens = [s - p for s, p in zip(seqlens, prompt_lens)]
+        return generate_flops(cfg, prompt_lens, gen_lens)
+    return forward_flops(cfg, seqlens)

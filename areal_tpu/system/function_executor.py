@@ -209,7 +209,17 @@ class FunctionExecutor:
         if rpc.log_return_value:
             logger.info("MFC %s -> %s", rpc.name, stats)
         with stats_tracker.scope(rpc.name):
-            stats_tracker.scalar(elapsed=reply.get("elapsed", 0.0))
+            elapsed = reply.get("elapsed", 0.0)
+            stats_tracker.scalar(elapsed=elapsed)
+            # per-MFC throughput from the worker's analytic accounting
+            # (reference: realhf/system/flops_counter.py); tflops is
+            # per-worker-group since every SPMD peer ran the same FLOPs
+            if "flops" in reply and elapsed > 0:
+                stats_tracker.scalar(
+                    tflops=reply["flops"] / elapsed / 1e12,
+                    tokens_per_sec=reply.get("n_tokens", 0) / elapsed,
+                    n_tokens=float(reply.get("n_tokens", 0)),
+                )
         return stats
 
     # -- one full step ------------------------------------------------------

@@ -65,6 +65,13 @@ class MasterWorker(worker_base.AsyncWorker):
         )
         self.stats: Dict[str, Any] = {}
         self.stats_history = []
+        from areal_tpu.base.metrics import MetricsLogger
+
+        self._metrics = MetricsLogger(
+            constants.get_log_path(),
+            experiment_name=constants.experiment_name(),
+            trial_name=constants.trial_name(),
+        )
 
     async def _lazy_init(self):
         cfg = self.config
@@ -189,9 +196,12 @@ class MasterWorker(worker_base.AsyncWorker):
         step = self._step_info
 
         stats["time_perf/e2e"] = elapsed
+        # master-side per-MFC tracking (elapsed / tflops / tok_s recorded by
+        # the executor) joins the worker-reported interface stats
+        stats.update(stats_tracker.export())
         self.stats = stats
         self.stats_history.append(stats)
-        tracked = stats_tracker.export()
+        self._metrics.log(stats, step.global_step)
         self.logger.info(
             "step %d (epoch %d, %.2fs): %s",
             step.global_step,
@@ -199,7 +209,6 @@ class MasterWorker(worker_base.AsyncWorker):
             elapsed,
             {k: round(v, 4) for k, v in stats.items() if isinstance(v, float)},
         )
-        del tracked
 
         if self._eval_ctl.check(epochs=epochs_passed, steps=1):
             await self._run_eval()
@@ -253,3 +262,5 @@ class MasterWorker(worker_base.AsyncWorker):
             self._router.stop()
         if hasattr(self, "_stream"):
             self._stream.close()
+        if hasattr(self, "_metrics"):
+            self._metrics.close()

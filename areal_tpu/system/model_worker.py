@@ -308,19 +308,34 @@ class ModelWorker(worker_base.Worker):
             return {"stats": res, "elapsed": 0.0}
         data = self._data_manager.get_batch(ids, input_keys)
 
+        # optional per-MFC profiling (reference: the torch.profiler wrap in
+        # realhf/system/model_worker.py:829 __maybe_profile_rpc); set
+        # AREAL_PROFILE_DIR to collect an xplane trace per MFC kind
+        profile_dir = os.environ.get("AREAL_PROFILE_DIR")
+        prof_ctx = None
+        if profile_dir:
+            prof_ctx = jax.profiler.trace(
+                os.path.join(profile_dir, rpc_name)
+            )
+            prof_ctx.__enter__()
         tik = time.monotonic()
         res: Any = None
-        if handle == "train_step":
-            res = interface.train_step(model, data, mb_spec)
-        elif handle == "inference":
-            res = interface.inference(model, data, mb_spec)
-        elif handle == "generate":
-            res = interface.generate(model, data, mb_spec)
-        else:
-            raise ValueError(f"unknown MFC handle {handle}")
+        try:
+            if handle == "train_step":
+                res = interface.train_step(model, data, mb_spec)
+            elif handle == "inference":
+                res = interface.inference(model, data, mb_spec)
+            elif handle == "generate":
+                res = interface.generate(model, data, mb_spec)
+            else:
+                raise ValueError(f"unknown MFC handle {handle}")
+        finally:
+            if prof_ctx is not None:
+                prof_ctx.__exit__(None, None, None)
         elapsed = time.monotonic() - tik
 
         reply: Dict = {"elapsed": elapsed}
+        reply.update(self._mfc_flops_stats(model, handle, data, res))
         if isinstance(res, SequenceSample):
             self._data_manager.store(res)
             reply["meta"] = res.meta()
@@ -328,6 +343,64 @@ class ModelWorker(worker_base.Worker):
         elif isinstance(res, dict):
             reply["stats"] = res
         return reply
+
+    def _mfc_flops_stats(self, model, handle: str, data, res) -> Dict:
+        """Analytic FLOPs + token count for the master's throughput logs
+        (reference: realhf/system/flops_counter.py feeding
+        master_worker._log_training_stats)."""
+        from areal_tpu.system import flops_counter
+
+        cfg = getattr(model, "model_cfg", None)
+        if cfg is None:
+            return {}
+
+        def _lens(sample, key):
+            return [sum(l) for l in sample.seqlens[key]]
+
+        try:
+            if handle == "generate" and isinstance(res, SequenceSample):
+                key = (
+                    "packed_input_ids"
+                    if "packed_input_ids" in res.keys
+                    else sorted(res.keys)[0]
+                )
+                # per-ANSWER lengths: grouped sampling stores n answers per
+                # id, each an independent prefill+decode over its own cache
+                full = [
+                    int(l)
+                    for per_id in res.seqlens[key]
+                    for l in per_id
+                ]
+                pkey = next(
+                    (
+                        k
+                        for k in ("packed_prompts", "packed_input_ids")
+                        if k in data.keys
+                    ),
+                    None,
+                )
+                prompts = []
+                if pkey:
+                    for per_id, out_per_id in zip(
+                        data.seqlens[pkey], res.seqlens[key]
+                    ):
+                        prompts.extend([int(sum(per_id))] * len(out_per_id))
+                else:
+                    prompts = [0] * len(full)
+                fl = flops_counter.mfc_flops(handle, cfg, full, prompts)
+                n_tokens = sum(full)
+            else:
+                key = (
+                    "packed_input_ids"
+                    if "packed_input_ids" in data.keys
+                    else sorted(data.keys)[0]
+                )
+                lens = _lens(data, key)
+                fl = flops_counter.mfc_flops(handle, cfg, lens)
+                n_tokens = sum(lens)
+        except Exception:  # noqa: BLE001 - accounting must never kill an MFC
+            return {}
+        return {"flops": fl, "n_tokens": n_tokens}
 
     # -- poll ---------------------------------------------------------------
 

@@ -50,6 +50,13 @@ class WorkerException(Exception):
         self.worker_status = worker_status
 
 
+#: Seconds between heartbeat writes; a worker is declared LOST after
+#: missing several beats (reference: the heartbeat/watch keys that
+#: realhf/system/worker_base.py:701-708 maintains in name_resolve).
+HEARTBEAT_INTERVAL = 2.0
+HEARTBEAT_TIMEOUT = 30.0
+
+
 class WorkerServer:
     """Per-worker ZMQ REP command socket; address registered in name_resolve
     (reference: worker_base.py WorkerServer + worker_control.py)."""
@@ -72,6 +79,30 @@ class WorkerServer:
             experiment_name, trial_name, worker_name
         )
         name_resolve.add(self._status_key, self._status.value, replace=True)
+        self._heartbeat_key = names.worker_heartbeat(
+            experiment_name, trial_name, worker_name
+        )
+        self.beat()
+        # beats come from a daemon thread, NOT the poll loop: a single poll
+        # legitimately blocks for a whole MFC / train step / jit compile, so
+        # the heartbeat is a process-liveness signal (process death and
+        # worker-level errors are caught by the scheduler and the status key)
+        self._beat_stop = threading.Event()
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, daemon=True, name=f"beat-{worker_name}"
+        )
+        self._beat_thread.start()
+
+    def beat(self):
+        """Write a liveness timestamp."""
+        name_resolve.add(self._heartbeat_key, str(time.time()), replace=True)
+
+    def _beat_loop(self):
+        while not self._beat_stop.wait(HEARTBEAT_INTERVAL):
+            try:
+                self.beat()
+            except Exception:  # noqa: BLE001 - dying beats = declared LOST
+                logger.warning("heartbeat write failed", exc_info=True)
 
     def register_handler(self, command: str, fn):
         self._handlers[command] = fn
@@ -107,6 +138,7 @@ class WorkerServer:
             self._sock.send(pickle.dumps(resp))
 
     def close(self):
+        self._beat_stop.set()
         self._sock.close(linger=0)
 
 
@@ -167,6 +199,39 @@ class WorkerControlPanel:
             return WorkerServerStatus(val)
         except name_resolve.NameEntryNotFoundError:
             return WorkerServerStatus.LOST
+
+    def get_heartbeat_age(self, worker_name: str) -> Optional[float]:
+        """Seconds since the worker's last heartbeat, or None if it never
+        beat (a worker that never registered can't be declared lost yet)."""
+        try:
+            ts = float(
+                name_resolve.get(
+                    names.worker_heartbeat(
+                        self.experiment_name, self.trial_name, worker_name
+                    )
+                )
+            )
+        except name_resolve.NameEntryNotFoundError:
+            return None
+        return max(0.0, time.time() - ts)
+
+    def find_stale_workers(
+        self, worker_names: List[str], timeout: float = HEARTBEAT_TIMEOUT
+    ) -> List[str]:
+        """Workers whose heartbeat is older than ``timeout`` and whose status
+        is not terminal — i.e. they should be alive but have stopped beating."""
+        stale = []
+        for w in worker_names:
+            status = self.get_worker_status(w)
+            if status in (
+                WorkerServerStatus.COMPLETED,
+                WorkerServerStatus.ERROR,
+            ):
+                continue
+            age = self.get_heartbeat_age(w)
+            if age is not None and age > timeout:
+                stale.append(w)
+        return stale
 
     def close(self):
         for s in self._socks.values():

@@ -69,7 +69,9 @@ def test_sync_ppo_full_graph(dataset_path, tokenizer_path, tmp_path, monkeypatch
     assert np.isfinite(s["actor_train/loss"])
     assert np.isfinite(s["critic_train/loss"])
     assert "actor_train/kl" in s
-    assert "rew_inf/elapsed" not in s  # stats come from worker stats dicts
+    # per-MFC tracking (elapsed/tflops) merged from the master's tracker
+    assert "rew_inf/elapsed" in s
+    assert s.get("actor_train/tflops", 0.0) > 0.0
 
 
 def test_sync_ppo_grpo_style(dataset_path, tokenizer_path, tmp_path, monkeypatch):
