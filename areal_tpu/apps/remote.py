@@ -72,6 +72,15 @@ def run_worker(
     from areal_tpu.base import constants, name_resolve
     from areal_tpu.system.worker_base import AsyncWorker, make_server
 
+    # hermetic platform pinning for CPU-mesh tests and mixed fleets: the env
+    # var alone can lose to an eagerly-registered platform plugin, so also
+    # set jax.config (same pattern as tests/conftest.py)
+    platform = os.environ.get("AREAL_JAX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
     name_resolve.reconfigure(
         os.environ.get("AREAL_NAME_RESOLVE", "nfs"),
     )

@@ -1,0 +1,102 @@
+"""One SPMD controller process of a multi-host dry run.
+
+Joins a jax.distributed cluster (``n_local`` virtual CPU devices per
+process), builds the global mesh with real tp/fsdp/dp axes spanning all
+processes, runs full TrainEngine train steps plus a logprob forward pass,
+and prints a JSON line the parent cross-checks across processes — every
+controller must compute identical global losses (the TPU-native equivalent
+of the reference's multi-node NCCL bootstrap,
+realhf/impl/model/comm/global_comm.py:48).
+
+Usage: ``python -m areal_tpu.parallel.dryrun_worker COORD NPROCS PROC_ID
+[N_LOCAL_DEVICES]``
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    coordinator, num_procs, proc_id = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+    )
+    n_local = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_local}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from areal_tpu.parallel import distributed as dist
+
+    dist.initialize(coordinator, num_procs, proc_id)
+    assert jax.process_count() == num_procs
+    assert len(jax.devices()) == n_local * num_procs, len(jax.devices())
+
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.engine.train_engine import TrainEngine
+    from areal_tpu.interfaces.sft_interface import sft_loss_fn
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+
+    n_total = n_local * num_procs
+    model = 2 if n_total % 2 == 0 else 1
+    fsdp = 2 if (n_total // model) % 2 == 0 else 1
+    data = n_total // model // fsdp
+    spec = MeshSpec(data=data, fsdp=fsdp, model=model)
+    mesh = spec.make_mesh(jax.devices())
+    cfg = tiny_config(vocab_size=128)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    engine = TrainEngine(
+        cfg,
+        mesh,
+        params,
+        optimizer_cfg=OptimizerConfig(lr=1e-3),
+        total_train_steps=4,
+    )
+
+    rng = np.random.default_rng(0)  # same data on every process (SPMD)
+    seqlens = [12, 9, 17, 8, 11, 15, 10, 13]
+    total = sum(seqlens)
+    sample = SequenceSample.from_default(
+        seqlens=seqlens,
+        ids=list(range(len(seqlens))),
+        data={
+            "packed_input_ids": rng.integers(0, cfg.vocab_size, (total,)).astype(
+                np.int64
+            ),
+            "prompt_mask": np.zeros((total,), bool),
+        },
+    )
+    losses = []
+    for _ in range(3):
+        stats = engine.train_batch(
+            sample, sft_loss_fn, MicroBatchSpec(n_mbs=2)
+        )
+        losses.append(stats["loss"])
+    # step 0 runs at lr=0 (warmup); training bites from step 1 on
+    assert losses[2] < losses[1], losses
+
+    from areal_tpu.interfaces.ppo_interface import model_logprobs_fwd
+
+    lps = engine.forward_batch(
+        sample, model_logprobs_fwd(1.0), MicroBatchSpec(n_mbs=2), output_shift=1
+    )
+    assert np.isfinite(np.asarray(lps, np.float32)).all()
+
+    host = engine.get_host_params()
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(host))
+    print(json.dumps({"proc": proc_id, "losses": losses, "n_params": n}))
+
+
+if __name__ == "__main__":
+    main()

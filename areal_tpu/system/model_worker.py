@@ -355,7 +355,12 @@ class ModelWorker(worker_base.Worker):
             return {}
 
         def _lens(sample, key):
-            return [sum(l) for l in sample.seqlens[key]]
+            # flatten per ANSWER: grouped sampling stores n independent
+            # sequences per id; summing them per id would square-inflate
+            # the attention term
+            return [
+                int(l) for per_id in sample.seqlens[key] for l in per_id
+            ]
 
         try:
             if handle == "generate" and isinstance(res, SequenceSample):
@@ -364,13 +369,9 @@ class ModelWorker(worker_base.Worker):
                     if "packed_input_ids" in res.keys
                     else sorted(res.keys)[0]
                 )
-                # per-ANSWER lengths: grouped sampling stores n answers per
-                # id, each an independent prefill+decode over its own cache
-                full = [
-                    int(l)
-                    for per_id in res.seqlens[key]
-                    for l in per_id
-                ]
+                # per-ANSWER lengths: each answer is an independent
+                # prefill+decode over its own cache
+                full = _lens(res, key)
                 pkey = next(
                     (
                         k

@@ -151,6 +151,11 @@ class WorkerControlPanel:
         self.trial_name = trial_name
         self._ctx = zmq.Context.instance()
         self._socks: Dict[str, zmq.Socket] = {}
+        # worker -> (last observed heartbeat value, local monotonic time we
+        # first saw it); staleness is judged on OUR clock from when the
+        # value last CHANGED, so cross-host wall-clock skew can't fake a
+        # missed (or fresh) beat
+        self._hb_seen: Dict[str, tuple] = {}
 
     def connect(self, worker_names: List[str], timeout: float = 60.0):
         deadline = time.monotonic() + timeout
@@ -201,19 +206,23 @@ class WorkerControlPanel:
             return WorkerServerStatus.LOST
 
     def get_heartbeat_age(self, worker_name: str) -> Optional[float]:
-        """Seconds since the worker's last heartbeat, or None if it never
+        """Seconds (on the CALLER's monotonic clock) since the worker's
+        heartbeat value was last observed to change, or None if it never
         beat (a worker that never registered can't be declared lost yet)."""
         try:
-            ts = float(
-                name_resolve.get(
-                    names.worker_heartbeat(
-                        self.experiment_name, self.trial_name, worker_name
-                    )
+            val = name_resolve.get(
+                names.worker_heartbeat(
+                    self.experiment_name, self.trial_name, worker_name
                 )
             )
         except name_resolve.NameEntryNotFoundError:
             return None
-        return max(0.0, time.time() - ts)
+        now = time.monotonic()
+        seen = self._hb_seen.get(worker_name)
+        if seen is None or seen[0] != val:
+            self._hb_seen[worker_name] = (val, now)
+            return 0.0
+        return now - seen[1]
 
     def find_stale_workers(
         self, worker_names: List[str], timeout: float = HEARTBEAT_TIMEOUT

@@ -55,8 +55,6 @@ def test_flops_counter_relations():
 
 
 def test_worker_heartbeat():
-    import time
-
     from areal_tpu.base import constants, name_resolve, names
     from areal_tpu.system import worker_base
 
@@ -68,11 +66,13 @@ def test_worker_heartbeat():
     assert age is not None and age < 5.0
     assert panel.find_stale_workers(["w0"], timeout=60.0) == []
 
-    # a worker whose last beat is old counts as stale (synthetic worker so
-    # no live beat thread refreshes it underneath the assertion)
+    # a worker whose beat value stopped changing counts as stale; staleness
+    # is reader-side (panel's monotonic clock since last observed CHANGE),
+    # so a synthetic worker is observed once, then its observation time is
+    # backdated to simulate 120s with no new beat
     name_resolve.add(
         names.worker_heartbeat("hbexp", "t0", "w1"),
-        str(time.time() - 120),
+        "12345.0",
         replace=True,
     )
     name_resolve.add(
@@ -80,8 +80,18 @@ def test_worker_heartbeat():
         worker_base.WorkerServerStatus.RUNNING.value,
         replace=True,
     )
+    assert panel.find_stale_workers(["w1"], timeout=60.0) == []  # first obs
+    val, seen = panel._hb_seen["w1"]
+    panel._hb_seen["w1"] = (val, seen - 120)
     assert panel.find_stale_workers(["w1"], timeout=60.0) == ["w1"]
-    # terminal workers are never stale
+    # a NEW beat value resets staleness
+    name_resolve.add(
+        names.worker_heartbeat("hbexp", "t0", "w1"), "12346.0", replace=True
+    )
+    assert panel.find_stale_workers(["w1"], timeout=60.0) == []
+    # terminal workers are never stale, even with an old observation
+    val, seen = panel._hb_seen["w1"]
+    panel._hb_seen["w1"] = (val, seen - 120)
     name_resolve.add(
         names.worker_status("hbexp", "t0", "w1"),
         worker_base.WorkerServerStatus.COMPLETED.value,

@@ -1,0 +1,95 @@
+"""Shared tiny-experiment builders for system e2e tests (threaded local
+runner and the multi-process launcher both consume these)."""
+
+from areal_tpu.api.config import DatasetAbstraction, ModelAbstraction
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.api.system_api import ExperimentSaveEvalControl
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.engine.optimizer import OptimizerConfig
+from areal_tpu.experiments.ppo_math_exp import (
+    PPOHyperparameters,
+    PPOMathExperiment,
+)
+
+
+def make_sync_ppo_exp(
+    dataset_path,
+    tokenizer_path,
+    experiment_name="test-ppo",
+    trial_name="e2e",
+    **ppo_kwargs,
+):
+    gen = GenerationHyperparameters(
+        max_new_tokens=16, min_new_tokens=2, temperature=1.0
+    )
+    return PPOMathExperiment(
+        experiment_name=experiment_name,
+        trial_name=trial_name,
+        n_model_workers=1,
+        mesh_spec=MeshSpec(data=2, model=2),
+        exp_ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=1, benchmark_steps=2
+        ),
+        tokenizer_path=tokenizer_path,
+        actor=ModelAbstraction(
+            "random", {"vocab_size": 256, "max_position_embeddings": 512}
+        ),
+        dataset=DatasetAbstraction(
+            "math_code_prompt",
+            {"dataset_path": dataset_path, "max_length": 64},
+        ),
+        train_bs_n_seqs=4,
+        actor_optimizer=OptimizerConfig(lr=1e-4),
+        critic_optimizer=OptimizerConfig(lr=1e-4),
+        ppo=PPOHyperparameters(gen=gen, ppo_n_minibatches=2, **ppo_kwargs),
+    )
+
+
+def make_async_ppo_exp(
+    dataset_path,
+    tokenizer_path,
+    experiment_name="test-async-ppo",
+    trial_name="e2e",
+    **kwargs,
+):
+    from areal_tpu.experiments.async_ppo_exp import AsyncPPOMathExperiment
+
+    gen = GenerationHyperparameters(
+        max_new_tokens=8, min_new_tokens=1, temperature=1.0
+    )
+    defaults = dict(
+        experiment_name=experiment_name,
+        trial_name=trial_name,
+        n_model_workers=1,
+        mesh_spec=MeshSpec(data=2, model=2),
+        exp_ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=4, benchmark_steps=2
+        ),
+        tokenizer_path=tokenizer_path,
+        actor=ModelAbstraction(
+            "random", {"vocab_size": 256, "max_position_embeddings": 512}
+        ),
+        dataset=DatasetAbstraction(
+            "math_code_prompt",
+            {"dataset_path": dataset_path, "max_length": 64},
+        ),
+        train_bs_n_seqs=4,
+        group_size=2,
+        actor_optimizer=OptimizerConfig(lr=1e-4),
+        ppo=PPOHyperparameters(
+            gen=gen,
+            ppo_n_minibatches=2,
+            kl_ctl=0.0,
+            disable_value=True,
+            use_decoupled_loss=True,
+        ),
+        n_rollout_workers=1,
+        n_gen_servers=1,
+        max_head_offpolicyness=4,
+        max_concurrent_rollouts=4,
+        new_tokens_per_chunk=4,
+        gen_kv_cache_len=128,
+        gen_max_concurrent_batch=4,
+    )
+    defaults.update(kwargs)
+    return AsyncPPOMathExperiment(**defaults)

@@ -20,52 +20,10 @@ def test_async_ppo_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch):
     monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
     monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
 
-    from areal_tpu.api.config import DatasetAbstraction, ModelAbstraction
-    from areal_tpu.api.model_api import GenerationHyperparameters
-    from areal_tpu.api.system_api import ExperimentSaveEvalControl
     from areal_tpu.apps.local_runner import run_experiment_local
-    from areal_tpu.base.topology import MeshSpec
-    from areal_tpu.engine.optimizer import OptimizerConfig
-    from areal_tpu.experiments.async_ppo_exp import AsyncPPOMathExperiment
-    from areal_tpu.experiments.ppo_math_exp import PPOHyperparameters
+    from tests.system.exp_factories import make_async_ppo_exp
 
-    gen = GenerationHyperparameters(
-        max_new_tokens=8, min_new_tokens=1, temperature=1.0
-    )
-    exp = AsyncPPOMathExperiment(
-        experiment_name="test-async-ppo",
-        trial_name="e2e",
-        n_model_workers=1,
-        mesh_spec=MeshSpec(data=2, model=2),
-        exp_ctrl=ExperimentSaveEvalControl(
-            total_train_epochs=4, benchmark_steps=2
-        ),
-        tokenizer_path=tokenizer_path,
-        actor=ModelAbstraction(
-            "random", {"vocab_size": 256, "max_position_embeddings": 512}
-        ),
-        dataset=DatasetAbstraction(
-            "math_code_prompt",
-            {"dataset_path": dataset_path, "max_length": 64},
-        ),
-        train_bs_n_seqs=4,
-        group_size=2,
-        actor_optimizer=OptimizerConfig(lr=1e-4),
-        ppo=PPOHyperparameters(
-            gen=gen,
-            ppo_n_minibatches=2,
-            kl_ctl=0.0,
-            disable_value=True,
-            use_decoupled_loss=True,
-        ),
-        n_rollout_workers=1,
-        n_gen_servers=1,
-        max_head_offpolicyness=4,
-        max_concurrent_rollouts=4,
-        new_tokens_per_chunk=4,  # exercise chunked/interruptible generation
-        gen_kv_cache_len=128,
-        gen_max_concurrent_batch=4,
-    )
+    exp = make_async_ppo_exp(dataset_path, tokenizer_path)
     cfg = exp.initial_setup()
     names_ = [r.name for r in cfg.master.model_rpcs]
     assert "actor_gen" not in names_ and "rew_inf" not in names_

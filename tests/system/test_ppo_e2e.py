@@ -15,40 +15,9 @@ def tokenizer_path(tokenizer, save_path):
 
 
 def _make_exp(dataset_path, tokenizer_path, **ppo_kwargs):
-    from areal_tpu.api.config import DatasetAbstraction, ModelAbstraction
-    from areal_tpu.api.model_api import GenerationHyperparameters
-    from areal_tpu.api.system_api import ExperimentSaveEvalControl
-    from areal_tpu.base.topology import MeshSpec
-    from areal_tpu.engine.optimizer import OptimizerConfig
-    from areal_tpu.experiments.ppo_math_exp import (
-        PPOHyperparameters,
-        PPOMathExperiment,
-    )
+    from tests.system.exp_factories import make_sync_ppo_exp
 
-    gen = GenerationHyperparameters(
-        max_new_tokens=16, min_new_tokens=2, temperature=1.0
-    )
-    return PPOMathExperiment(
-        experiment_name="test-ppo",
-        trial_name="e2e",
-        n_model_workers=1,
-        mesh_spec=MeshSpec(data=2, model=2),
-        exp_ctrl=ExperimentSaveEvalControl(
-            total_train_epochs=1, benchmark_steps=2
-        ),
-        tokenizer_path=tokenizer_path,
-        actor=ModelAbstraction(
-            "random", {"vocab_size": 256, "max_position_embeddings": 512}
-        ),
-        dataset=DatasetAbstraction(
-            "math_code_prompt",
-            {"dataset_path": dataset_path, "max_length": 64},
-        ),
-        train_bs_n_seqs=4,
-        actor_optimizer=OptimizerConfig(lr=1e-4),
-        critic_optimizer=OptimizerConfig(lr=1e-4),
-        ppo=PPOHyperparameters(gen=gen, ppo_n_minibatches=2, **ppo_kwargs),
-    )
+    return make_sync_ppo_exp(dataset_path, tokenizer_path, **ppo_kwargs)
 
 
 def _run(exp, tmp_path, monkeypatch):
