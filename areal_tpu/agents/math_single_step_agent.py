@@ -55,8 +55,25 @@ class MathSingleStepAgent(agent_api.Agent):
         await env.reset()
         answers = bundle.seqs  # token ids; env decodes/scores
         _, rewards, *_ = await env.step(
-            (qid, answers, prompt.metadata.get("solutions", [[]])[0],
-             len(prompt_ids))
+            {
+                "qid": qid,
+                "seqs": answers,
+                "prompt_len": len(prompt_ids),
+                "task": prompt.metadata.get("task", ["math"])[0],
+                "problem": {
+                    "query_id": qid,
+                    "solutions": prompt.metadata.get("solutions", [[]])[0],
+                    "input_output": prompt.metadata.get(
+                        "input_output", [None]
+                    )[0],
+                    **(
+                        {"timeout": prompt.metadata["timeout"][0]}
+                        if prompt.metadata.get("timeout", [None])[0]
+                        is not None
+                        else {}
+                    ),
+                },
+            }
         )
         rewards = np.asarray(rewards, np.float32)
 

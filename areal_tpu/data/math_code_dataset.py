@@ -97,6 +97,8 @@ class MATHCodePromptDataset(torch.utils.data.Dataset):
         self.tasks_ids = [d["task"] for d in data]
         self.ids = [str(d["query_id"]) for d in data]
         self.solutions = [d.get("solutions", []) for d in data]
+        self.input_outputs = [d.get("input_output") for d in data]
+        self.timeouts = [d.get("timeout") for d in data]
         util.tokenizer.padding_side = "left"
         encodings = util.tokenizer(
             [d["prompt"] for d in data],
@@ -128,6 +130,8 @@ class MATHCodePromptDataset(torch.utils.data.Dataset):
             metadata={
                 "task": [self.tasks_ids[i]],
                 "solutions": [self.solutions[i]],
+                "input_output": [self.input_outputs[i]],
+                "timeout": [self.timeouts[i]],
             },
         )
 

@@ -118,13 +118,9 @@ def verify_math_solution(generated: str, solutions: List[str]) -> float:
 def parse_lines_in_parallel(
     generateds: List[str], solutions_list: List[List[str]], max_workers: int = 8
 ) -> List[float]:
-    """Verify many answers concurrently (sympy can be slow per-item)."""
-    if len(generateds) <= 4:
-        return [
-            verify_math_solution(g, s)
-            for g, s in zip(generateds, solutions_list)
-        ]
-    from concurrent.futures import ThreadPoolExecutor
+    """Verify many answers concurrently with timeout isolation.  Delegates
+    to the hardened process-pool wrapper (areal_tpu/verifiers/math_verify.py)
+    so a pathological sympy input can never hang the caller."""
+    from areal_tpu.verifiers.math_verify import math_verify
 
-    with ThreadPoolExecutor(max_workers=max_workers) as ex:
-        return list(ex.map(verify_math_solution, generateds, solutions_list))
+    return math_verify(generateds, solutions_list)

@@ -11,7 +11,7 @@ from typing import List, Tuple
 
 from areal_tpu.api import dataset_api, env_api
 from areal_tpu.base import logging_
-from areal_tpu.data.math_parser import parse_lines_in_parallel
+from areal_tpu.verifiers.dispatch import verify_batch
 
 logger = logging_.getLogger("math_env")
 
@@ -24,23 +24,34 @@ class MathCodeSingleStepEnv(env_api.EnvironmentService):
             else None
         )
 
-    async def reset(self, seed=None, options=None):
-        return None, {}
-
     async def step(self, action) -> Tuple[None, List[float], bool, bool, dict]:
-        """action = (qid, seqs [list of token lists], solutions, prompt_len).
-        Returns (obs, per-answer rewards, terminated, truncated, info)."""
-        qid, seqs, solutions, prompt_len = action
-        assert self._tokenizer is not None, "math env needs a tokenizer"
+        """action = {qid, seqs [list of token lists], prompt_len, task,
+        problem {query_id, solutions, input_output}}.
+        Returns (obs, per-answer rewards, terminated, truncated, info).
+        Math answers go through final-answer equivalence, code answers
+        through sandboxed testcase execution (multi-task dispatch,
+        reference: math_code_single_step_env.py:42)."""
+        qid = action["qid"]
+        seqs = action["seqs"]
+        prompt_len = action["prompt_len"]
+        task = action.get("task", "math")
+        problem = action.get("problem") or {"query_id": qid, "solutions": []}
+        assert self._tokenizer is not None, "env needs a tokenizer"
         texts = await asyncio.to_thread(
             self._tokenizer.batch_decode,
             [s[prompt_len:] for s in seqs],
             skip_special_tokens=True,
         )
         rewards = await asyncio.to_thread(
-            parse_lines_in_parallel, texts, [solutions] * len(texts)
+            verify_batch,
+            [task] * len(texts),
+            texts,
+            [problem] * len(texts),
         )
         return None, rewards, True, False, {}
+
+    async def reset(self, seed=None, options=None):
+        return None, {}
 
 
 env_api.register_environment("math-code-single-step", MathCodeSingleStepEnv)

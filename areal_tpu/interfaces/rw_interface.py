@@ -18,7 +18,7 @@ import numpy as np
 from areal_tpu.api import model_api
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.base import logging_, stats_tracker
-from areal_tpu.data.math_parser import parse_lines_in_parallel
+from areal_tpu.verifiers.dispatch import verify_batch
 
 logger = logging_.getLogger("rw_interface")
 
@@ -52,11 +52,28 @@ class MultiTaskRewardInterface(model_api.ModelInterface):
             texts.append(tok.decode(seq, skip_special_tokens=True))
 
         solutions = data.metadata.get("solutions")
-        if solutions is None:
+        tasks = data.metadata.get("task") or ["math"] * data.bs
+        input_outputs = data.metadata.get("input_output") or [None] * data.bs
+        if solutions is None and all(t == "math" for t in tasks):
             logger.warning("no solutions metadata; rewards are all 0")
             rewards = [0.0] * data.bs
         else:
-            rewards = parse_lines_in_parallel(texts, solutions)
+            solutions = solutions or [[]] * data.bs
+            timeouts = data.metadata.get("timeout") or [None] * data.bs
+            problems = [
+                {
+                    "query_id": str(data.ids[i]),
+                    "solutions": solutions[i],
+                    "input_output": input_outputs[i],
+                    **(
+                        {"timeout": timeouts[i]}
+                        if timeouts[i] is not None
+                        else {}
+                    ),
+                }
+                for i in range(data.bs)
+            ]
+            rewards = verify_batch(tasks, texts, problems)
 
         with stats_tracker.scope("reward"):
             stats_tracker.scalar(

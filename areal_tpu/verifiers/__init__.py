@@ -1,0 +1,3 @@
+"""Reward verifiers: sandboxed code execution, hardened math checking, and
+the multi-task dispatch + HTTP service that the reward interface and envs
+consume (reference: the ``functioncall/`` reward service tree)."""

@@ -1,0 +1,180 @@
+"""Parent-side code verification: sandbox processes + testcase batching.
+
+Rebuild of the reference's code reward path (reference:
+functioncall/code/local_verify.py ``code_verify`` — process-pool fan-out of
+sandboxed per-solution runs with hard kill on timeout; and
+functioncall/code/verify.py:111 ``code_verify`` — splitting each problem's
+testcases into batches dispatched concurrently with fast-fail AND-reduction
+over batch verdicts).  Ours merges both: every (solution, testcase-batch)
+pair becomes one disposable sandbox subprocess
+(areal_tpu/verifiers/sandbox_runner.py) run under a thread pool; a problem
+scores 1 only if every batch passes every case.
+
+Problem dicts use the dataset schema (areal_tpu/data/math_code_dataset.py):
+``query_id`` and ``input_output`` — a JSON string with ``inputs``,
+``outputs``, optional ``fn_name``/``timeout``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("code_verify")
+
+SINGLE_CASE_EXEC_TIMEOUT = 6
+TEST_CASE_BATCH_SIZE = 4
+JOB_WALL_TIMEOUT = 200
+
+
+def _run_sandbox(job: Dict, wall_timeout: float) -> Dict:
+    """One sandbox subprocess; hard process-group kill on timeout."""
+    tmp = tempfile.gettempdir()
+    tag = uuid.uuid4().hex
+    in_path = os.path.join(tmp, f"areal-code-{tag}-in.json")
+    out_path = os.path.join(tmp, f"areal-code-{tag}-out.json")
+    with open(in_path, "w") as f:
+        json.dump(job, f)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "areal_tpu.verifiers.sandbox_runner",
+            in_path,
+            out_path,
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    )
+    try:
+        proc.wait(timeout=wall_timeout)
+    except subprocess.TimeoutExpired:
+        pass
+    finally:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+    result = {"results": [False], "error": "no output (killed or crashed)"}
+    try:
+        with open(out_path) as f:
+            result = json.load(f)
+    except (FileNotFoundError, ValueError):
+        pass
+    finally:
+        for p in (in_path, out_path):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+    return result
+
+
+def _problem_jobs(
+    problem: Dict,
+    solution: str,
+    query_index: int,
+    timeout_per_case: int,
+    batch_size: int,
+) -> List[Dict]:
+    io_spec = problem["input_output"]
+    if isinstance(io_spec, str):
+        io_spec = json.loads(io_spec)
+    inputs = io_spec.get("inputs", [])
+    outputs = io_spec.get("outputs", [])
+    assert len(inputs) == len(outputs), problem.get("query_id")
+    fn_name = io_spec.get("fn_name", "")
+    timeout = int(
+        min(100, max(1, float(problem.get("timeout", timeout_per_case))))
+    )
+    if not inputs:
+        # unit-test style: one load-and-run job
+        return [
+            {
+                "code": solution,
+                "fn_name": fn_name,
+                "testcases": [],
+                "timeout_per_case": timeout,
+                "query_index": query_index,
+            }
+        ]
+    batch_size = min(max(1, batch_size), len(inputs))
+    jobs = []
+    for start in range(0, len(inputs), batch_size):
+        end = min(len(inputs), start + batch_size)
+        jobs.append(
+            {
+                "code": solution,
+                "fn_name": fn_name,
+                "testcases": [
+                    {"input": inputs[i], "expected_output": outputs[i]}
+                    for i in range(start, end)
+                ],
+                "timeout_per_case": timeout,
+                "fast_fail": True,
+                "query_index": query_index,
+            }
+        )
+    return jobs
+
+
+def code_verify(
+    id2info: Dict[str, Dict],
+    generateds: Sequence[str],
+    query_ids: Sequence[str],
+    timeout_per_case: int = SINGLE_CASE_EXEC_TIMEOUT,
+    test_case_batch_size: int = TEST_CASE_BATCH_SIZE,
+    job_wall_timeout: float = JOB_WALL_TIMEOUT,
+    max_workers: Optional[int] = None,
+) -> List[float]:
+    """Score each generated solution 1.0 iff every testcase passes."""
+    assert len(generateds) == len(query_ids)
+    jobs: List[Dict] = []
+    malformed: List[int] = []
+    for idx, (qid, sol) in enumerate(zip(query_ids, generateds)):
+        try:
+            jobs.extend(
+                _problem_jobs(
+                    id2info[qid],
+                    sol,
+                    idx,
+                    timeout_per_case,
+                    test_case_batch_size,
+                )
+            )
+        except (KeyError, TypeError, AttributeError, ValueError, AssertionError) as e:
+            # a malformed problem spec (e.g. missing input_output) scores 0
+            # rather than killing the reward MFC / rollout task
+            logger.warning("problem %s malformed (%r); reward 0", qid, e)
+            malformed.append(idx)
+    if max_workers is None:
+        max_workers = max(2, (os.cpu_count() or 8) // 4)
+    results = [1.0] * len(query_ids)
+    for idx in malformed:
+        results[idx] = 0.0
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        for job, out in zip(
+            jobs,
+            pool.map(lambda j: _run_sandbox(j, job_wall_timeout), jobs),
+        ):
+            per_case = out.get("results", [False])
+            n_cases = len(job["testcases"])
+            passed = (
+                all(per_case)
+                and (n_cases == 0 or len(per_case) == n_cases)
+            )
+            if not passed:
+                results[job["query_index"]] = 0.0
+    return results

@@ -1,0 +1,104 @@
+"""Multi-task reward dispatch: route each generated answer to its verifier.
+
+Rebuild of the reference's task dispatch (reference:
+realhf/impl/model/interface/math_rw_interface.py ``MultiTaskRewardInterface``
+:181 groups answers by task tag and calls the math or code verifier;
+realhf/impl/environment/math_code_single_step_env.py:42 does the same inside
+the async env).  Verification runs locally by default; exporting
+``AREAL_VERIFIER_URL`` routes every batch to the HTTP verifier service
+(areal_tpu/verifiers/service.py) instead — the reference's "functioncall"
+remote mode.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Sequence
+
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("verifier_dispatch")
+
+
+def verify_batch(
+    tasks: Sequence[str],
+    texts: Sequence[str],
+    problems: Sequence[Dict],
+    timeout: float = 300.0,
+) -> List[float]:
+    """Score ``texts[i]`` (the generated answer for ``problems[i]``, a
+    dataset info dict) under the verifier selected by ``tasks[i]``.
+
+    Task tags: ``math`` / ``stem`` -> final-answer equivalence;
+    ``code`` -> sandboxed testcase execution."""
+    assert len(tasks) == len(texts) == len(problems)
+    url = os.environ.get("AREAL_VERIFIER_URL")
+    if url:
+        return _client_for(url).verify(tasks, texts, problems, timeout)
+    return verify_batch_local(tasks, texts, problems)
+
+
+_clients: Dict[str, object] = {}
+
+
+def _client_for(url: str):
+    """One client per URL so its concurrency cap actually spans every
+    concurrent verify_batch caller in the process."""
+    if url not in _clients:
+        from areal_tpu.verifiers.service import VerifierClient
+
+        _clients[url] = VerifierClient(url)
+    return _clients[url]
+
+
+def verify_batch_local(
+    tasks: Sequence[str],
+    texts: Sequence[str],
+    problems: Sequence[Dict],
+) -> List[float]:
+    rewards = [0.0] * len(texts)
+
+    math_idx = [i for i, t in enumerate(tasks) if t in ("math", "stem")]
+    if math_idx:
+        from areal_tpu.verifiers.math_verify import math_verify
+
+        math_rewards = math_verify(
+            [texts[i] for i in math_idx],
+            [problems[i].get("solutions", []) for i in math_idx],
+        )
+        for i, r in zip(math_idx, math_rewards):
+            rewards[i] = r
+
+    code_idx = [i for i, t in enumerate(tasks) if t == "code"]
+    if code_idx:
+        from areal_tpu.verifiers.code_verify import code_verify
+
+        id2info = {}
+        qids = []
+        for i in code_idx:
+            qid = str(problems[i].get("query_id", i))
+            id2info[qid] = problems[i]
+            qids.append(qid)
+        code_rewards = code_verify(
+            id2info, [extract_code(texts[i]) for i in code_idx], qids
+        )
+        for i, r in zip(code_idx, code_rewards):
+            rewards[i] = r
+
+    unknown = set(tasks) - {"math", "stem", "code"}
+    if unknown:
+        logger.warning("unknown task tags scored 0: %s", sorted(unknown))
+    return rewards
+
+
+def extract_code(text: str) -> str:
+    """Last fenced code block, or the raw text when there is none (the
+    reference extracts ```...``` blocks from generated answers)."""
+    import re
+
+    blocks = re.findall(
+        r"```(?:python|py|cpp|c\+\+)?\s*\n(.*?)```", text, re.DOTALL
+    )
+    if blocks:
+        return blocks[-1]
+    return text

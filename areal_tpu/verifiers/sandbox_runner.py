@@ -1,0 +1,294 @@
+"""Sandboxed single-solution code runner (subprocess body).
+
+Executes one generated solution against a list of testcases inside THIS
+process — which the parent (areal_tpu/verifiers/code_verify.py) always
+spawns as a disposable, resource-limited, process-group-isolated child, so a
+malicious or runaway solution can only kill its own sandbox.
+
+Semantics follow the reference's LiveCodeBench-derived checker
+(reference: functioncall/code/function/testing_util.py ``run_test`` — two
+problem styles) re-implemented from scratch:
+
+- **stdin style** (no ``fn_name``): the solution is a whole program; each
+  testcase feeds ``input`` on stdin and compares captured stdout
+  line-by-line (trailing whitespace stripped, float-tolerant tokens).
+- **call style** (``fn_name`` given): the solution defines a function (or a
+  ``Solution`` class with the method); each testcase's ``input`` holds the
+  argument list and ``expected_output`` the return value, compared with
+  normalization (tuples->lists, float tolerance).
+
+Per-case wall-clock timeout via SIGALRM; CPU/memory/process rlimits applied
+before any user code runs.  Output: JSON ``{"results": [...], "error": ...}``
+with one bool per case (fast-fail truncates).
+
+Usage: ``python -m areal_tpu.verifiers.sandbox_runner IN.json OUT.json``
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import signal
+import sys
+import types
+from typing import Any, Dict, List
+
+#: import preamble exposed to solutions — competitive-programming staples
+PREAMBLE = (
+    "import sys, os, re, math, json, random, itertools, functools, "
+    "operator, bisect, heapq, collections, string, copy, statistics, io\n"
+    "from math import *\n"
+    "from collections import *\n"
+    "from itertools import *\n"
+    "from functools import *\n"
+    "from heapq import *\n"
+    "from bisect import *\n"
+    "from typing import *\n"
+    "sys.setrecursionlimit(600000)\n"
+)
+
+
+class CaseTimeout(Exception):
+    pass
+
+
+def _alarm(signum, frame):
+    raise CaseTimeout()
+
+
+def apply_rlimits(cpu_seconds: int = 60, mem_bytes: int = 4 << 30):
+    import resource
+
+    resource.setrlimit(resource.RLIMIT_CPU, (cpu_seconds, cpu_seconds + 5))
+    try:
+        resource.setrlimit(resource.RLIMIT_AS, (mem_bytes, mem_bytes))
+    except (ValueError, OSError):
+        pass
+    try:
+        resource.setrlimit(resource.RLIMIT_NPROC, (64, 64))
+    except (ValueError, OSError):
+        pass
+
+
+def neuter_destructive_apis():
+    """Best-effort guard against solutions nuking shared state (the real
+    isolation is the disposable child process + rlimits)."""
+    import builtins
+    import os as _os
+    import shutil as _shutil
+    import subprocess as _subprocess
+
+    for mod, name in (
+        (_os, "system"),
+        (_os, "popen"),
+        (_os, "execv"),
+        (_os, "execve"),
+        (_os, "fork"),
+        (_os, "forkpty"),
+        (_os, "killpg"),
+        (_os, "removedirs"),
+        (_os, "rmdir"),
+        (_shutil, "rmtree"),
+        (_subprocess, "Popen"),
+        (_subprocess, "run"),
+        (_subprocess, "call"),
+        (_subprocess, "check_output"),
+    ):
+        try:
+            setattr(mod, name, None)
+        except (AttributeError, TypeError):
+            pass
+    builtins.exit = None
+    builtins.quit = None
+
+
+def _float_tokens_equal(a: str, b: str, tol: float = 1e-6) -> bool:
+    if a == b:
+        return True
+    try:
+        return abs(float(a) - float(b)) <= tol * max(1.0, abs(float(b)))
+    except (ValueError, OverflowError):
+        return False
+
+
+def stdout_matches(got: str, expected: str) -> bool:
+    """Line-by-line comparison, trailing-whitespace insensitive, with
+    float-tolerant token fallback."""
+    glines = [l.rstrip() for l in got.rstrip().splitlines()]
+    elines = [l.rstrip() for l in expected.rstrip().splitlines()]
+    if glines == elines:
+        return True
+    if len(glines) != len(elines):
+        return False
+    for g, e in zip(glines, elines):
+        if g == e:
+            continue
+        gt, et = g.split(), e.split()
+        if len(gt) != len(et):
+            return False
+        if not all(_float_tokens_equal(x, y) for x, y in zip(gt, et)):
+            return False
+    return True
+
+
+def values_equal(got: Any, expected: Any, tol: float = 1e-6) -> bool:
+    """Normalized value comparison for call-style problems."""
+    if isinstance(got, tuple):
+        got = list(got)
+    if isinstance(expected, tuple):
+        expected = list(expected)
+    if isinstance(got, list) and isinstance(expected, list):
+        return len(got) == len(expected) and all(
+            values_equal(g, e, tol) for g, e in zip(got, expected)
+        )
+    if isinstance(got, dict) and isinstance(expected, dict):
+        return set(got) == set(expected) and all(
+            values_equal(got[k], expected[k], tol) for k in got
+        )
+    if isinstance(got, float) or isinstance(expected, float):
+        try:
+            return abs(float(got) - float(expected)) <= tol * max(
+                1.0, abs(float(expected))
+            )
+        except (TypeError, ValueError):
+            return False
+    return got == expected
+
+
+def _load_solution_module(code: str):
+    mod = types.ModuleType("solution")
+    exec(compile(PREAMBLE + code, "<solution>", "exec"), mod.__dict__)
+    return mod
+
+
+def _resolve_fn(mod, fn_name: str):
+    if hasattr(mod, fn_name):
+        return getattr(mod, fn_name)
+    if hasattr(mod, "Solution"):
+        return getattr(mod.Solution(), fn_name)
+    raise AttributeError(f"solution defines no {fn_name!r}")
+
+
+def _parse_args(raw: Any) -> List[Any]:
+    """Call-style testcase input -> argument list.  Accepts a JSON list, a
+    newline-separated sequence of JSON values, or a single value."""
+    if isinstance(raw, list):
+        return raw
+    if isinstance(raw, str):
+        lines = [l for l in raw.splitlines() if l.strip()]
+        if len(lines) > 1:
+            return [json.loads(l) for l in lines]
+        return [json.loads(raw)]
+    return [raw]
+
+
+def run_stdin_case(code: str, stdin_data: str, expected: str, timeout: int):
+    old_stdin, old_stdout = sys.stdin, sys.stdout
+    sys.stdin = io.StringIO(stdin_data if stdin_data.endswith("\n") else stdin_data + "\n")
+    sys.stdout = captured = io.StringIO()
+    signal.alarm(timeout)
+    try:
+        # fresh module per case: programs assume a clean global state
+        mod = types.ModuleType("solution_main")
+        mod.__dict__["__name__"] = "__main__"
+        exec(compile(PREAMBLE + code, "<solution>", "exec"), mod.__dict__)
+        ok = True
+    except SystemExit:
+        ok = True  # programs may sys.exit(0) after printing
+    except BaseException:
+        ok = False
+    finally:
+        signal.alarm(0)
+        sys.stdin, sys.stdout = old_stdin, old_stdout
+    return ok and stdout_matches(captured.getvalue(), expected)
+
+
+def run_call_case(fn, raw_input: Any, expected: Any, timeout: int) -> bool:
+    args = _parse_args(raw_input)
+    if isinstance(expected, str):
+        try:
+            expected = json.loads(expected)
+        except (ValueError, TypeError):
+            pass
+    old_stdout = sys.stdout
+    sys.stdout = io.StringIO()  # solutions may print debug noise
+    signal.alarm(timeout)
+    try:
+        got = fn(*args)
+        ok = values_equal(got, expected)
+    except BaseException:
+        ok = False
+    finally:
+        signal.alarm(0)
+        sys.stdout = old_stdout
+    return ok
+
+
+def run_job(job: Dict) -> Dict:
+    code = job["code"]
+    fn_name = job.get("fn_name") or ""
+    cases = job["testcases"]
+    timeout = int(job.get("timeout_per_case", 6))
+    fast_fail = bool(job.get("fast_fail", True))
+
+    results: List[bool] = []
+    if not cases:
+        # unit-test style: success = the solution merely loads and runs
+        try:
+            signal.alarm(timeout)
+            _load_solution_module(code)
+            results.append(True)
+        except BaseException:
+            results.append(False)
+        finally:
+            signal.alarm(0)
+        return {"results": results}
+
+    fn = None
+    if fn_name:
+        try:
+            signal.alarm(timeout)
+            fn = _resolve_fn(_load_solution_module(code), fn_name)
+        except BaseException as e:  # noqa: BLE001
+            return {
+                "results": [False] * len(cases),
+                "error": f"load: {type(e).__name__}: {e}",
+            }
+        finally:
+            signal.alarm(0)
+
+    for case in cases:
+        if fn_name:
+            ok = run_call_case(
+                fn, case["input"], case["expected_output"], timeout
+            )
+        else:
+            ok = run_stdin_case(
+                code, str(case["input"]), str(case["expected_output"]), timeout
+            )
+        results.append(ok)
+        if fast_fail and not ok:
+            break
+    return {"results": results}
+
+
+def main():
+    in_path, out_path = sys.argv[1], sys.argv[2]
+    with open(in_path) as f:
+        job = json.load(f)
+    signal.signal(signal.SIGALRM, _alarm)
+    apply_rlimits(
+        cpu_seconds=int(job.get("cpu_limit", 60)),
+        mem_bytes=int(job.get("mem_limit", 4 << 30)),
+    )
+    neuter_destructive_apis()
+    try:
+        out = run_job(job)
+    except BaseException as e:  # noqa: BLE001 - report, don't crash silently
+        out = {"results": [False], "error": f"{type(e).__name__}: {e}"}
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

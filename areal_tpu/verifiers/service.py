@@ -1,0 +1,188 @@
+"""HTTP verifier service + client (the reference's reward FaaS, stdlib-only).
+
+Rebuild of the reference's functioncall service layer (reference:
+functioncall/base/call.py:81-220 ``batch_function_call`` — async HTTP batch
+dispatch with a concurrency semaphore, per-request timeout and retries with
+backoff; the server side lives in a FaaS cluster).  Ours ships the server
+too: a ``ThreadingHTTPServer`` exposing ``POST /verify`` over the same
+multi-task dispatch used locally, so a verifier cluster is one process per
+CPU host with ``AREAL_VERIFIER_URL`` pointed at it (it registers itself in
+name_resolve for discovery).
+
+Protocol: request ``{"tasks": [...], "texts": [...], "problems": [...]}``;
+response ``{"rewards": [...]}``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+
+from areal_tpu.base import logging_, network
+
+logger = logging_.getLogger("verifier_service")
+
+MAX_BATCH_PER_REQUEST = 64
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path == "/health":
+            body = json.dumps({"status": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/verify":
+            self.send_error(404)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length))
+            from areal_tpu.verifiers.dispatch import verify_batch_local
+
+            rewards = verify_batch_local(
+                req["tasks"], req["texts"], req["problems"]
+            )
+            body = json.dumps({"rewards": rewards}).encode()
+            self.send_response(200)
+        except Exception as e:  # noqa: BLE001 - report to client
+            logger.exception("verify request failed")
+            body = json.dumps({"error": repr(e)}).encode()
+            self.send_response(500)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug(fmt, *args)
+
+
+class VerifierServer:
+    """In-process verifier HTTP server (daemon thread)."""
+
+    def __init__(self, port: int = 0, register: bool = False):
+        if port == 0:
+            port = network.find_free_port()
+        self.port = port
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self.url = f"http://{network.gethostip()}:{port}"
+        if register:
+            from areal_tpu.base import constants, name_resolve, names
+
+            name_resolve.add_subentry(
+                names.metric_server_root(
+                    constants.experiment_name(), constants.trial_name()
+                )
+                + "verifier",
+                self.url,
+            )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class VerifierClient:
+    """Chunked, concurrency-capped, retrying client
+    (semantics of reference functioncall/base/call.py:81-220)."""
+
+    def __init__(
+        self,
+        url: str,
+        max_concurrency: int = 8,
+        retries: int = 3,
+        backoff: float = 0.5,
+    ):
+        self.url = url.rstrip("/")
+        self._sem = threading.Semaphore(max_concurrency)
+        self.retries = retries
+        self.backoff = backoff
+
+    def _post_chunk(
+        self,
+        tasks: Sequence[str],
+        texts: Sequence[str],
+        problems: Sequence[Dict],
+        timeout: float,
+    ) -> Optional[List[float]]:
+        payload = json.dumps(
+            {
+                "tasks": list(tasks),
+                "texts": list(texts),
+                "problems": list(problems),
+            }
+        ).encode()
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries):
+            with self._sem:
+                try:
+                    req = urllib.request.Request(
+                        self.url + "/verify",
+                        data=payload,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=timeout) as rsp:
+                        out = json.loads(rsp.read())
+                    return [float(r) for r in out["rewards"]]
+                except (
+                    urllib.error.URLError,
+                    urllib.error.HTTPError,
+                    TimeoutError,
+                    KeyError,
+                    ValueError,
+                ) as e:
+                    last_err = e
+                    time.sleep(self.backoff * (2**attempt))
+        logger.warning(
+            "verifier requests failed after %d retries: %r; scoring 0",
+            self.retries,
+            last_err,
+        )
+        return None
+
+    def verify(
+        self,
+        tasks: Sequence[str],
+        texts: Sequence[str],
+        problems: Sequence[Dict],
+        timeout: float = 300.0,
+    ) -> List[float]:
+        from concurrent.futures import ThreadPoolExecutor
+
+        chunks = [
+            (start, min(len(tasks), start + MAX_BATCH_PER_REQUEST))
+            for start in range(0, len(tasks), MAX_BATCH_PER_REQUEST)
+        ]
+        rewards = [0.0] * len(tasks)
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            outs = pool.map(
+                lambda se: self._post_chunk(
+                    tasks[se[0] : se[1]],
+                    texts[se[0] : se[1]],
+                    problems[se[0] : se[1]],
+                    timeout,
+                ),
+                chunks,
+            )
+            for (start, end), out in zip(chunks, outs):
+                if out is not None:
+                    rewards[start:end] = out
+        return rewards

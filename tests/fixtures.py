@@ -63,6 +63,42 @@ def dataset_path(dataset, save_path):
 
 
 @pytest.fixture
+def mixed_dataset_path(save_path):
+    """Math + code rows: code rows carry real stdin-style testcases that the
+    sandbox actually executes."""
+    random.seed(1)
+    rows = []
+    for i in range(TESTING_DATASET_SIZE):
+        qid = str(uuid.uuid4())
+        if i % 2 == 0:
+            rows.append(
+                dict(
+                    query_id=qid,
+                    prompt=random_sentence(random.randint(1, 8)),
+                    solutions=["\\boxed{42}"],
+                    task="math",
+                )
+            )
+        else:
+            rows.append(
+                dict(
+                    query_id=qid,
+                    prompt=random_sentence(random.randint(1, 8)),
+                    input_output=json.dumps(
+                        {"inputs": ["1 2\n"], "outputs": ["3\n"]}
+                    ),
+                    task="code",
+                    timeout=2,
+                )
+            )
+    path = save_path / "mixed_dataset.jsonl"
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+@pytest.fixture
 def tokenizer(dataset, save_path):
     from tokenizers import Tokenizer
     from tokenizers.models import WordPiece

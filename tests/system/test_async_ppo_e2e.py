@@ -6,7 +6,13 @@ post-train weight publication hot-swapping the generation servers
 import numpy as np
 import pytest
 
-from tests.fixtures import dataset, dataset_path, save_path, tokenizer  # noqa: F401
+from tests.fixtures import (  # noqa: F401
+    dataset,
+    dataset_path,
+    mixed_dataset_path,
+    save_path,
+    tokenizer,
+)
 
 
 @pytest.fixture
@@ -39,3 +45,26 @@ def test_async_ppo_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch):
     # trajectories carried behavioral logprobs + version stamps through the
     # stream; decoupled loss ran (prox_logp recomputed by actor_inf)
     assert "actor_train/kl" in s
+
+
+def test_async_ppo_mixed_math_code(
+    mixed_dataset_path, tokenizer_path, tmp_path, monkeypatch
+):
+    """Async PPO over a mixed math+code dataset: code rewards come from the
+    sandboxed verifier actually executing the (random-model) answers, math
+    rewards from the hardened parser — the full multi-task dispatch path."""
+    monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
+    monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
+
+    from areal_tpu.apps.local_runner import run_experiment_local
+    from tests.system.exp_factories import make_async_ppo_exp
+
+    exp = make_async_ppo_exp(
+        mixed_dataset_path,
+        tokenizer_path,
+        trial_name="e2e-mixed",
+    )
+    cfg = exp.initial_setup()
+    master = run_experiment_local(cfg, timeout=600)
+    assert len(master.stats_history) >= 2
+    assert np.isfinite(master.stats_history[-1]["actor_train/loss"])

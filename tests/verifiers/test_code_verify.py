@@ -1,0 +1,163 @@
+"""Sandboxed code verification: real subprocess execution of generated
+solutions against testcases (reference semantics:
+functioncall/code/local_verify.py + code/verify.py testcase batching)."""
+
+import json
+import time
+
+import pytest
+
+from areal_tpu.verifiers.code_verify import code_verify
+from areal_tpu.verifiers.dispatch import extract_code, verify_batch_local
+from areal_tpu.verifiers.sandbox_runner import (
+    stdout_matches,
+    values_equal,
+)
+
+
+def _problem(qid, inputs, outputs, fn_name="", timeout=None):
+    spec = {"inputs": inputs, "outputs": outputs}
+    if fn_name:
+        spec["fn_name"] = fn_name
+    p = {"query_id": qid, "input_output": json.dumps(spec), "task": "code"}
+    if timeout:
+        p["timeout"] = timeout
+    return p
+
+
+STDIN_SUM = "a, b = map(int, input().split())\nprint(a + b)\n"
+CALL_ADD = "def add(a, b):\n    return a + b\n"
+CLASS_ADD = (
+    "class Solution:\n    def add(self, a, b):\n        return a + b\n"
+)
+
+
+def test_stdin_style_pass_and_fail():
+    id2info = {
+        "q0": _problem("q0", ["1 2\n", "10 20\n"], ["3\n", "30\n"]),
+    }
+    assert code_verify(id2info, [STDIN_SUM], ["q0"]) == [1.0]
+    wrong = "a, b = map(int, input().split())\nprint(a - b)\n"
+    assert code_verify(id2info, [wrong], ["q0"]) == [0.0]
+    broken = "this is not python"
+    assert code_verify(id2info, [broken], ["q0"]) == [0.0]
+
+
+def test_call_style_fn_and_solution_class():
+    id2info = {
+        "q0": _problem("q0", [[1, 2], [5, 7]], ["3", "12"], fn_name="add"),
+    }
+    assert code_verify(id2info, [CALL_ADD], ["q0"]) == [1.0]
+    assert code_verify(id2info, [CLASS_ADD], ["q0"]) == [1.0]
+    assert code_verify(id2info, ["def add(a, b):\n    return a * b\n"], ["q0"]) == [
+        0.0
+    ]
+
+
+def test_testcase_batching_and_multiple_solutions():
+    # 6 cases with batch size 2 -> 3 sandbox jobs per solution; the second
+    # solution fails only the last case
+    inputs = [f"{i} {i}\n" for i in range(6)]
+    outputs = [f"{2 * i}\n" for i in range(6)]
+    id2info = {"q0": _problem("q0", inputs, outputs)}
+    almost = (
+        "a, b = map(int, input().split())\n"
+        "print(a + b if a < 5 else a + b + 1)\n"
+    )
+    res = code_verify(
+        id2info, [STDIN_SUM, almost], ["q0", "q0"], test_case_batch_size=2
+    )
+    assert res == [1.0, 0.0]
+
+
+def test_infinite_loop_killed_within_wall_timeout():
+    id2info = {"q0": _problem("q0", ["1 2\n"], ["3\n"], timeout=2)}
+    t0 = time.monotonic()
+    res = code_verify(
+        id2info, ["while True:\n    pass\n"], ["q0"], job_wall_timeout=15
+    )
+    assert res == [0.0]
+    assert time.monotonic() - t0 < 60
+
+
+def test_float_tolerant_and_value_comparisons():
+    assert stdout_matches("3.0000001\n", "3.0\n")
+    assert not stdout_matches("3.1\n", "3.0\n")
+    assert stdout_matches("a b\nc\n", "a b \nc")
+    assert values_equal((1, 2), [1, 2])
+    assert values_equal({"a": [1.0, 2]}, {"a": [1.0000000001, 2]})
+    assert not values_equal([1, 2], [1, 2, 3])
+
+
+def test_extract_code_fenced_block():
+    txt = "Here's my solution:\n```python\nprint(1)\n```\ndone"
+    assert extract_code(txt) == "print(1)\n"
+    assert extract_code("no fence") == "no fence"
+
+
+def test_mixed_math_code_dispatch():
+    problems = [
+        {"query_id": "m0", "solutions": ["\\boxed{4}"]},
+        _problem("c0", ["1 2\n"], ["3\n"]),
+        {"query_id": "m1", "solutions": ["\\boxed{9}"]},
+    ]
+    texts = [
+        "The answer is \\boxed{4}",
+        f"```python\n{STDIN_SUM}```",
+        "The answer is \\boxed{8}",
+    ]
+    rewards = verify_batch_local(["math", "code", "math"], texts, problems)
+    assert rewards == [1.0, 1.0, 0.0]
+
+
+def test_math_verify_timeout_hardening():
+    from areal_tpu.verifiers.math_verify import math_verify
+
+    rewards = math_verify(
+        ["\\boxed{2}", "\\boxed{3}"], [["\\boxed{2}"], ["\\boxed{2}"]]
+    )
+    assert rewards == [1.0, 0.0]
+    # empty input fast path
+    assert math_verify([], []) == []
+
+
+def test_verifier_service_round_trip():
+    from areal_tpu.verifiers.service import VerifierClient, VerifierServer
+
+    server = VerifierServer().start()
+    try:
+        client = VerifierClient(server.url)
+        problems = [
+            {"query_id": "m0", "solutions": ["\\boxed{1}"]},
+            _problem("c0", [[2, 3]], ["5"], fn_name="add"),
+        ]
+        rewards = client.verify(
+            ["math", "code"],
+            ["\\boxed{1}", f"```python\n{CALL_ADD}```"],
+            problems,
+        )
+        assert rewards == [1.0, 1.0]
+        # unreachable server -> zeros, not an exception
+        bad = VerifierClient("http://127.0.0.1:9", retries=1, backoff=0.01)
+        assert bad.verify(["math"], ["x"], [problems[0]], timeout=2) == [0.0]
+    finally:
+        server.stop()
+
+
+def test_unit_test_style_no_cases():
+    id2info = {
+        "q0": {"query_id": "q0", "input_output": json.dumps({"inputs": [], "outputs": []})}
+    }
+    assert code_verify(id2info, ["x = 1\n"], ["q0"]) == [1.0]
+    assert code_verify(id2info, ["raise ValueError()\n"], ["q0"]) == [0.0]
+
+
+def test_malformed_problem_scores_zero():
+    # missing / None input_output must not raise (the reward path feeds
+    # these when a code-tagged row lacks testcases)
+    res = code_verify(
+        {"q0": {"query_id": "q0"}, "q1": {"query_id": "q1", "input_output": None}},
+        ["print(1)\n", "print(1)\n"],
+        ["q0", "q1"],
+    )
+    assert res == [0.0, 0.0]
