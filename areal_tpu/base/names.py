@@ -65,6 +65,13 @@ def model_version(
     return f"{trial_root(experiment_name, trial_name)}/model_version/{model_name}"
 
 
+def recover_load(
+    experiment_name: str, trial_name: str, model_name: str
+) -> str:
+    """Which recover checkpoint a model was reloaded from on restart."""
+    return f"{trial_root(experiment_name, trial_name)}/recover_load/{model_name}"
+
+
 def gen_servers(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/gen_servers/"
 
@@ -86,6 +93,11 @@ def experiment_status(experiment_name: str, trial_name: str) -> str:
 
 def used_ports(experiment_name: str, trial_name: str, host_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/used_ports/{host_name}/"
+
+
+def verifier_server(experiment_name: str, trial_name: str) -> str:
+    """Reward verifier service URL (reference: the functioncall cluster)."""
+    return f"{trial_root(experiment_name, trial_name)}/verifier_server"
 
 
 def metric_server(

@@ -116,7 +116,7 @@ def verify_math_solution(generated: str, solutions: List[str]) -> float:
 
 
 def parse_lines_in_parallel(
-    generateds: List[str], solutions_list: List[List[str]], max_workers: int = 8
+    generateds: List[str], solutions_list: List[List[str]]
 ) -> List[float]:
     """Verify many answers concurrently with timeout isolation.  Delegates
     to the hardened process-pool wrapper (areal_tpu/verifiers/math_verify.py)

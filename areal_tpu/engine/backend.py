@@ -110,17 +110,12 @@ class TrainBackend(model_api.ModelBackend):
     def save(self, model, save_dir: str):
         import os
 
-        os.makedirs(save_dir, exist_ok=True)
-        model.engine.save_optimizer_state(
-            os.path.join(save_dir, "optimizer.pkl")
-        )
+        model.engine.save_train_state(os.path.join(save_dir, "train_state"))
 
     def load(self, model, load_dir: str):
         import os
 
-        path = os.path.join(load_dir, "optimizer.pkl")
-        if os.path.exists(path):
-            model.engine.load_optimizer_state(path)
+        model.engine.load_train_state(os.path.join(load_dir, "train_state"))
 
 
 @dataclasses.dataclass

@@ -348,23 +348,15 @@ class TrainEngine:
             path, family, self.model_cfg, self.get_host_params(), tokenizer
         )
 
-    def save_optimizer_state(self, path: str):
-        import pickle
+    def save_train_state(self, path: str):
+        """Sharded {params, opt_state, version} checkpoint (per-host shard
+        writes via orbax; replaces the round-1 host-gathered pickle)."""
+        from areal_tpu.engine import checkpoint
 
-        host = jax.tree.map(lambda x: np.asarray(x), self.opt_state)
-        with open(path, "wb") as f:
-            pickle.dump(host, f)
+        checkpoint.save_train_state(self, path)
 
-    def load_optimizer_state(self, path: str):
-        import pickle
+    def load_train_state(self, path: str) -> bool:
+        from areal_tpu.engine import checkpoint
 
-        with open(path, "rb") as f:
-            host = pickle.load(f)
-        ref = self.opt_state
-        self.opt_state = jax.tree.map(
-            lambda x, r: jax.device_put(jnp.asarray(x), r.sharding)
-            if hasattr(r, "sharding")
-            else x,
-            host,
-            ref,
-        )
+        return checkpoint.load_train_state(self, path)
+

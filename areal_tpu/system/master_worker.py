@@ -121,8 +121,15 @@ class MasterWorker(worker_base.AsyncWorker):
             ),
         )
 
-        # recover?
-        info = recover.discover()
+        # recover? gated on the same flag the workers use for weight reload
+        # (apps/main.py sets it on restart attempts) so master StepInfo and
+        # worker weights can never silently diverge: without the flag a
+        # stale recover_info.json from an earlier trial is ignored
+        import os
+
+        info = (
+            recover.discover() if os.environ.get("AREAL_RECOVER") == "1" else None
+        )
         if info is not None:
             self._step_info = info.recover_start
             self._save_ctl.load_state_dict(info.save_ctl_states)
@@ -152,30 +159,51 @@ class MasterWorker(worker_base.AsyncWorker):
         )
 
     async def _save_models(self, tag: str):
+        """``save`` = persistent HF-format export (one worker host-gathers);
+        ``ckpt`` = recover checkpoint — sharded train state written by EVERY
+        SPMD peer of the group into the recover dir (reference: the save- vs
+        ckpt-frequency split of ExperimentSaveEvalControl, cli_args.py:702,
+        and the recover save realhf/system/model_worker.py:1159-1245)."""
         import os
 
-        base = constants.get_save_path()
         for mname in self._train_models():
-            path = os.path.join(
-                base,
-                mname,
-                f"epoch{self._step_info.epoch}"
-                f"epochstep{self._step_info.epoch_step}"
-                f"globalstep{self._step_info.global_step}",
-            )
             workers = self.config.model_groups[mname]
-            await group_request(
-                self._router,
-                self._stream,
-                workers[:1],
-                "save",
-                data={"model_name": mname, "path": path},
-            )
+            if tag == "ckpt":
+                path = os.path.join(
+                    constants.get_recover_path(),
+                    mname,
+                    f"globalstep{self._step_info.global_step}",
+                )
+                await group_request(
+                    self._router,
+                    self._stream,
+                    workers,
+                    "ckpt",
+                    data={"model_name": mname, "path": path},
+                )
+            else:
+                path = os.path.join(
+                    constants.get_save_path(),
+                    mname,
+                    f"epoch{self._step_info.epoch}"
+                    f"epochstep{self._step_info.epoch_step}"
+                    f"globalstep{self._step_info.global_step}",
+                )
+                await group_request(
+                    self._router,
+                    self._stream,
+                    workers[:1],
+                    "save",
+                    data={"model_name": mname, "path": path},
+                )
             self.logger.info("saved %s (%s) -> %s", mname, tag, path)
 
     def _recover_save(self):
+        # _step_info counts COMPLETED steps (incremented after each step),
+        # so the resume point IS the current value — the poll loop's own
+        # increment advances it when the next step completes
         info = recover.RecoverInfo(
-            recover_start=self._step_info.next(self._ft_spec.steps_per_epoch),
+            recover_start=self._step_info,
             last_step_info=self._step_info,
             save_ctl_states=self._save_ctl.state_dict(),
             eval_ctl_states=self._eval_ctl.state_dict(),

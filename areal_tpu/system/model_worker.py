@@ -172,6 +172,7 @@ class ModelWorker(worker_base.Worker):
         model = backend.initialize(model, ft_spec)
         self._models[name] = model
         self._backends[name] = backend
+        self._maybe_recover_load(name, backend, model)
         if shard.eval_dataset is not None:
             model.eval_dataset = dataset_api.make_dataset(
                 shard.eval_dataset,
@@ -182,6 +183,48 @@ class ModelWorker(worker_base.Worker):
             )
         self.logger.info("initialized model %s on mesh %s", name, shard.mesh_spec)
         return {"model_config": dataclasses.asdict(model.model_cfg)}
+
+    def _maybe_recover_load(self, name: str, backend, model):
+        """On a recover restart (AREAL_RECOVER=1, set by the launcher's
+        restart policy), reload the model's latest recover checkpoint —
+        weights, optimizer state, and version — instead of starting from the
+        initial weights (reference: realhf/system/model_worker.py:723-733;
+        master-side StepInfo restore alone would silently train a fresh
+        model)."""
+        if os.environ.get("AREAL_RECOVER") != "1":
+            return
+        from areal_tpu.base import recover
+        from areal_tpu.engine.checkpoint import latest_train_state
+
+        # cap at the master's recorded resume step: a crash between the
+        # ckpt write and the recover-info write must not replay one extra
+        # optimizer update
+        info = recover.discover()
+        max_step = info.recover_start.global_step if info else None
+        base = os.path.join(constants.get_recover_path(), name)
+        latest = latest_train_state(base, max_step=max_step)
+        if latest is None:
+            self.logger.info("recover: no checkpoint for %s; fresh start", name)
+            return
+        try:
+            backend.load(model, latest)
+            self.logger.info(
+                "recover: %s reloaded from %s (version %d)",
+                name,
+                latest,
+                getattr(model.engine, "version", -1),
+            )
+            from areal_tpu.base import name_resolve, names
+
+            name_resolve.add(
+                names.recover_load(
+                    constants.experiment_name(), constants.trial_name(), name
+                ),
+                latest,
+                replace=True,
+            )
+        except NotImplementedError:
+            pass
 
     def _get_interface(self, rpc_name: str) -> model_api.ModelInterface:
         if rpc_name not in self._interfaces:
@@ -282,9 +325,13 @@ class ModelWorker(worker_base.Worker):
         model = self._models[model_name]
         os.makedirs(path, exist_ok=True)
         model.engine.save_hf(path, model.backend_name, model.tokenizer)
+
+    def _ckpt_model(self, model_name: str, path: str):
+        """Recover checkpoint: sharded train state (params+optimizer+version),
+        every SPMD peer writing its own shards."""
         backend = self._backends[model_name]
         try:
-            backend.save(model, path)
+            backend.save(self._models[model_name], path)
         except NotImplementedError:
             pass
 
@@ -430,6 +477,9 @@ class ModelWorker(worker_base.Worker):
             }
         elif h == "save":
             self._save_model(req.data["model_name"], req.data["path"])
+            resp = "ok"
+        elif h == "ckpt":
+            self._ckpt_model(req.data["model_name"], req.data["path"])
             resp = "ok"
         elif h in ("train_step", "inference", "generate", "evaluate"):
             resp = self._handle_model_rpc(req)

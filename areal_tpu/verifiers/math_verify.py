@@ -21,7 +21,10 @@ from areal_tpu.data.math_parser import verify_math_solution
 
 logger = logging_.getLogger("math_verify")
 
+#: minimum collective deadline; the effective deadline scales with batch
+#: size so large reward batches are not spuriously zeroed
 DEFAULT_TIMEOUT = 60.0
+PER_ITEM_BUDGET = 5.0
 
 _pool: Optional[concurrent.futures.ProcessPoolExecutor] = None
 
@@ -56,14 +59,24 @@ def _shutdown_pool():
 def math_verify(
     generateds: List[str],
     solutions_list: List[List[str]],
-    timeout: float = DEFAULT_TIMEOUT,
+    timeout: Optional[float] = None,
 ) -> List[float]:
-    """Per-item 0/1 rewards; items unfinished by the deadline score 0."""
+    """Per-item 0/1 rewards; items unfinished by the deadline score 0.
+
+    The default deadline scales with batch size over pool width (a 256-item
+    PPO reward batch on 2 workers legitimately needs minutes; a fixed 60s
+    would zero the healthy tail)."""
     assert len(generateds) == len(solutions_list)
     if not generateds:
         return []
     global _pool
     pool = _get_pool()
+    if timeout is None:
+        workers = pool._max_workers
+        timeout = max(
+            DEFAULT_TIMEOUT,
+            PER_ITEM_BUDGET * len(generateds) / max(1, workers),
+        )
     try:
         futures = [
             pool.submit(verify_math_solution, g, s)

@@ -84,10 +84,9 @@ class VerifierServer:
             from areal_tpu.base import constants, name_resolve, names
 
             name_resolve.add_subentry(
-                names.metric_server_root(
+                names.verifier_server(
                     constants.experiment_name(), constants.trial_name()
-                )
-                + "verifier",
+                ),
                 self.url,
             )
 

@@ -1,0 +1,76 @@
+"""Sharded train-state checkpoint round trip (orbax): params, optimizer
+state, and version survive into a FRESH engine with different init,
+replacing the round-1 host-gathered pickle (VERDICT weak #6)."""
+
+import jax
+import numpy as np
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.engine.checkpoint import latest_train_state
+from areal_tpu.engine.optimizer import OptimizerConfig
+from areal_tpu.engine.train_engine import TrainEngine
+from areal_tpu.interfaces.sft_interface import sft_loss_fn
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+
+
+def _sample(cfg, rng):
+    seqlens = [12, 9, 17, 8]
+    total = sum(seqlens)
+    return SequenceSample.from_default(
+        seqlens=seqlens,
+        ids=list(range(len(seqlens))),
+        data={
+            "packed_input_ids": rng.integers(
+                0, cfg.vocab_size, (total,)
+            ).astype(np.int64),
+            "prompt_mask": np.zeros((total,), bool),
+        },
+    )
+
+
+def _make_engine(cfg, mesh, seed):
+    return TrainEngine(
+        cfg,
+        mesh,
+        transformer.init_params(cfg, jax.random.PRNGKey(seed)),
+        optimizer_cfg=OptimizerConfig(lr=1e-3),
+        total_train_steps=10,
+    )
+
+
+def test_train_state_round_trip(tmp_path):
+    cfg = tiny_config(vocab_size=128)
+    mesh = MeshSpec(data=2, fsdp=2, model=2).make_mesh()
+    rng = np.random.default_rng(0)
+    sample = _sample(cfg, rng)
+
+    engine = _make_engine(cfg, mesh, seed=0)
+    engine.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))
+    engine.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))
+    ckpt = str(tmp_path / "recover" / "actor" / "globalstep2")
+    engine.save_train_state(ckpt)
+    ref_params = engine.get_host_params()
+    ref_version = engine.version
+
+    # fresh engine with DIFFERENT init; restore must overwrite everything
+    fresh = _make_engine(cfg, mesh, seed=7)
+    assert fresh.load_train_state(ckpt)
+    assert fresh.version == ref_version == 2
+    got = fresh.get_host_params()
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # optimizer state restored too: one more step must match the original
+    # engine's continued trajectory exactly
+    s1 = engine.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))
+    s2 = fresh.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))
+    assert np.isclose(s1["loss"], s2["loss"], rtol=1e-5), (s1, s2)
+
+    # discovery picks the newest committed checkpoint
+    engine.save_train_state(str(tmp_path / "recover" / "actor" / "globalstep3"))
+    latest = latest_train_state(str(tmp_path / "recover" / "actor"))
+    assert latest is not None and latest.endswith("globalstep3")
+
+    # absent path -> False, no side effects
+    assert not fresh.load_train_state(str(tmp_path / "nope"))

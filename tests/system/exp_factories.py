@@ -17,6 +17,7 @@ def make_sync_ppo_exp(
     tokenizer_path,
     experiment_name="test-ppo",
     trial_name="e2e",
+    exp_ctrl=None,
     **ppo_kwargs,
 ):
     gen = GenerationHyperparameters(
@@ -27,9 +28,8 @@ def make_sync_ppo_exp(
         trial_name=trial_name,
         n_model_workers=1,
         mesh_spec=MeshSpec(data=2, model=2),
-        exp_ctrl=ExperimentSaveEvalControl(
-            total_train_epochs=1, benchmark_steps=2
-        ),
+        exp_ctrl=exp_ctrl
+        or ExperimentSaveEvalControl(total_train_epochs=1, benchmark_steps=2),
         tokenizer_path=tokenizer_path,
         actor=ModelAbstraction(
             "random", {"vocab_size": 256, "max_position_embeddings": 512}

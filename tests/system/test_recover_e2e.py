@@ -1,0 +1,83 @@
+"""Kill-and-resume: a sync PPO run is stopped after 2 steps (recover
+checkpoints written each step), then relaunched in recover mode — the
+master resumes from the saved StepInfo and the model worker reloads the
+actor's weights + optimizer + version from the sharded recover checkpoint
+(reference: the recover loop realhf/apps/main.py:108-288 with worker-side
+reload realhf/system/model_worker.py:723-733)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from tests.fixtures import dataset, dataset_path, save_path, tokenizer  # noqa: F401
+
+
+@pytest.fixture
+def tokenizer_path(tokenizer, save_path):
+    p = str(save_path / "tokenizer")
+    tokenizer.save_pretrained(p)
+    return p
+
+
+def _make(dataset_path, tokenizer_path, benchmark_steps):
+    from areal_tpu.api.system_api import ExperimentSaveEvalControl
+    from tests.system.exp_factories import make_sync_ppo_exp
+
+    return make_sync_ppo_exp(
+        dataset_path,
+        tokenizer_path,
+        trial_name="recover",
+        exp_ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=10,
+            benchmark_steps=benchmark_steps,
+            ckpt_freq_steps=1,
+        ),
+        kl_ctl=0.0,
+        disable_value=True,
+        use_decoupled_loss=True,
+    )
+
+
+def test_kill_and_resume(dataset_path, tokenizer_path, tmp_path, monkeypatch):
+    monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
+    monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
+
+    from areal_tpu.apps.local_runner import run_experiment_local
+    from areal_tpu.base import constants, name_resolve
+
+    # phase 1: train 2 steps with a recover ckpt every step, then "die"
+    exp = _make(dataset_path, tokenizer_path, benchmark_steps=2)
+    master1 = run_experiment_local(exp.initial_setup(), timeout=600)
+    assert len(master1.stats_history) == 2
+
+    recover_dirs = glob.glob(
+        str(tmp_path / "save" / "**" / "recover" / "actor*" / "globalstep*"),
+        recursive=True,
+    )
+    assert recover_dirs, "no recover checkpoints written"
+    assert any(d.endswith("globalstep2") for d in recover_dirs)
+
+    # fresh process-global state (the restart boundary)
+    name_resolve.reset()
+    constants.reset()
+
+    # phase 2: recover mode — resume to step 4
+    monkeypatch.setenv("AREAL_RECOVER", "1")
+    exp2 = _make(dataset_path, tokenizer_path, benchmark_steps=4)
+
+    master2 = run_experiment_local(exp2.initial_setup(), timeout=600)
+
+    # master resumed from step 2: only 2 more steps were run
+    assert len(master2.stats_history) == 2
+    assert master2._step_info.global_step == 4
+    assert np.isfinite(master2.stats_history[-1]["actor_train/loss"])
+    # the worker actually reloaded weights/optimizer from the ckpt (it
+    # records the source checkpoint in name_resolve)
+    from areal_tpu.base import names
+
+    loaded_from = name_resolve.get(
+        names.recover_load("test-ppo", "recover", "actor@0")
+    )
+    assert loaded_from.endswith("globalstep2"), loaded_from
