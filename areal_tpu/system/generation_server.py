@@ -115,12 +115,22 @@ class GenerationServerWorker(worker_base.Worker):
                 self._sock.send_multipart([ident, b"", pickle.dumps(out)])
 
     def _update_weights(self, payload: Dict) -> int:
-        """Load new weights (from the trainer's realloc dir) and hot-swap."""
+        """Load new weights (from the trainer's realloc dir) and hot-swap.
+
+        ``format == "params"`` is the fast path: a sharded raw-param orbax
+        tree restored straight onto this engine's shardings/dtypes (no HF
+        conversion, resharding handled by orbax).  Plain HF checkpoint dirs
+        remain accepted for cross-job swaps."""
         path = payload.get("path")
         version = payload.get("version")
-        from areal_tpu.models.hf.registry import load_hf_model
+        if payload.get("format") == "params":
+            from areal_tpu.engine import checkpoint
 
-        cfg, params = load_hf_model(path)
+            params = checkpoint.load_params_like(self.engine.params, path)
+        else:
+            from areal_tpu.models.hf.registry import load_hf_model
+
+            _, params = load_hf_model(path)
         return self.engine.update_weights(params, version=version)
 
     def metrics(self) -> Dict:

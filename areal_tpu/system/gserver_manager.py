@@ -151,7 +151,14 @@ class GserverManager(worker_base.Worker):
         n_interrupted = 0
         for addr, client in self._clients.items():
             resp = client.call(
-                "update_weights", {"path": info["path"], "version": version}
+                "update_weights",
+                {
+                    "path": info["path"],
+                    "version": version,
+                    # forward the checkpoint format so servers pick the
+                    # sharded raw-param load path for orbax trees
+                    "format": info.get("format"),
+                },
             )
             n_interrupted += resp["num_interrupted"]
         for addr, client in self._clients.items():

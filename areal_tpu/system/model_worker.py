@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -72,6 +73,9 @@ class ModelWorker(worker_base.Worker):
             config.worker_name,
         )
         self._models: Dict[str, model_api.Model] = {}
+        self._publish_lock = threading.Lock()
+        self._publish_threads: List[threading.Thread] = []
+        self._last_published_version: Dict[str, int] = {}
         self._backends: Dict[str, model_api.ModelBackend] = {}
         self._interfaces: Dict[str, model_api.ModelInterface] = {}
 
@@ -284,42 +288,85 @@ class ModelWorker(worker_base.Worker):
         dst.set_params(new)
 
     def _publish_weights(self, model_name: str):
-        """Save current weights to the realloc dir and publish the version in
+        """Write current weights to the realloc dir as a SHARDED raw-param
+        checkpoint (each host writes its own shards, inference dtype — no
+        host gather, no HF conversion) and publish the version in
         name_resolve — the train->generation weight sync trigger (reference:
         realhf/system/model_worker.py:787-812 post-train realloc save +
         version publish; gserver manager picks it up and hot-swaps)."""
         import pickle as _pickle
 
         from areal_tpu.base import name_resolve, names
+        from areal_tpu.engine import checkpoint
 
         model = self._models[model_name]
         version = model.version.global_step
+        role = model.name.role
         path = os.path.join(
-            constants.get_param_realloc_path(),
-            model.name.role,
-            f"v{version}",
+            constants.get_param_realloc_path(), role, f"v{version}"
         )
-        os.makedirs(path, exist_ok=True)
-        model.engine.save_hf(path, model.backend_name, model.tokenizer)
-        name_resolve.add(
-            names.model_version(
-                constants.experiment_name(),
-                constants.trial_name(),
-                model.name.role,
-            ),
-            _pickle.dumps({"version": version, "path": path}).hex(),
-            replace=True,
+        tik = time.monotonic()
+        # non-blocking: orbax snapshots the device buffers (~ms) and commits
+        # in a background thread; the trainer proceeds immediately
+        checkpoint.save_params(
+            model.engine.params,
+            path,
+            cast_dtype=model.model_cfg.dtype,
+            wait=False,
         )
-        # gc older snapshots (keep last 2; reference gserver_manager:287-305)
-        base = os.path.dirname(path)
-        snaps = sorted(
-            (d for d in os.listdir(base) if d.startswith("v")),
-            key=lambda d: int(d[1:]),
+        version_key = names.model_version(
+            constants.experiment_name(), constants.trial_name(), role
         )
-        for d in snaps[:-2]:
-            import shutil
+        payload = _pickle.dumps(
+            {"version": version, "path": path, "format": "params"}
+        ).hex()
 
-            shutil.rmtree(os.path.join(base, d), ignore_errors=True)
+        def _commit():
+            # advertise the version only once the checkpoint is durable,
+            # then gc older snapshots (keep last 2; ref gserver_manager
+            # :287-305)
+            try:
+                checkpoint.wait_for_saves()
+                with self._publish_lock:
+                    # concurrent commits may finish out of order (the
+                    # shared checkpointer waits for ALL pending saves);
+                    # never let an older version overwrite a newer key
+                    if version <= self._last_published_version.get(role, -1):
+                        return
+                    self._last_published_version[role] = version
+                    name_resolve.add(version_key, payload, replace=True)
+                    base = os.path.dirname(path)
+                    import re as _re
+                    import shutil
+
+                    snaps = sorted(
+                        (
+                            d
+                            for d in os.listdir(base)
+                            # skip orbax atomic-save tmp dirs of in-flight
+                            # publishes (e.g. 'v7.orbax-checkpoint-tmp-...')
+                            if _re.fullmatch(r"v\d+", d)
+                        ),
+                        key=lambda d: int(d[1:]),
+                    )
+                    for d in snaps[:-2]:
+                        shutil.rmtree(
+                            os.path.join(base, d), ignore_errors=True
+                        )
+                self.logger.debug(
+                    "published %s v%d in %.2fs (async commit)",
+                    model_name,
+                    version,
+                    time.monotonic() - tik,
+                )
+            except Exception:  # noqa: BLE001 - version stays unadvertised
+                self.logger.exception("weight publish v%d failed", version)
+
+        t = threading.Thread(
+            target=_commit, daemon=True, name=f"publish-{role}-v{version}"
+        )
+        self._publish_threads.append(t)
+        t.start()
 
     def _save_model(self, model_name: str, path: str):
         model = self._models[model_name]
@@ -511,6 +558,10 @@ class ModelWorker(worker_base.Worker):
         return worker_base.PollResult(sample_count=count)
 
     def _exit_hook(self):
+        # drain in-flight publish commits: the final trained version must be
+        # advertised before the process goes away
+        for t in getattr(self, "_publish_threads", []):
+            t.join(timeout=60)
         if hasattr(self, "_data_manager"):
             self._data_manager.close()
         if hasattr(self, "_stream"):

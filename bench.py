@@ -213,6 +213,27 @@ def main():
         )
     )
 
+    # train->generation weight publish: sharded raw-param checkpoint in
+    # inference dtype (the <1s single-host budget from the reference's <3s
+    # at 1k-GPU scale, blog/AReaL_v0_2.md:52-54)
+    import shutil
+    import tempfile
+
+    from areal_tpu.engine.checkpoint import save_params, wait_for_saves
+
+    pub_dir = tempfile.mkdtemp(prefix="areal-bench-pub-")
+    try:
+        save_params(gen_params, pub_dir + "/v0", cast_dtype="bfloat16")  # warm
+        t0 = time.perf_counter()
+        save_params(
+            gen_params, pub_dir + "/v1", cast_dtype="bfloat16", wait=False
+        )
+        publish_block_s = time.perf_counter() - t0  # trainer stall
+        wait_for_saves()
+        publish_commit_s = time.perf_counter() - t0  # durable + advertised
+    finally:
+        shutil.rmtree(pub_dir, ignore_errors=True)
+
     print(
         json.dumps(
             {
@@ -226,6 +247,8 @@ def main():
                     "tokens_per_sec": round(toks_per_sec, 1),
                     "step_time_s": round(dt, 4),
                     "tokens_per_step": tokens_per_step,
+                    "weight_publish_block_s": round(publish_block_s, 4),
+                    "weight_publish_commit_s": round(publish_commit_s, 3),
                     "generation": gen,
                 },
             }

@@ -74,3 +74,36 @@ def test_train_state_round_trip(tmp_path):
 
     # absent path -> False, no side effects
     assert not fresh.load_train_state(str(tmp_path / "nope"))
+
+
+def test_param_publish_round_trip(tmp_path):
+    """Fast weight-sync path: sharded raw-param save in inference dtype,
+    restored onto a DIFFERENT mesh layout (orbax reshards + casts)."""
+    import jax.numpy as jnp
+
+    cfg = tiny_config(vocab_size=128)
+    trainer_mesh = MeshSpec(data=2, fsdp=2, model=2).make_mesh()
+    engine = _make_engine(cfg, trainer_mesh, seed=0)
+    path = str(tmp_path / "publish" / "v1")
+
+    from areal_tpu.engine.checkpoint import load_params_like, save_params
+
+    save_params(engine.params, path, cast_dtype="bfloat16")
+
+    # consumer: single-device bf16 params (a generation engine's layout)
+    template = jax.tree.map(
+        lambda x: jax.device_put(
+            jnp.zeros(x.shape, jnp.bfloat16), jax.devices()[0]
+        ),
+        engine.get_host_params(),
+    )
+    restored = load_params_like(template, path)
+    ref = engine.get_host_params()
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(ref)):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32),
+            np.asarray(b, np.float32).astype(np.float32),
+            rtol=1e-2,
+            atol=1e-2,
+        )

@@ -1,0 +1,107 @@
+"""Train->generation weight sync integration: the trainer-side publish
+(sharded raw-param orbax checkpoint + version key) flows through the
+gserver manager's flush-and-update into a REAL generation server, which
+hot-swaps its engine weights via the format-aware load path
+(reference flow: realhf/system/model_worker.py:787-812 publish ->
+gserver_manager.py:158-190 flush + update_weights_from_disk)."""
+
+import pickle
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def trial(monkeypatch, tmp_path):
+    from areal_tpu.base import constants, name_resolve
+
+    monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
+    monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
+    name_resolve.reconfigure("memory")
+    constants.set_experiment_trial_names("pubtest", "t0")
+    return "pubtest", "t0"
+
+
+def test_publish_to_generation_server_hot_swap(trial):
+    from areal_tpu.api.config import ModelAbstraction
+    from areal_tpu.api.system_api import GenServerConfig, GserverManagerConfig
+    from areal_tpu.base import name_resolve, names
+    from areal_tpu.engine import checkpoint
+    from areal_tpu.engine.backend import make_model
+    from areal_tpu.system.generation_server import GenerationServerWorker
+    from areal_tpu.system.gserver_manager import GserverManager
+
+    expr, tr = trial
+    model_abs = ModelAbstraction(
+        "random", {"vocab_size": 64, "max_position_embeddings": 64}
+    )
+
+    server = GenerationServerWorker()
+    st = threading.Thread(
+        target=server.run,
+        args=(
+            GenServerConfig(
+                worker_name="gen_server_0",
+                model=model_abs,
+                max_concurrent_batch=2,
+                kv_cache_len=64,
+            ),
+        ),
+        daemon=True,
+    )
+    st.start()
+    name_resolve.wait(names.gen_server(expr, tr, "gen_server_0"), timeout=30)
+
+    manager = GserverManager()
+    mt = threading.Thread(
+        target=manager.run,
+        args=(GserverManagerConfig(worker_name="gserver_manager", n_servers=1),),
+        daemon=True,
+    )
+    mt.start()
+    name_resolve.wait(names.gen_server_manager(expr, tr), timeout=30)
+
+    try:
+        # trainer side: publish NEW weights the way model_worker does —
+        # sharded orbax params + version key with format tag
+        probe = make_model(model_abs, None, None)
+        new_params = jax.tree.map(
+            lambda x: jnp.asarray(np.asarray(x) + 0.25),
+            probe.init_params,
+        )
+        from areal_tpu.base import constants as _c
+        import os
+
+        path = os.path.join(_c.get_param_realloc_path(), "actor", "v3")
+        checkpoint.save_params(new_params, path, cast_dtype="bfloat16")
+        name_resolve.add(
+            names.model_version(expr, tr, "actor"),
+            pickle.dumps(
+                {"version": 3, "path": path, "format": "params"}
+            ).hex(),
+            replace=True,
+        )
+
+        # manager polls the version key and hot-swaps the server
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and server.engine.version != 3:
+            time.sleep(0.2)
+        assert server.engine.version == 3, "server never received v3 weights"
+        # the engine's params really are the published ones (bf16 cast)
+        got = jax.tree.leaves(server.engine.params)[0]
+        want = jax.tree.leaves(new_params)[0]
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(want, np.float32).astype(jnp.bfloat16).astype(np.float32),
+            rtol=1e-2,
+            atol=1e-2,
+        )
+    finally:
+        manager.exit()
+        server.exit()
+        mt.join(timeout=10)
+        st.join(timeout=10)
