@@ -32,7 +32,7 @@ import numpy as np
 # vary slowest (DCN-friendly), ``model`` fastest (ICI-ring-friendly): tensor
 # parallel collectives are the most latency sensitive so the model axis maps
 # onto adjacent chips.
-MESH_AXIS_ORDER = ("pipe", "data", "fsdp", "seq", "model")
+MESH_AXIS_ORDER = ("pipe", "data", "fsdp", "seq", "expert", "model")
 
 DATA_AXES = ("data", "fsdp")  # batch is sharded over these
 PARAM_AXES = ("fsdp", "model")  # params are sharded over these
@@ -50,6 +50,7 @@ class MeshSpec:
     model: int = 1
     pipe: int = 1
     seq: int = 1
+    expert: int = 1  # expert parallelism: shards the MoE expert dimension
 
     def __post_init__(self):
         for ax in MESH_AXIS_ORDER:
@@ -58,7 +59,14 @@ class MeshSpec:
 
     @property
     def world_size(self) -> int:
-        return self.data * self.fsdp * self.model * self.pipe * self.seq
+        return (
+            self.data
+            * self.fsdp
+            * self.model
+            * self.pipe
+            * self.seq
+            * self.expert
+        )
 
     @property
     def shape(self) -> Dict[str, int]:
@@ -99,9 +107,16 @@ class MeshSpec:
         """
         import re
 
-        mapping = {"d": "data", "f": "fsdp", "m": "model", "p": "pipe", "s": "seq"}
+        mapping = {
+            "d": "data",
+            "f": "fsdp",
+            "m": "model",
+            "p": "pipe",
+            "s": "seq",
+            "e": "expert",
+        }
         kwargs = {}
-        for m in re.finditer(r"([dfmps])(\d+)", s):
+        for m in re.finditer(r"([dfmpse])(\d+)", s):
             kwargs[mapping[m.group(1)]] = int(m.group(2))
         if not kwargs:
             raise ValueError(f"cannot parse mesh spec {s!r}")
@@ -109,7 +124,8 @@ class MeshSpec:
 
     def __str__(self):
         return (
-            f"d{self.data}f{self.fsdp}m{self.model}p{self.pipe}s{self.seq}"
+            f"d{self.data}f{self.fsdp}m{self.model}"
+            f"p{self.pipe}s{self.seq}e{self.expert}"
         )
 
 

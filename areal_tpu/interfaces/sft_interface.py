@@ -20,9 +20,16 @@ logger = logging_.getLogger("sft_interface")
 
 def sft_loss_fn(params, cfg, batch):
     """(loss_sum, token_count, stats). Labels = next token; prompt tokens and
-    padding are masked out of the loss."""
-    hidden = hidden_states(
-        params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
+    padding are masked out of the loss.  For MoE models the router's
+    load-balancing/z losses join the objective (reference:
+    realhf/impl/model/modules/moe/router.py aux tracking)."""
+    hidden, moe_aux = hidden_states(
+        params,
+        cfg,
+        batch["tokens"],
+        batch["positions"],
+        batch["seg_ids"],
+        with_aux=True,
     )
     B, T, D = hidden.shape
     w = head_weight(params, cfg).astype(hidden.dtype)
@@ -40,6 +47,14 @@ def sft_loss_fn(params, cfg, batch):
         h, w, labels.reshape(-1), mask
     )
     stats = {"nll_sum": loss_sum, "n_valid_tokens": count}
+    if cfg.is_moe:
+        # aux terms are per-batch means; scale by count so the engine's
+        # grad-accum normalization (sum over mbs / total denom) yields their
+        # denom-weighted mean added to the objective
+        aux_total = moe_aux["moe_aux_loss"] + moe_aux["moe_z_loss"]
+        loss_sum = loss_sum + aux_total * count
+        stats["moe_aux_loss_sum"] = moe_aux["moe_aux_loss"] * count
+        stats["moe_z_loss_sum"] = moe_aux["moe_z_loss"] * count
     return loss_sum, count, stats
 
 

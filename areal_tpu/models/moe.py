@@ -5,9 +5,9 @@ realhf/impl/model/modules/moe/router.py ``TopKRouter`` with aux/z losses,
 moe/experts.py:21-131 grouped GEMM experts, moe/token_dispatcher.py
 permute/unpermute) the TPU way: tokens are sorted by expert and the expert
 matmuls run as a single ``jax.lax.ragged_dot`` — the MXU-native equivalent of
-the CUDA ``grouped_gemm`` dependency.  Expert parallelism shards the expert
-axis of the weights over the ``model`` mesh axis (an ``expert`` mesh axis can
-be introduced transparently later since weights are [E, D, F]).
+the CUDA ``grouped_gemm`` dependency.  Expert parallelism shards the [E, ...]
+expert-weight dimension over the ``expert`` mesh axis (transformer.param_pspecs;
+SURVEY §2.9 EP — a capability beyond the reference's local-only MoE).
 """
 
 from __future__ import annotations
@@ -17,7 +17,6 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
 
 from areal_tpu.models.config import TransformerConfig
 
@@ -43,23 +42,19 @@ def init_moe_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
     }
 
 
-def moe_pspecs(cfg: TransformerConfig, lp) -> Dict[str, Any]:
-    return {
-        "router": {"w": P(lp, None, None)},
-        "experts": {
-            "gate": P(lp, None, "fsdp", "model"),
-            "up": P(lp, None, "fsdp", "model"),
-            "down": P(lp, None, "model", "fsdp"),
-        },
-    }
-
-
 def moe_mlp(
-    cfg: TransformerConfig, h: jax.Array, p: Dict[str, Any]
+    cfg: TransformerConfig,
+    h: jax.Array,
+    p: Dict[str, Any],
+    valid: jax.Array = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """h: [B, T, D] (per-layer params, no leading L).  Returns (out, aux)
     where aux carries the load-balancing and z losses
-    (reference: realhf/impl/model/modules/moe/router.py aux-loss/z-loss)."""
+    (reference: realhf/impl/model/modules/moe/router.py aux-loss/z-loss).
+
+    ``valid`` [B, T] bool masks padding out of the aux statistics — the
+    reference router sees packed pad-free tokens, so including pads here
+    would distort the load-balancing objective toward pad-token routing."""
     B, T, D = h.shape
     E, K = cfg.n_experts, cfg.n_experts_per_tok
     x = h.reshape(-1, D)
@@ -72,15 +67,23 @@ def moe_mlp(
     topk_probs, topk_idx = jax.lax.top_k(probs, K)  # [N, K]
     topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
-    # aux losses
-    me = jnp.mean(probs, axis=0)  # [E]
-    ce = jnp.mean(
-        jax.nn.one_hot(topk_idx, E).sum(axis=1), axis=0
+    # aux losses over VALID tokens only
+    if valid is None:
+        vmask = jnp.ones((N,), jnp.float32)
+    else:
+        vmask = valid.reshape(-1).astype(jnp.float32)
+    n_valid = jnp.maximum(jnp.sum(vmask), 1.0)
+    me = jnp.sum(probs * vmask[:, None], axis=0) / n_valid  # [E]
+    ce = (
+        jnp.sum(
+            jax.nn.one_hot(topk_idx, E).sum(axis=1) * vmask[:, None], axis=0
+        )
+        / n_valid
     )  # fraction routed per expert * K
     aux_loss = cfg.moe_aux_loss_coef * E * jnp.sum(me * ce) / K
-    z_loss = cfg.moe_z_loss_coef * jnp.mean(
-        jax.nn.logsumexp(router_logits, axis=-1) ** 2
-    )
+    z_loss = cfg.moe_z_loss_coef * jnp.sum(
+        jax.nn.logsumexp(router_logits, axis=-1) ** 2 * vmask
+    ) / n_valid
 
     # dispatch: sort token-expert pairs by expert id
     flat_expert = topk_idx.reshape(-1)  # [N*K]

