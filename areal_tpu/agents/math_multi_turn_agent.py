@@ -1,0 +1,153 @@
+"""Multi-turn math agent: retry-with-feedback loop.
+
+Rebuild of the reference's multi-turn agent (reference:
+realhf/impl/agent/math_multi_turn_agent.py — per turn: generate one answer,
+score it via the env, append a correct/wrong feedback message, continue up
+to ``num_turns``; turn rewards are discounted backward through the turn
+chain :209-213).
+
+Design divergence from the reference: each turn becomes its OWN trajectory
+``SequenceSample`` (id ``{qid}-t{j}``) carrying the discounted
+reward-to-go, instead of one multi-sequence sample per id — our data plane
+treats per-answer ids as the packing unit.  The training semantics
+(per-turn sequences with turn-level discounted rewards) are identical.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List
+
+import numpy as np
+
+from areal_tpu.api import agent_api, dataset_api, model_api
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("math_multi_turn_agent")
+
+FEEDBACK_CORRECT = "\nCongratulations! You are correct!\n"
+FEEDBACK_WRONG = "\nUnfortunately your answer is wrong. Let's try again.\n"
+
+
+class MathMultiTurnAgent(agent_api.Agent):
+    def __init__(
+        self,
+        gconfig: model_api.GenerationHyperparameters = None,
+        tokenizer_path: str = None,
+        num_turns: int = 5,
+        turn_level_discount: float = 1.0,
+        reward_scaling: float = 1.0,
+        reward_bias: float = 0.0,
+    ):
+        gconfig = gconfig or model_api.GenerationHyperparameters()
+        # one answer per turn; the group dimension is the turn chain
+        self.gconfig = gconfig.new(n=1)
+        self.tokenizer = (
+            dataset_api.load_hf_tokenizer(tokenizer_path)
+            if tokenizer_path
+            else None
+        )
+        self.num_turns = num_turns
+        self.turn_level_discount = turn_level_discount
+        self.reward_scaling = reward_scaling
+        self.reward_bias = reward_bias
+
+    def _feedback_ids(self, correct: bool) -> List[int]:
+        text = FEEDBACK_CORRECT if correct else FEEDBACK_WRONG
+        tok = self.tokenizer
+        if tok is None:
+            return []
+        if getattr(tok, "chat_template", None):
+            text = tok.apply_chat_template(
+                [dict(content=text.strip(), role="user")],
+                add_generation_prompt=True,
+                tokenize=False,
+            )
+        return tok(text, add_special_tokens=False)["input_ids"]
+
+    async def collect_trajectory(
+        self,
+        prompt: SequenceSample,
+        env,
+        obs_queue: asyncio.Queue,
+        act_queue: asyncio.Queue,
+    ) -> List[SequenceSample]:
+        qid = str(prompt.ids[0])
+        prompt_ids = prompt.data["packed_prompts"].tolist()
+        task = prompt.metadata.get("task", ["math"])[0]
+        problem = {
+            "query_id": qid,
+            "solutions": prompt.metadata.get("solutions", [[]])[0],
+            "input_output": prompt.metadata.get("input_output", [None])[0],
+        }
+
+        token_ids = list(prompt_ids)
+        turns = []  # (bundle, prompt_len_this_turn, success)
+        await env.reset()
+        for turn in range(self.num_turns):
+            await obs_queue.put((f"{qid}@t{turn}", token_ids, 1))
+            bundle: model_api.BundledGenerationOutputs = await act_queue.get()
+            _, rewards, *_ = await env.step(
+                {
+                    "qid": qid,
+                    "seqs": bundle.seqs,
+                    "prompt_len": len(token_ids),
+                    "task": task,
+                    "problem": problem,
+                }
+            )
+            success = float(rewards[0]) > 0
+            turns.append((bundle, len(token_ids), success))
+            if success:
+                break
+            # next turn continues from the full transcript + feedback
+            token_ids = list(bundle.seqs[0])
+            token_ids.extend(self._feedback_ids(success))
+
+        # turn-level discounted reward-to-go (reference :209-213): reward is
+        # ±1 per turn, later turns' rewards flow backward
+        raw = [
+            ((1.0 if s else -1.0) - self.reward_bias) * self.reward_scaling
+            for _, _, s in turns
+        ]
+        for i in reversed(range(len(raw) - 1)):
+            raw[i] = raw[i] + raw[i + 1] * self.turn_level_discount
+
+        now = time.time()
+        samples = []
+        for j, ((bundle, plen, _s), reward) in enumerate(zip(turns, raw)):
+            seq = bundle.seqs[0]
+            L = len(seq)
+            pmask = np.zeros(L, bool)
+            pmask[:plen] = True  # everything before this turn's generation
+            samples.append(
+                SequenceSample.from_default(
+                    seqlens=[L],
+                    ids=[f"{qid}-t{j}"],
+                    data={
+                        "packed_input_ids": np.asarray(seq, np.int64),
+                        "packed_logprobs": np.asarray(
+                            bundle.logprobs[0], np.float32
+                        ),
+                        "prompt_mask": pmask,
+                        "seq_no_eos_mask": np.asarray(
+                            [bundle.no_eos[0]], np.float32
+                        ),
+                        "rewards": np.asarray([reward], np.float32),
+                        "version_start": np.asarray(
+                            [bundle.version_start[0]], np.int32
+                        ),
+                        "version_end": np.asarray(
+                            [bundle.version_end[0]], np.int32
+                        ),
+                        "birth_time": np.asarray([now], np.float64),
+                    },
+                    metadata={"birth_time": [now]},
+                )
+            )
+        return samples
+
+
+agent_api.register_agent("math-multi-turn", MathMultiTurnAgent)

@@ -1,0 +1,293 @@
+"""Allocation modes: how devices are split between generation and training.
+
+TPU-native rebuild of the reference's allocation layer (reference:
+realhf/experiments/common/utils.py:245-372 ``AllocationMode`` with
+``sglang.d4p1m1+d2p2m1``-style decoupled strings, per-MFC ``key:value``
+hybrid strings, and the ``manual``/``heuristic`` modes; plus the allocation
+search of realhf/api/quickstart/search.py, an MCMC enumeration over
+device-mesh x parallel-strategy assignments driven by a FLOPs/memory cost
+model).
+
+Differences by design: parallel strategies are :class:`MeshSpec` axis shapes
+(``d``ata/``f``sdp/``m``odel/``p``ipe/``s``eq/``e``xpert) instead of
+3D p/m/d tuples — on TPU a strategy IS a mesh shape, XLA inserts the
+collectives — and the decoupled prefix is ``gen.`` (the native engine
+replaces the vLLM/SGLang server split).  The search enumerates mesh
+factorizations and scores them with an analytic HBM + step-time model
+rather than profiling runs; it is deterministic and runs in microseconds,
+which a TPU can afford because the strategy space is tiny (axis sizes are
+powers of two on a fixed chip count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import re
+from typing import Dict, Optional
+
+from areal_tpu.base.topology import MeshSpec
+
+_GEN_PREFIXES = ("gen", "vllm", "sglang", "mock")  # accepted for parity
+
+
+class AllocationType(enum.Enum):
+    DECOUPLED = 1  # separate gen + train device sets (async RL)
+    GLOBAL_HYBRID = 2  # one device set, per-MFC (or uniform) strategies
+    MANUAL = 3  # caller supplies everything
+    HEURISTIC = 4  # search_allocation picks the split
+
+
+@dataclasses.dataclass
+class AllocationMode:
+    type_: AllocationType
+    # strategy per scope: "*" = every MFC, "gen" = generation cluster,
+    # otherwise an MFC name (e.g. "actor_train")
+    strategies: Dict[str, MeshSpec] = dataclasses.field(default_factory=dict)
+
+    def is_decoupled(self) -> bool:
+        return self.type_ == AllocationType.DECOUPLED
+
+    @property
+    def gen_spec(self) -> MeshSpec:
+        assert self.is_decoupled(), "gen spec only exists in decoupled mode"
+        return self.strategies["gen"]
+
+    @property
+    def gen_size(self) -> int:
+        return self.gen_spec.world_size
+
+    def train_spec(self, rpc_name: str = "*") -> MeshSpec:
+        if rpc_name in self.strategies:
+            return self.strategies[rpc_name]
+        return self.strategies["*"]
+
+    @property
+    def train_size(self) -> int:
+        return max(s.world_size for k, s in self.strategies.items() if k != "gen")
+
+    @classmethod
+    def from_str(cls, s: str) -> "AllocationMode":
+        """Parse an allocation string.
+
+        Forms (mirroring the reference grammar)::
+
+            manual | heuristic
+            d2f2m2                      # uniform hybrid
+            actor_train:d2f2m2,ref_inf:d4m2   # per-MFC hybrid
+            gen.d4m1+d2f2m1             # decoupled: gen cluster + trainer
+            gen.d4m1+actor_train:d2m2,ref_inf:d4   # decoupled, per-MFC
+        """
+        s = s.strip()
+        if s == "manual":
+            return cls(AllocationType.MANUAL)
+        if s == "heuristic":
+            return cls(AllocationType.HEURISTIC)
+        m = re.match(
+            rf"^(?:{'|'.join(_GEN_PREFIXES)})\.([^+]+)\+(.+)$", s
+        )
+        if m:
+            strategies = _parse_hybrid(m.group(2))
+            strategies["gen"] = MeshSpec.from_str(m.group(1))
+            return cls(AllocationType.DECOUPLED, strategies)
+        return cls(AllocationType.GLOBAL_HYBRID, _parse_hybrid(s))
+
+    def __str__(self):
+        if self.type_ == AllocationType.MANUAL:
+            return "manual"
+        if self.type_ == AllocationType.HEURISTIC:
+            return "heuristic"
+        parts = [
+            f"{k}:{v}" if k not in ("*", "gen") else str(v)
+            for k, v in self.strategies.items()
+            if k != "gen"
+        ]
+        body = ",".join(parts)
+        if self.is_decoupled():
+            return f"gen.{self.strategies['gen']}+{body}"
+        return body
+
+
+def _parse_hybrid(s: str) -> Dict[str, MeshSpec]:
+    strategies: Dict[str, MeshSpec] = {}
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, spec = part.split(":", 1)
+            strategies[name.strip()] = MeshSpec.from_str(spec.strip())
+        else:
+            strategies["*"] = MeshSpec.from_str(part)
+    if not strategies:
+        raise ValueError(f"cannot parse allocation {s!r}")
+    if "*" not in strategies:
+        # per-MFC-only strings still need a default for unlisted MFCs:
+        # use the largest listed strategy
+        strategies["*"] = max(
+            strategies.values(), key=lambda m: m.world_size
+        )
+    return strategies
+
+
+# ---------------------------------------------------------------------------
+# Allocation search (reference: realhf/api/quickstart/search.py — ours is an
+# analytic enumeration instead of MCMC over profiled costs)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ModelFootprint:
+    """Inputs to the cost model, derivable from a TransformerConfig."""
+
+    n_params: int
+    n_layers: int
+    hidden_dim: int
+    # bytes per param of train state BEYOND the master weights: bf16 grads
+    # + 2x fp32 adam moments = 2 + 4 + 4
+    train_state_bytes_per_param: float = 10.0
+    param_bytes: float = 4.0  # fp32 master weights
+
+    @classmethod
+    def from_config(cls, cfg) -> "ModelFootprint":
+        from areal_tpu.models import transformer
+
+        import jax
+
+        # shape-only init is cheap: eval_shape avoids allocating
+        shapes = jax.eval_shape(
+            lambda: transformer.init_params(cfg, jax.random.PRNGKey(0))
+        )
+        n = sum(
+            int(_prod(x.shape)) for x in jax.tree.leaves(shapes)
+        )
+        return cls(
+            n_params=n, n_layers=cfg.n_layers, hidden_dim=cfg.hidden_dim
+        )
+
+
+def _prod(t):
+    out = 1
+    for x in t:
+        out *= x
+    return out
+
+
+def _pow2_factorizations(n: int):
+    """(data, model) splits of n with power-of-two model sizes."""
+    m = 1
+    while m <= n:
+        if n % m == 0:
+            yield n // m, m
+        m *= 2
+
+
+def estimate_train_hbm(
+    fp: ModelFootprint,
+    spec: MeshSpec,
+    tokens_per_device_batch: int,
+    remat: bool = True,
+) -> float:
+    """Bytes of HBM needed per chip for one train step.
+
+    Persistent state shards over (fsdp x model); activations scale with the
+    per-device token count.  With full remat only ~2 live activations per
+    layer boundary survive the forward scan (carry + residual); without it
+    every layer's activations are live.
+    """
+    shards = spec.fsdp * spec.model * spec.pipe * spec.expert
+    state = fp.n_params * (fp.param_bytes + fp.train_state_bytes_per_param)
+    state_per_chip = state / shards
+    act_bytes_per_tok = fp.hidden_dim * 2  # bf16
+    live_layers = 4 if remat else fp.n_layers
+    acts = tokens_per_device_batch * act_bytes_per_tok * live_layers
+    # logits buffer dominates transiently for LM heads; charge one copy
+    return state_per_chip + acts * 4  # 4x: grads of acts + workspace
+
+
+def _comm_penalty(spec: MeshSpec) -> float:
+    """Relative step-time penalty of collectives: model-axis collectives are
+    per-layer (expensive), fsdp gathers are per-step (cheap), data-axis
+    all-reduce is per-step (cheapest)."""
+    penalty = 1.0
+    if spec.model > 1:
+        penalty *= 1.0 + 0.06 * (spec.model - 1)
+    if spec.fsdp > 1:
+        penalty *= 1.03
+    if spec.pipe > 1:
+        penalty *= 1.0 + 0.10 * (spec.pipe - 1)  # bubble cost
+    return penalty
+
+
+def search_allocation(
+    n_devices: int,
+    footprint: ModelFootprint,
+    tokens_per_step: int,
+    hbm_bytes: float = 16e9,  # v5e default
+    decoupled_gen_fraction: Optional[float] = None,
+) -> AllocationMode:
+    """Pick the best mesh shape(s) for ``n_devices`` chips.
+
+    Enumerates (fsdp, model) power-of-two factorizations, keeps those whose
+    estimated HBM fits, and among those picks the one with the smallest
+    communication penalty (pure FSDP wins when it fits — the scaling-book
+    recipe — model parallelism only buys its cost back when state doesn't
+    fit).  With ``decoupled_gen_fraction`` the device set is split
+    gen/train first (async RL), mirroring the reference heuristic's
+    gen-device carve-out.
+    """
+    if decoupled_gen_fraction:
+        n_gen = max(1, round(n_devices * decoupled_gen_fraction))
+        n_train = n_devices - n_gen
+        assert n_train >= 1, "no devices left for training"
+        train = search_allocation(
+            n_train, footprint, tokens_per_step, hbm_bytes
+        )
+        return AllocationMode(
+            AllocationType.DECOUPLED,
+            {
+                "*": train.strategies["*"],
+                # gen replicates the model per server unless it can't fit:
+                # bf16 inference state is n_params * 2 bytes
+                "gen": _gen_spec(n_gen, footprint, hbm_bytes),
+            },
+        )
+
+    best = None
+    for data, model in _pow2_factorizations(n_devices):
+        for fsdp_of_data in (d for d in _divisors_pow2(data)):
+            spec = MeshSpec(
+                data=data // fsdp_of_data, fsdp=fsdp_of_data, model=model
+            )
+            per_dev_toks = max(1, tokens_per_step // spec.dp_size)
+            need = estimate_train_hbm(footprint, spec, per_dev_toks)
+            if need > hbm_bytes * 0.92:  # leave allocator headroom
+                continue
+            score = _comm_penalty(spec)
+            if best is None or score < best[0]:
+                best = (score, spec)
+    if best is None:
+        raise ValueError(
+            f"model does not fit on {n_devices} devices with any strategy"
+        )
+    return AllocationMode(AllocationType.GLOBAL_HYBRID, {"*": best[1]})
+
+
+def _divisors_pow2(n: int):
+    d = 1
+    while d <= n:
+        if n % d == 0:
+            yield d
+        d *= 2
+
+
+def _gen_spec(n_gen: int, fp: ModelFootprint, hbm_bytes: float) -> MeshSpec:
+    # smallest model-parallel degree whose bf16 weights + KV budget fit
+    m = 1
+    while m <= n_gen:
+        weights = fp.n_params * 2 / m
+        if weights < hbm_bytes * 0.4:  # rest is KV cache
+            if n_gen % m == 0:
+                return MeshSpec(data=n_gen // m, model=m)
+        m *= 2
+    raise ValueError(f"generation weights do not fit on {n_gen} devices")

@@ -138,6 +138,24 @@ class GserverManagerConfig:
 
 
 @dataclasses.dataclass
+class EvaluatorConfig:
+    """Automatic-evaluator knobs (reference: cli_args AutomaticEvaluator —
+    ours points the watcher at the saved-checkpoint tree and an eval
+    dataset instead of a slurm image)."""
+
+    dataset_path: str
+    model_name: str = "actor"
+    max_prompts: int = 64
+    max_new_tokens: int = 256
+    interval: float = 5.0
+    # JAX platform for the eval subprocess. Default "cpu": the training
+    # workers already own the local accelerator chips (one process per
+    # chip), so an eval job sharing the host must not touch them. Set to
+    # "tpu" only when the evaluator runs on its own host/slice.
+    device: str = "cpu"
+
+
+@dataclasses.dataclass
 class ExperimentConfig:
     experiment_name: str
     trial_name: str
@@ -152,6 +170,7 @@ class ExperimentConfig:
         default_factory=list
     )
     gserver_manager: Optional[GserverManagerConfig] = None
+    evaluator: Optional[EvaluatorConfig] = None
 
     def lazy_init(self):
         """Build the MFC graph and sanity-check worker wiring
@@ -209,3 +228,15 @@ def register_experiment(name: str, cls: Callable[[], Experiment]):
 
 def make_experiment(name: str, *args, **kwargs) -> Experiment:
     return _EXPERIMENTS[name](*args, **kwargs)
+
+
+def experiment_cls(name: str) -> Callable[[], Experiment]:
+    if name not in _EXPERIMENTS:
+        raise KeyError(
+            f"unknown experiment {name!r}; registered: {sorted(_EXPERIMENTS)}"
+        )
+    return _EXPERIMENTS[name]
+
+
+def list_experiments() -> List[str]:
+    return sorted(_EXPERIMENTS)

@@ -1,0 +1,133 @@
+"""Offline evaluation CLI: score a saved checkpoint on a prompt dataset.
+
+The in-repo eval job the automatic evaluator submits per checkpoint
+(reference: the ``evaluation/`` suite invoked by
+realhf/scheduler/evaluator.py via ``install_deps_and_eval.sh``; ours loads
+the HF-format checkpoint into the native continuous-batching engine,
+generates one answer per prompt, scores with the local verifiers, and
+writes an aggregate JSON).
+
+Usage::
+
+    python -m areal_tpu.apps.eval --ckpt DIR --dataset D.jsonl \
+        --output OUT.json [--max-prompts N] [--max-new-tokens M]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def evaluate_checkpoint(
+    ckpt_dir: str,
+    dataset_path: str,
+    max_prompts: int = 64,
+    max_new_tokens: int = 512,
+    kv_cache_len: int = 2048,
+    max_batch: int = 16,
+) -> dict:
+    from transformers import AutoTokenizer
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.data.math_code_dataset import load_metadata
+    from areal_tpu.engine.inference_server import ContinuousBatchingEngine
+    from areal_tpu.models.hf.registry import load_hf_model
+    from areal_tpu.verifiers.dispatch import verify_batch
+
+    cfg, params = load_hf_model(ckpt_dir)
+    tokenizer = AutoTokenizer.from_pretrained(ckpt_dir)
+    engine = ContinuousBatchingEngine(
+        cfg,
+        params,
+        tokenizer=tokenizer,
+        max_batch=max_batch,
+        kv_cache_len=kv_cache_len,
+    )
+
+    id2info, task_cnt = load_metadata(dataset_path)
+    items = list(id2info.values())[:max_prompts]
+    gcfg = GenerationHyperparameters(
+        max_new_tokens=max_new_tokens, greedy=True
+    )
+    t0 = time.time()
+    prompt_lens = {}
+    for d in items:
+        ids = tokenizer(d["prompt"])["input_ids"]
+        prompt_lens[d["query_id"]] = len(ids)
+        engine.submit(
+            APIGenerateInput(
+                qid=d["query_id"], prompt_ids=ids, input_ids=ids, gconfig=gcfg
+            )
+        )
+    outs = {}
+    while len(outs) < len(items):
+        engine.step()
+        for d in items:
+            if d["query_id"] in outs:
+                continue
+            r = engine.try_get_result(d["query_id"])
+            if r is not None:
+                outs[d["query_id"]] = r
+    gen_time = time.time() - t0
+
+    texts, tasks, problems = [], [], []
+    for d in items:
+        seq = outs[d["query_id"]].seqs[0]
+        answer = tokenizer.decode(
+            seq[prompt_lens[d["query_id"]] :], skip_special_tokens=True
+        )
+        texts.append(answer)
+        tasks.append(d.get("task", "math"))
+        problems.append(d)
+    rewards = verify_batch(tasks, texts, problems)
+
+    per_task: dict = {}
+    for t, r in zip(tasks, rewards):
+        per_task.setdefault(t, []).append(r)
+    result = {
+        "dataset": os.path.basename(dataset_path),
+        "n_prompts": len(items),
+        "accuracy": sum(rewards) / max(1, len(rewards)),
+        "per_task": {
+            t: {"accuracy": sum(rs) / len(rs), "n": len(rs)}
+            for t, rs in per_task.items()
+        },
+        "gen_time_s": round(gen_time, 2),
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="areal_tpu offline evaluation")
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--max-prompts", type=int, default=64)
+    p.add_argument("--max-new-tokens", type=int, default=512)
+    p.add_argument("--kv-cache-len", type=int, default=2048)
+    args = p.parse_args(argv)
+    result = evaluate_checkpoint(
+        args.ckpt,
+        args.dataset,
+        max_prompts=args.max_prompts,
+        max_new_tokens=args.max_new_tokens,
+        kv_cache_len=args.kv_cache_len,
+    )
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
+    tmp = args.output + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2)
+    os.replace(tmp, args.output)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

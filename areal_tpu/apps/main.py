@@ -135,6 +135,31 @@ def _launch_once(
         raise
 
 
+def _make_evaluator(cfg: system_api.ExperimentConfig):
+    """Checkpoint-watching evaluator driven by the controller loop
+    (reference: realhf/apps/main.py:96-154 builds the AutomaticEvaluator and
+    steps it while monitoring)."""
+    if cfg.evaluator is None:
+        return None
+    from areal_tpu.base.metrics import MetricsLogger
+    from areal_tpu.scheduler.evaluator import AutomaticEvaluator
+
+    ecfg = cfg.evaluator
+    return AutomaticEvaluator(
+        ckpt_root=os.path.join(constants.get_save_path(), ecfg.model_name),
+        dataset_path=ecfg.dataset_path,
+        output_root=os.path.join(constants.get_log_path(), "eval"),
+        metrics=MetricsLogger(
+            os.path.join(constants.get_log_path(), "eval"),
+            experiment_name=cfg.experiment_name,
+            trial_name=cfg.trial_name,
+        ),
+        max_prompts=ecfg.max_prompts,
+        max_new_tokens=ecfg.max_new_tokens,
+        env={**os.environ, "JAX_PLATFORMS": ecfg.device},
+    )
+
+
 def _monitor(
     sched,
     cfg: system_api.ExperimentConfig,
@@ -154,6 +179,47 @@ def _monitor(
     # faster, heartbeats catch hosts that vanish without reaping
     hb_timeout = float(os.environ.get("AREAL_HEARTBEAT_TIMEOUT", "60"))
     panel = WorkerControlPanel(cfg.experiment_name, cfg.trial_name)
+    evaluator = _make_evaluator(cfg)
+    last_eval_step = time.monotonic()
+    completed = False
+    try:
+        _monitor_loop(
+            sched,
+            cfg,
+            deadline,
+            status_key,
+            master_name,
+            panel,
+            all_names,
+            hb_timeout,
+            evaluator,
+            last_eval_step,
+        )
+        completed = True
+    finally:
+        # every exit path (worker failure, timeout, Ctrl-C) must reap the
+        # detached eval subprocess or a restart would race the orphan
+        if evaluator is not None:
+            evaluator._harvest()
+            evaluator.shutdown()
+        if not completed:
+            panel.close()
+
+    _shutdown_workers(sched, cfg, specs, panel, master_name)
+
+
+def _monitor_loop(
+    sched,
+    cfg,
+    deadline,
+    status_key,
+    master_name,
+    panel,
+    all_names,
+    hb_timeout,
+    evaluator,
+    last_eval_step,
+):
     last_hb_check = time.monotonic()
     while True:
         for job in sched.find_all():
@@ -184,10 +250,17 @@ def _monitor(
                 raise JobException(
                     sched.run_name, stale[0], "?", JobState.FAILED
                 )
+        if evaluator is not None and (
+            time.monotonic() - last_eval_step > cfg.evaluator.interval
+        ):
+            last_eval_step = time.monotonic()
+            evaluator.step()
         if deadline and time.monotonic() > deadline:
             raise TimeoutError("experiment timed out")
         time.sleep(0.5)
 
+
+def _shutdown_workers(sched, cfg, specs, panel, master_name):
     # master done: ask everyone else to exit, then reap
     others = [w for t, i, w in specs if w != master_name]
     try:

@@ -56,8 +56,32 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
     gen_device_start: Optional[int] = None
     success_rate_lb: float = 0.0
     success_rate_ub: float = 1.0
+    # agent selection (reference: async_ppo_math_exp overrides the agent;
+    # "math-multi-turn" enables the retry-with-feedback loop)
+    agent_type: str = "math-single-step"
+    num_turns: int = 5
+    turn_level_discount: float = 1.0
+
+    def _heuristic_gen_fraction(self):
+        return 0.25  # reference heuristic carves ~1/4 of devices for gen
 
     def initial_setup(self) -> system_api.ExperimentConfig:
+        # decoupled allocation strings size the rollout cluster before the
+        # trainer graph is built (reference: decoupled AllocationMode carving
+        # gen devices out of the cluster, experiments/common/utils.py:245)
+        am = self.resolve_allocation()
+        if am is not None and am.is_decoupled():
+            gen = am.gen_spec
+            if gen.model * gen.pipe * gen.seq * gen.expert != 1:
+                raise ValueError(
+                    "generation servers are single-chip engines for now; "
+                    f"use a data-only gen spec (got gen.{gen})"
+                )
+            self.n_gen_servers = gen.data
+            if self.gen_device_start is None:
+                # gen devices sit after the LARGEST per-MFC trainer mesh,
+                # not just the default '*' strategy
+                self.gen_device_start = am.train_size
         cfg = super().initial_setup()
         ppo = self.ppo
         actor = ModelName("actor")
@@ -111,26 +135,46 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
             )
             for i in range(self.n_gen_servers)
         ]
+        # staleness accounting converts rollouts -> sequences via group_size;
+        # the multi-turn agent emits ONE answer per turn (1..num_turns seqs
+        # per rollout), so counting group_size seqs per rollout would
+        # over-count and can gate allocation forever (deadlock: allocations
+        # stop before a train batch can fill). Count the guaranteed minimum.
+        staleness_group_size = (
+            1 if self.agent_type == "math-multi-turn" else self.group_size
+        )
         cfg.gserver_manager = GserverManagerConfig(
             n_servers=self.n_gen_servers,
             schedule_policy="least_requests",
             max_head_offpolicyness=self.max_head_offpolicyness,
             train_batch_size=self.train_bs_n_seqs,
-            group_size=self.group_size,
+            group_size=staleness_group_size,
             max_concurrent_rollouts=self.max_concurrent_rollouts,
             flush_request_timeout=self.flush_request_timeout,
         )
+        if self.agent_type == "math-multi-turn":
+            agent_abs = AgentAbstraction(
+                "math-multi-turn",
+                {
+                    "gconfig": gen_gconfig,
+                    "tokenizer_path": self.tokenizer_path,
+                    "num_turns": self.num_turns,
+                    "turn_level_discount": self.turn_level_discount,
+                },
+            )
+        else:
+            agent_abs = AgentAbstraction(
+                self.agent_type,
+                {
+                    "gconfig": gen_gconfig,
+                    "success_rate_lb": self.success_rate_lb,
+                    "success_rate_ub": self.success_rate_ub,
+                },
+            )
         cfg.rollout_workers = [
             RolloutWorkerConfig(
                 worker_name=f"rollout_worker_{i}",
-                agent=AgentAbstraction(
-                    "math-single-step",
-                    {
-                        "gconfig": gen_gconfig,
-                        "success_rate_lb": self.success_rate_lb,
-                        "success_rate_ub": self.success_rate_ub,
-                    },
-                ),
+                agent=agent_abs,
                 env=EnvServiceAbstraction(
                     "math-code-single-step",
                     {"tokenizer_path": self.tokenizer_path},

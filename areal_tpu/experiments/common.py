@@ -32,9 +32,71 @@ class CommonExperimentConfig(system_api.Experiment):
         default_factory=ExperimentSaveEvalControl
     )
     tokenizer_path: Optional[str] = None
+    # compact allocation string ("d2f2m2", "gen.d2m1+d4f2m1", "heuristic")
+    # overriding mesh_spec / the gen-device split (reference:
+    # CommonExperimentConfig.allocation_mode, experiments/common/common.py:189)
+    allocation_mode: str = ""
     # run on N virtual CPU devices instead of the accelerator (debug/CI mode,
     # mirrors the reference's CPU test harness realhf/base/testing.py)
     force_cpu_devices: Optional[int] = None
+
+    def resolve_allocation(self):
+        """Apply ``allocation_mode`` to mesh_spec; returns the parsed mode
+        (or None).  Decoupled gen placement is applied by the async
+        experiment, which owns the gen-server configs."""
+        if not self.allocation_mode:
+            return None
+        from areal_tpu.api.allocation import AllocationMode, AllocationType
+
+        am = AllocationMode.from_str(self.allocation_mode)
+        if am.type_ == AllocationType.HEURISTIC:
+            am = self._solve_heuristic_allocation()
+        if am.type_ != AllocationType.MANUAL:
+            self.mesh_spec = am.train_spec()
+        return am
+
+    # -- heuristic allocation hooks (overridden by concrete experiments) ----
+
+    def _heuristic_model_config(self):
+        """TransformerConfig of the trained model, or None when the
+        experiment cannot derive one."""
+        return None
+
+    def _heuristic_tokens_per_step(self) -> int:
+        return 32768
+
+    def _heuristic_gen_fraction(self) -> Optional[float]:
+        """Fraction of devices carved out for generation (async RL)."""
+        return None
+
+    def _solve_heuristic_allocation(self):
+        cfg = self._heuristic_model_config()
+        if cfg is None:
+            raise ValueError(
+                "allocation_mode='heuristic' is not supported by "
+                f"{type(self).__name__} (no model footprint); pass an "
+                "explicit strategy string like 'd2f2m1'"
+            )
+        import jax
+
+        from areal_tpu.api.allocation import (
+            ModelFootprint,
+            search_allocation,
+        )
+
+        stats = {}
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except Exception:  # noqa: BLE001 - backend-dependent
+            pass
+        hbm = float(stats.get("bytes_limit", 16e9))
+        return search_allocation(
+            len(jax.devices()),
+            ModelFootprint.from_config(cfg),
+            self._heuristic_tokens_per_step(),
+            hbm_bytes=hbm,
+            decoupled_gen_fraction=self._heuristic_gen_fraction(),
+        )
 
     def apply_device_overrides(self):
         if self.force_cpu_devices:

@@ -76,6 +76,37 @@ class PPOMathExperiment(CommonExperimentConfig):
     critic_optimizer: OptimizerConfig = dataclasses.field(
         default_factory=lambda: OptimizerConfig(lr=5e-6)
     )
+    # collapse rew_inf + ref_inf into one fused MFC on the ref model
+    # (reference: fused_interface.py; saves a dispatch + overlaps the CPU
+    # verifier with the ref forward). Only takes effect when use_ref.
+    fuse_rew_ref: bool = False
+
+    def _heuristic_model_config(self):
+        if self.actor is None:
+            return None
+        if self.actor.type_ == "hf":
+            from areal_tpu.models.hf.registry import load_hf_config
+
+            _, cfg, _ = load_hf_config(self.actor.args["path"])
+            return cfg
+        if self.actor.type_ == "random":
+            from areal_tpu.models.config import TransformerConfig, tiny_config
+
+            args = dict(self.actor.args)
+            args.pop("seed", None)
+            conf = args.pop("config", None)
+            if isinstance(conf, TransformerConfig):
+                return conf
+            if conf is not None:
+                return TransformerConfig(**conf)
+            return tiny_config(**args)
+        return None
+
+    def _heuristic_tokens_per_step(self) -> int:
+        # prompts + generations for one train batch (upper bound: every
+        # sequence at the generation budget)
+        per_seq = self.ppo.gen.max_new_tokens + 512
+        return self.train_bs_n_seqs * max(1, self.group_size) * per_seq
 
     @property
     def use_critic(self) -> bool:
@@ -86,6 +117,7 @@ class PPOMathExperiment(CommonExperimentConfig):
         return self.ppo.kl_ctl != 0.0
 
     def initial_setup(self) -> system_api.ExperimentConfig:
+        self.resolve_allocation()  # allocation_mode -> mesh_spec
         ppo = self.ppo
         actor = ModelName("actor")
         critic = ModelName("critic")
@@ -159,17 +191,40 @@ class PPOMathExperiment(CommonExperimentConfig):
         rpcs.append(actor_gen)
         interfaces["actor_gen"] = actor_iface
 
-        rew_inf = MFCDef(
-            name="rew_inf",
-            model_name=reward,
-            interface_type=ModelInterfaceType.INFERENCE,
-            interface_impl=rw_iface,
-            input_keys=("packed_input_ids", "prompt_mask"),
-            output_keys=("rewards",),
-            n_seqs=n,
-        )
-        rpcs.append(rew_inf)
-        interfaces["rew_inf"] = rw_iface
+        fused = self.fuse_rew_ref and self.use_ref
+        if fused:
+            from areal_tpu.interfaces.fused_interface import (  # noqa: F401
+                FusedInferenceInterface,
+            )
+
+            fused_iface = ModelInterfaceAbstraction(
+                "fused-inference",
+                {"interfaces": {"rew": rw_iface, "ref": ref_iface}},
+            )
+            rpcs.append(
+                MFCDef(
+                    name="rew_ref_inf",
+                    model_name=ref,
+                    interface_type=ModelInterfaceType.INFERENCE,
+                    interface_impl=fused_iface,
+                    input_keys=("packed_input_ids", "prompt_mask"),
+                    output_keys=("rewards", "packed_ref_logprobs"),
+                    n_seqs=n,
+                )
+            )
+            interfaces["rew_ref_inf"] = fused_iface
+        else:
+            rew_inf = MFCDef(
+                name="rew_inf",
+                model_name=reward,
+                interface_type=ModelInterfaceType.INFERENCE,
+                interface_impl=rw_iface,
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("rewards",),
+                n_seqs=n,
+            )
+            rpcs.append(rew_inf)
+            interfaces["rew_inf"] = rw_iface
 
         train_input_keys = [
             "packed_input_ids",
@@ -179,18 +234,19 @@ class PPOMathExperiment(CommonExperimentConfig):
             "seq_no_eos_mask",
         ]
         if self.use_ref:
-            rpcs.append(
-                MFCDef(
-                    name="ref_inf",
-                    model_name=ref,
-                    interface_type=ModelInterfaceType.INFERENCE,
-                    interface_impl=ref_iface,
-                    input_keys=("packed_input_ids", "prompt_mask"),
-                    output_keys=("packed_ref_logprobs",),
-                    n_seqs=n,
+            if not fused:
+                rpcs.append(
+                    MFCDef(
+                        name="ref_inf",
+                        model_name=ref,
+                        interface_type=ModelInterfaceType.INFERENCE,
+                        interface_impl=ref_iface,
+                        input_keys=("packed_input_ids", "prompt_mask"),
+                        output_keys=("packed_ref_logprobs",),
+                        n_seqs=n,
+                    )
                 )
-            )
-            interfaces["ref_inf"] = ref_iface
+                interfaces["ref_inf"] = ref_iface
             train_input_keys.append("packed_ref_logprobs")
         if self.use_critic:
             rpcs.append(
@@ -277,13 +333,16 @@ class PPOMathExperiment(CommonExperimentConfig):
                 ),
                 mesh_spec=self.mesh_spec,
             ),
-            ModelShard(
-                model_name=reward,
-                model=ModelAbstraction("null"),
-                backend=ModelBackendAbstraction("null"),
-                mesh_spec=self.mesh_spec,
-            ),
         ]
+        if not fused:
+            shards.append(
+                ModelShard(
+                    model_name=reward,
+                    model=ModelAbstraction("null"),
+                    backend=ModelBackendAbstraction("null"),
+                    mesh_spec=self.mesh_spec,
+                )
+            )
         if self.use_ref:
             shards.append(
                 ModelShard(

@@ -1,0 +1,85 @@
+"""Fused inference interface: run several sub-interfaces as ONE MFC.
+
+Rebuild of the reference's fused forward interface (reference:
+realhf/impl/model/interface/fused_interface.py:23
+``FusedThreadingForwardInterface`` — sub-interfaces run in a thread pool and
+their output samples are unioned), used to collapse ``rew_inf`` + ``ref_inf``
+into a single dispatch.
+
+On TPU the fusion win is real concurrency, not just fewer dispatches: the
+reward verifier is host-side CPU work (sympy / sandboxed code execution)
+while the ref forward occupies the chip — threading overlaps them, and the
+single MFC halves the data-plane transfers for the shared
+``packed_input_ids`` payload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict
+
+from areal_tpu.api import model_api
+from areal_tpu.api.config import ModelInterfaceAbstraction
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("fused_interface")
+
+
+@dataclasses.dataclass
+class FusedInferenceInterface(model_api.ModelInterface):
+    """``interfaces``: name -> sub-interface abstraction (or instance)."""
+
+    def __init__(self, interfaces: Dict[str, ModelInterfaceAbstraction]):
+        self.interfaces = {
+            key: (
+                iface
+                if isinstance(iface, model_api.ModelInterface)
+                else model_api.make_interface(
+                    ModelInterfaceAbstraction(**iface)
+                    if isinstance(iface, dict)
+                    else iface
+                )
+            )
+            for key, iface in interfaces.items()
+        }
+
+    def _run_one(self, name, model, data, mb_spec):
+        tik = time.perf_counter()
+        res = self.interfaces[name].inference(model, data, mb_spec)
+        logger.debug(
+            "fused sub-interface %s took %.3fs", name, time.perf_counter() - tik
+        )
+        return res
+
+    def inference(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> SequenceSample | None:
+        with ThreadPoolExecutor(max_workers=len(self.interfaces)) as pool:
+            futs = {
+                name: pool.submit(self._run_one, name, model, data, mb_spec)
+                for name in self.interfaces
+            }
+            results = {name: f.result() for name, f in futs.items()}
+        merged = None
+        for name in self.interfaces:  # deterministic merge order
+            res = results[name]
+            if res is None:
+                continue
+            if merged is None:
+                merged = res
+            else:
+                merged.update_(res)
+        return merged
+
+    def save(self, model, save_dir):
+        for iface in self.interfaces.values():
+            iface.save(model, save_dir)
+
+
+model_api.register_interface("fused-inference", FusedInferenceInterface)

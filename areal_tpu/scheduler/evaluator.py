@@ -1,0 +1,216 @@
+"""Automatic evaluator: watch the checkpoint dir, eval each new save.
+
+Rebuild of the reference's evaluator (reference:
+realhf/scheduler/evaluator.py:34 ``AutomaticEvaluator`` / :131
+``EvaluationStep`` — discovers ``epoch{X}epochstep{Y}globalstep{Z}``
+checkpoint dirs as they appear, submits one offline eval job per
+checkpoint (at most one running), parses the result JSON, and logs scores
+keyed by global step).  Ours submits the in-repo eval CLI
+(areal_tpu/apps/eval.py) as a subprocess — no slurm/singularity
+dependency — and fans scores out through the shared MetricsLogger
+(tensorboard + stats JSONL; wandb/swanlab opt-in).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.base import logging_
+
+logger = logging_.getLogger("evaluator")
+
+CKPT_DIR_RE = re.compile(r"epoch(\d+)epochstep(\d+)globalstep(\d+)")
+
+
+class EvalStatus(enum.Enum):
+    PENDING = 0
+    RUNNING = 1
+    DONE = 2
+    FAILED = 3
+
+
+@dataclasses.dataclass
+class EvaluationStep:
+    global_step: int
+    ckpt_dir: str
+    output_path: str
+    status: EvalStatus = EvalStatus.PENDING
+    process: Optional[subprocess.Popen] = None
+
+    @classmethod
+    def from_ckpt_dir(cls, ckpt_dir: str, output_root: str):
+        m = CKPT_DIR_RE.fullmatch(os.path.basename(ckpt_dir))
+        if m is None:
+            return None
+        step = int(m.group(3))
+        return cls(
+            global_step=step,
+            ckpt_dir=ckpt_dir,
+            output_path=os.path.join(
+                output_root, f"globalstep{step}", "eval_result.json"
+            ),
+        )
+
+
+class AutomaticEvaluator:
+    """Poll-driven: call :meth:`step` periodically (the launcher's monitor
+    loop or a dedicated thread)."""
+
+    def __init__(
+        self,
+        ckpt_root: str,
+        dataset_path: str,
+        output_root: str,
+        metrics=None,
+        max_prompts: int = 64,
+        max_new_tokens: int = 256,
+        env: Optional[Dict[str, str]] = None,
+        eval_argv=None,  # (EvaluationStep) -> argv; test seam
+    ):
+        self._eval_argv = eval_argv or self._default_argv
+        self.ckpt_root = ckpt_root
+        self.dataset_path = dataset_path
+        self.output_root = output_root
+        self.metrics = metrics
+        self.max_prompts = max_prompts
+        self.max_new_tokens = max_new_tokens
+        self._env = env
+        self._steps: Dict[int, EvaluationStep] = {}
+        # resume: outputs that already exist are LOGGED equivalents
+        if os.path.isdir(output_root):
+            for d in os.listdir(output_root):
+                m = re.fullmatch(r"globalstep(\d+)", d)
+                p = os.path.join(output_root, d, "eval_result.json")
+                if m and os.path.isfile(p):
+                    step = int(m.group(1))
+                    self._steps[step] = EvaluationStep(
+                        step, "", p, status=EvalStatus.DONE
+                    )
+
+    def _default_argv(self, step: "EvaluationStep") -> List[str]:
+        return [
+            sys.executable,
+            "-m",
+            "areal_tpu.apps.eval",
+            "--ckpt",
+            step.ckpt_dir,
+            "--dataset",
+            self.dataset_path,
+            "--output",
+            step.output_path,
+            "--max-prompts",
+            str(self.max_prompts),
+            "--max-new-tokens",
+            str(self.max_new_tokens),
+        ]
+
+    def _discover(self):
+        if not os.path.isdir(self.ckpt_root):
+            return
+        for d in sorted(os.listdir(self.ckpt_root)):
+            full = os.path.join(self.ckpt_root, d)
+            if not os.path.isdir(full):
+                continue
+            step = EvaluationStep.from_ckpt_dir(full, self.output_root)
+            if step is not None and step.global_step not in self._steps:
+                self._steps[step.global_step] = step
+                logger.info(
+                    "discovered checkpoint for eval: globalstep%d",
+                    step.global_step,
+                )
+
+    def _maybe_submit(self):
+        if any(s.status == EvalStatus.RUNNING for s in self._steps.values()):
+            return  # at most one eval at a time (reference behavior)
+        pending = sorted(
+            (s for s in self._steps.values() if s.status == EvalStatus.PENDING),
+            key=lambda s: s.global_step,
+        )
+        if not pending:
+            return
+        step = pending[0]
+        os.makedirs(os.path.dirname(step.output_path), exist_ok=True)
+        log_path = os.path.join(
+            os.path.dirname(step.output_path), "output.log"
+        )
+        with open(log_path, "ab") as log_file:
+            step.process = subprocess.Popen(
+                self._eval_argv(step),
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                env=self._env,
+                start_new_session=True,
+            )
+        step.status = EvalStatus.RUNNING
+        logger.info("submitted eval for globalstep%d", step.global_step)
+
+    def _harvest(self):
+        for step in self._steps.values():
+            if step.status != EvalStatus.RUNNING:
+                continue
+            rc = step.process.poll()
+            if rc is None:
+                continue
+            if rc != 0 or not os.path.isfile(step.output_path):
+                step.status = EvalStatus.FAILED
+                logger.warning(
+                    "eval for globalstep%d failed (rc=%s)",
+                    step.global_step,
+                    rc,
+                )
+                continue
+            try:
+                with open(step.output_path) as f:
+                    result = json.load(f)
+            except json.JSONDecodeError:
+                step.status = EvalStatus.FAILED
+                continue
+            step.status = EvalStatus.DONE
+            scores = {"eval/accuracy": result.get("accuracy", 0.0)}
+            for t, d in result.get("per_task", {}).items():
+                scores[f"eval/{t}_accuracy"] = d["accuracy"]
+            if self.metrics is not None:
+                self.metrics.log(scores, step.global_step)
+            logger.info(
+                "eval globalstep%d: %s", step.global_step, scores
+            )
+
+    def step(self):
+        self._discover()
+        self._harvest()
+        self._maybe_submit()
+
+    @property
+    def results(self) -> Dict[int, str]:
+        return {
+            s.global_step: s.output_path
+            for s in self._steps.values()
+            if s.status == EvalStatus.DONE
+        }
+
+    def shutdown(self):
+        for s in self._steps.values():
+            if s.status == EvalStatus.RUNNING and s.process is not None:
+                s.process.terminate()
+
+
+def run_evaluator_loop(
+    evaluator: AutomaticEvaluator,
+    stop_event,
+    interval: float = 5.0,
+):
+    """Drive an evaluator until ``stop_event`` is set, then drain."""
+    while not stop_event.wait(interval):
+        evaluator.step()
+    # final sweep: harvest anything that finished, but don't start new jobs
+    evaluator._discover()
+    evaluator._harvest()
+    evaluator.shutdown()

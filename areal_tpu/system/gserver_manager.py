@@ -119,11 +119,13 @@ class GserverManager(worker_base.Worker):
         self.n_running_rollouts = max(0, self.n_running_rollouts - 1)
         if accepted:
             self.accepted_rollouts += 1
-        # scheduling registered per-group-member qids "{qid}-{i}"
+        # scheduling registered per-group-member qids "{qid}-{i}"; multi-turn
+        # agents prefix per-turn requests as "{qid}@t{j}" before the member
+        # suffix, so both derived forms must be swept
         for k in [
             k
             for k in self._qid_server
-            if k == qid or k.startswith(qid + "-")
+            if k == qid or k.startswith(qid + "-") or k.startswith(qid + "@")
         ]:
             srv = self._qid_server.pop(k)
             self._server_load[srv] = max(0, self._server_load[srv] - 1)

@@ -66,12 +66,17 @@ class MasterWorker(worker_base.AsyncWorker):
         self.stats: Dict[str, Any] = {}
         self.stats_history = []
         from areal_tpu.base.metrics import MetricsLogger
+        from areal_tpu.base.monitor import UtilizationMonitor
 
         self._metrics = MetricsLogger(
             constants.get_log_path(),
             experiment_name=constants.experiment_name(),
             trial_name=constants.trial_name(),
         )
+        # device-HBM/host sampler (reference: the gpu_utilization_monitor
+        # thread, realhf/base/monitor.py:266)
+        self._util_monitor = UtilizationMonitor()
+        self._util_monitor.start()
 
     async def _lazy_init(self):
         cfg = self.config
@@ -227,6 +232,7 @@ class MasterWorker(worker_base.AsyncWorker):
         # master-side per-MFC tracking (elapsed / tflops / tok_s recorded by
         # the executor) joins the worker-reported interface stats
         stats.update(stats_tracker.export())
+        stats.update(self._util_monitor.export())
         self.stats = stats
         self.stats_history.append(stats)
         self._metrics.log(stats, step.global_step)
@@ -292,3 +298,5 @@ class MasterWorker(worker_base.AsyncWorker):
             self._stream.close()
         if hasattr(self, "_metrics"):
             self._metrics.close()
+        if hasattr(self, "_util_monitor"):
+            self._util_monitor.stop()

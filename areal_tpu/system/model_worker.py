@@ -374,8 +374,17 @@ class ModelWorker(worker_base.Worker):
 
     def _save_model(self, model_name: str, path: str):
         model = self._models[model_name]
-        os.makedirs(path, exist_ok=True)
-        model.engine.save_hf(path, model.backend_name, model.tokenizer)
+        # write-then-rename so watchers (the automatic evaluator's checkpoint
+        # discovery) never see a half-written HF dir; the tmp name does not
+        # match the epoch...globalstep... pattern the evaluator scans for
+        tmp = path.rstrip("/") + f".tmp-{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        model.engine.save_hf(tmp, model.backend_name, model.tokenizer)
+        if os.path.isdir(path):
+            import shutil
+
+            shutil.rmtree(path)
+        os.replace(tmp, path)
 
     def _ckpt_model(self, model_name: str, path: str):
         """Recover checkpoint: sharded train state (params+optimizer+version),

@@ -75,18 +75,36 @@ class RolloutWorker(worker_base.AsyncWorker):
         act_q: asyncio.Queue = asyncio.Queue()
 
         async def gen_pump():
-            q, prompt_ids, group_size = await obs_q.get()
-            bundle = await self.prm.generate_group(q, prompt_ids, group_size)
-            await act_q.put(bundle)
+            # loop: multi-turn agents issue one obs per turn (reference:
+            # math_multi_turn_agent.py); cancelled when the agent returns
+            while True:
+                q, prompt_ids, group_size = await obs_q.get()
+                bundle = await self.prm.generate_group(
+                    q, prompt_ids, group_size
+                )
+                await act_q.put(bundle)
 
         pump = asyncio.create_task(gen_pump())
         self._gen_tasks.add(pump)
         pump.add_done_callback(self._gen_tasks.discard)
         accepted = False
+        agent_task = asyncio.create_task(
+            self.agent.collect_trajectory(prompt_sample, self.env, obs_q, act_q)
+        )
         try:
-            trajs = await self.agent.collect_trajectory(
-                prompt_sample, self.env, obs_q, act_q
+            # wait on BOTH: a pump failure must surface instead of leaving
+            # the agent blocked on act_q forever (slot would never release)
+            await asyncio.wait(
+                {agent_task, pump}, return_when=asyncio.FIRST_COMPLETED
             )
+            if not agent_task.done():
+                agent_task.cancel()
+                try:
+                    await agent_task
+                except asyncio.CancelledError:
+                    pass
+                pump.result()  # raises the pump's exception
+            trajs = await agent_task
             accepted = len(trajs) > 0
             if accepted:
                 self.pusher.push([t.as_json_compatible() for t in trajs])

@@ -1,0 +1,140 @@
+"""Multi-turn agent unit test with stub env/queues (mirrors the reference's
+tests/agent/test_math_single_step_agent.py pattern): per-turn generate ->
+score -> feedback loop, early stop on success, turn-level discounted
+rewards flowing backward."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.api import model_api
+from areal_tpu.api.data import SequenceSample
+
+
+class StubEnv:
+    """Scores turn i as (in)correct per a script."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    async def reset(self, seed=None, options=None):
+        return None, {}
+
+    async def step(self, action):
+        ok = self.script[self.calls]
+        self.calls += 1
+        return None, [1.0 if ok else 0.0], True, False, {}
+
+
+def _bundle(seq, prompt_len):
+    return model_api.BundledGenerationOutputs(
+        qid="q0",
+        prompt_ids=seq[:prompt_len],
+        seqs=[list(seq)],
+        logprobs=[[0.0] * (len(seq) - 1)],
+        no_eos=[False],
+        version_start=[0],
+        version_end=[0],
+    )
+
+
+@pytest.fixture
+def tok_path(tmp_path):
+    from tests.fixtures import TESTING_DATASET_SIZE  # noqa: F401 - same tok
+
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordPiece
+    from tokenizers.pre_tokenizers import Whitespace
+    from tokenizers.trainers import WordPieceTrainer
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(WordPiece(unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    tok.train_from_iterator(
+        ["congratulations you are correct wrong try again"],
+        WordPieceTrainer(vocab_size=80, special_tokens=["[UNK]", "[PAD]"]),
+    )
+    hf = PreTrainedTokenizerFast(
+        tokenizer_object=tok, unk_token="[UNK]", pad_token="[PAD]"
+    )
+    p = str(tmp_path / "tok")
+    hf.save_pretrained(p)
+    return p
+
+
+def _run_agent(agent, script):
+    """Drive collect_trajectory with a pump that echoes canned bundles."""
+    prompt = SequenceSample.from_default(
+        seqlens=[3],
+        ids=["q0"],
+        data={"packed_prompts": np.array([5, 6, 7])},
+        metadata={"task": ["math"], "solutions": [["\\boxed{1}"]]},
+    )
+    env = StubEnv(script)
+
+    async def main():
+        obs_q: asyncio.Queue = asyncio.Queue()
+        act_q: asyncio.Queue = asyncio.Queue()
+
+        async def pump():
+            while True:
+                qid, token_ids, n = await obs_q.get()
+                assert n == 1
+                # "generation": transcript + 2 new tokens
+                await act_q.put(
+                    _bundle(list(token_ids) + [8, 9], len(token_ids))
+                )
+
+        t = asyncio.create_task(pump())
+        try:
+            return await agent.collect_trajectory(prompt, env, obs_q, act_q)
+        finally:
+            t.cancel()
+
+    return asyncio.run(main())
+
+
+def test_multi_turn_loops_until_success(tok_path):
+    from areal_tpu.agents.math_multi_turn_agent import MathMultiTurnAgent
+
+    agent = MathMultiTurnAgent(
+        gconfig=model_api.GenerationHyperparameters(max_new_tokens=4, n=4),
+        tokenizer_path=tok_path,
+        num_turns=4,
+        turn_level_discount=0.5,
+    )
+    assert agent.gconfig.n == 1  # forced to one answer per turn
+
+    samples = _run_agent(agent, [False, False, True, True])
+    assert len(samples) == 3  # early stop on first success (turn 3)
+    # discounted rewards backward: r = [-1, -1, 1], gamma=0.5
+    # r1 = -1 + 0.5 * r2; r2 = -1 + 0.5 * 1 = -0.5; r1 = -1.25
+    rewards = [float(s.data["rewards"][0]) for s in samples]
+    np.testing.assert_allclose(rewards, [-1.25, -0.5, 1.0])
+    # each turn's prompt mask covers the whole transcript prefix
+    for s in samples:
+        pm = s.data["prompt_mask"]
+        L = len(s.data["packed_input_ids"])
+        assert pm[: L - 2].all() and not pm[L - 2 :].any()
+    # turn t+1's sequence extends turn t's (transcript + feedback tokens)
+    l0 = len(samples[0].data["packed_input_ids"])
+    l1 = len(samples[1].data["packed_input_ids"])
+    assert l1 > l0
+    assert [f"q0-t{j}" for j in range(3)] == [s.ids[0] for s in samples]
+
+
+def test_multi_turn_exhausts_budget(tok_path):
+    from areal_tpu.agents.math_multi_turn_agent import MathMultiTurnAgent
+
+    agent = MathMultiTurnAgent(
+        gconfig=model_api.GenerationHyperparameters(max_new_tokens=4),
+        tokenizer_path=tok_path,
+        num_turns=3,
+        turn_level_discount=1.0,
+    )
+    samples = _run_agent(agent, [False, False, False])
+    assert len(samples) == 3
+    rewards = [float(s.data["rewards"][0]) for s in samples]
+    np.testing.assert_allclose(rewards, [-3.0, -2.0, -1.0])

@@ -1,0 +1,143 @@
+"""Allocation mode parsing + analytic allocation search (reference:
+realhf/experiments/common/utils.py AllocationMode grammar and
+realhf/api/quickstart/search.py)."""
+
+import pytest
+
+from areal_tpu.api.allocation import (
+    AllocationMode,
+    AllocationType,
+    ModelFootprint,
+    estimate_train_hbm,
+    search_allocation,
+)
+from areal_tpu.base.topology import MeshSpec
+
+
+def test_parse_uniform_hybrid():
+    am = AllocationMode.from_str("d2f2m2")
+    assert am.type_ == AllocationType.GLOBAL_HYBRID
+    assert am.train_spec() == MeshSpec(data=2, fsdp=2, model=2)
+    assert am.train_spec("anything") == am.train_spec()
+
+
+def test_parse_per_mfc_hybrid():
+    am = AllocationMode.from_str("actor_train:d2f2m2,ref_inf:d4m2")
+    assert am.train_spec("actor_train") == MeshSpec(data=2, fsdp=2, model=2)
+    assert am.train_spec("ref_inf") == MeshSpec(data=4, model=2)
+    # unlisted MFCs fall back to the largest listed strategy
+    assert am.train_spec("critic_inf").world_size == 8
+
+
+def test_parse_decoupled():
+    am = AllocationMode.from_str("gen.d4m1+d2f2m1")
+    assert am.is_decoupled()
+    assert am.gen_size == 4
+    assert am.gen_spec == MeshSpec(data=4)
+    assert am.train_spec() == MeshSpec(data=2, fsdp=2)
+    # reference-compat prefixes parse identically
+    assert AllocationMode.from_str("sglang.d4m1+d2f2m1").gen_size == 4
+
+
+def test_parse_modes_and_roundtrip():
+    assert AllocationMode.from_str("manual").type_ == AllocationType.MANUAL
+    assert (
+        AllocationMode.from_str("heuristic").type_ == AllocationType.HEURISTIC
+    )
+    am = AllocationMode.from_str("gen.d2m2+d4f2m1")
+    assert AllocationMode.from_str(str(am)).strategies == am.strategies
+    with pytest.raises(ValueError):
+        AllocationMode.from_str("nonsense!!")
+
+
+FP_7B = ModelFootprint(n_params=7_000_000_000, n_layers=32, hidden_dim=4096)
+FP_05B = ModelFootprint(n_params=500_000_000, n_layers=24, hidden_dim=1024)
+
+
+def test_search_small_model_prefers_pure_dp():
+    am = search_allocation(
+        8, FP_05B, tokens_per_step=32768, hbm_bytes=16e9
+    )
+    spec = am.train_spec()
+    assert spec.world_size <= 8
+    assert spec.model == 1  # fits without TP -> no TP (scaling-book rule)
+
+
+def test_search_large_model_shards_state():
+    # 7B train state (~126GB) cannot fit one 16GB chip: search must shard
+    am = search_allocation(8, FP_7B, tokens_per_step=32768, hbm_bytes=16e9)
+    spec = am.train_spec()
+    assert spec.fsdp * spec.model * spec.pipe >= 8
+    need = estimate_train_hbm(FP_7B, spec, 32768 // spec.dp_size)
+    assert need < 16e9
+
+
+def test_search_unfittable_raises():
+    with pytest.raises(ValueError):
+        search_allocation(1, FP_7B, tokens_per_step=4096, hbm_bytes=16e9)
+
+
+def test_search_decoupled_carves_gen_devices():
+    am = search_allocation(
+        8,
+        FP_05B,
+        tokens_per_step=32768,
+        hbm_bytes=16e9,
+        decoupled_gen_fraction=0.25,
+    )
+    assert am.is_decoupled()
+    assert am.gen_size == 2
+    assert am.train_spec().world_size <= 6
+
+
+def test_async_experiment_applies_decoupled_allocation(tmp_path):
+    # allocation string sizes the rollout cluster + trainer mesh
+    import json
+
+    from tests.system.exp_factories import make_async_ppo_exp
+
+    data = tmp_path / "d.jsonl"
+    rows = [
+        {"qid": str(i), "prompt": "1+1?", "solutions": ["\\boxed{2}"],
+         "task": "math"}
+        for i in range(4)
+    ]
+    data.write_text("\n".join(json.dumps(r) for r in rows))
+    exp = make_async_ppo_exp(str(data), None)
+    exp.allocation_mode = "gen.d2m1+d2f2m1"
+    exp.gen_device_start = None
+    cfg = exp.initial_setup()
+    assert len(cfg.gen_servers) == 2
+    assert cfg.gen_servers[0].device_idx == 4  # right after the trainer mesh
+    assert exp.mesh_spec.world_size == 4
+
+
+def test_heuristic_allocation_resolves_from_model(tmp_path):
+    import json
+
+    from tests.system.exp_factories import make_sync_ppo_exp
+
+    data = tmp_path / "d.jsonl"
+    rows = [
+        {"qid": str(i), "prompt": "1+1?", "solutions": ["\\boxed{2}"],
+         "task": "math"}
+        for i in range(4)
+    ]
+    data.write_text("\n".join(json.dumps(r) for r in rows))
+    exp = make_sync_ppo_exp(str(data), None)
+    exp.allocation_mode = "heuristic"
+    am = exp.resolve_allocation()
+    assert am is not None and not am.is_decoupled()
+    # tiny random model on the 8-device CPU mesh: fits without TP
+    assert exp.mesh_spec.model == 1
+    assert exp.mesh_spec.world_size <= 8
+
+
+def test_heuristic_unsupported_experiment_raises():
+    import pytest
+
+    from areal_tpu.experiments.common import CommonExperimentConfig
+
+    exp = CommonExperimentConfig(allocation_mode="heuristic")
+    with pytest.raises(ValueError, match="heuristic"):
+        exp.resolve_allocation()

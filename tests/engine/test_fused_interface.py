@@ -1,0 +1,85 @@
+"""Fused inference interface: sub-interface results are unioned into one
+sample, and the PPO experiment graph collapses rew_inf+ref_inf when asked
+(reference: realhf/impl/model/interface/fused_interface.py)."""
+
+import json
+
+import numpy as np
+
+from areal_tpu.api import model_api
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.interfaces.fused_interface import FusedInferenceInterface
+
+
+class _StubIface(model_api.ModelInterface):
+    def __init__(self, key: str, per_seq: bool = True):
+        self.key = key
+        self.per_seq = per_seq
+
+    def inference(self, model, data, mb_spec):
+        return SequenceSample.from_default(
+            seqlens=[1] * data.bs,
+            ids=list(data.ids),
+            data={self.key: np.arange(data.bs, dtype=np.float32)},
+        )
+
+
+def _prompt_sample(bs=3):
+    return SequenceSample.from_default(
+        seqlens=[4] * bs,
+        ids=[str(i) for i in range(bs)],
+        data={
+            "packed_input_ids": np.zeros(4 * bs, np.int64),
+        },
+    )
+
+
+def test_fused_union_and_order():
+    fused = FusedInferenceInterface(
+        {"a": _StubIface("rewards"), "b": _StubIface("values")}
+    )
+    out = fused.inference(None, _prompt_sample(), MicroBatchSpec())
+    assert {"rewards", "values"} <= set(out.keys)
+    np.testing.assert_array_equal(out.data["rewards"], [0.0, 1.0, 2.0])
+    np.testing.assert_array_equal(out.data["values"], [0.0, 1.0, 2.0])
+
+
+def test_fused_skips_none_results():
+    class _NoneIface(model_api.ModelInterface):
+        def inference(self, model, data, mb_spec):
+            return None
+
+    fused = FusedInferenceInterface(
+        {"a": _NoneIface(), "b": _StubIface("rewards")}
+    )
+    out = fused.inference(None, _prompt_sample(), MicroBatchSpec())
+    assert set(out.keys) == {"rewards"}
+
+
+def test_ppo_graph_fuses_rew_ref(tmp_path):
+    from tests.system.exp_factories import make_sync_ppo_exp
+
+    data = tmp_path / "d.jsonl"
+    rows = [
+        {"qid": str(i), "prompt": "1+1?", "solutions": ["\\boxed{2}"],
+         "task": "math"}
+        for i in range(4)
+    ]
+    data.write_text("\n".join(json.dumps(r) for r in rows))
+
+    exp = make_sync_ppo_exp(str(data), None)
+    exp.fuse_rew_ref = True
+    assert exp.use_ref, "factory must keep kl_ctl != 0 for this test"
+    cfg = exp.initial_setup()
+    names = {r.name for r in cfg.master.model_rpcs}
+    assert "rew_ref_inf" in names
+    assert "rew_inf" not in names and "ref_inf" not in names
+    fused_rpc = next(
+        r for r in cfg.master.model_rpcs if r.name == "rew_ref_inf"
+    )
+    assert set(fused_rpc.output_keys) == {"rewards", "packed_ref_logprobs"}
+    # the tokenizer-only reward shard disappears
+    roles = {
+        s.model_name.role for w in cfg.model_workers for s in w.shards
+    }
+    assert "reward" not in roles and "ref" in roles

@@ -1,0 +1,113 @@
+"""Automatic evaluator: checkpoint discovery, one-at-a-time submission,
+result harvesting + metric fan-out, resume, and failure marking (mirrors
+the reference's evaluator semantics, realhf/scheduler/evaluator.py)."""
+
+import json
+import os
+import sys
+import time
+
+from areal_tpu.scheduler.evaluator import AutomaticEvaluator, EvalStatus
+
+
+class StubMetrics:
+    def __init__(self):
+        self.logged = []
+
+    def log(self, scores, step):
+        self.logged.append((step, scores))
+
+
+def _mk_ckpt(root, epoch, epochstep, gstep):
+    d = os.path.join(
+        root, f"epoch{epoch}epochstep{epochstep}globalstep{gstep}"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _ok_argv(step):
+    code = (
+        "import json,sys;"
+        "json.dump({'accuracy':0.5,'per_task':{'math':{'accuracy':0.5,'n':2}}},"
+        "open(sys.argv[1],'w'))"
+    )
+    return [sys.executable, "-c", code, step.output_path]
+
+
+def _fail_argv(step):
+    return [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+
+def _drive(ev, until, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while not until():
+        assert time.monotonic() < deadline, "evaluator did not converge"
+        ev.step()
+        time.sleep(0.05)
+
+
+def test_discovery_submit_harvest_and_metrics(tmp_path):
+    ckpt_root = str(tmp_path / "ckpts")
+    out_root = str(tmp_path / "eval")
+    _mk_ckpt(ckpt_root, 1, 1, 2)
+    _mk_ckpt(ckpt_root, 1, 2, 4)
+    os.makedirs(os.path.join(ckpt_root, "not_a_ckpt"))
+
+    metrics = StubMetrics()
+    ev = AutomaticEvaluator(
+        ckpt_root, "unused.jsonl", out_root, metrics=metrics,
+        eval_argv=_ok_argv,
+    )
+    ev.step()
+    # ignores the junk dir; only one job at a time (reference behavior)
+    assert sorted(ev._steps) == [2, 4]
+    assert (
+        sum(s.status == EvalStatus.RUNNING for s in ev._steps.values()) == 1
+    )
+    _drive(ev, lambda: len(ev.results) == 2)
+
+    steps_logged = [s for s, _ in metrics.logged]
+    assert steps_logged == [2, 4]  # submitted in globalstep order
+    for _, scores in metrics.logged:
+        assert scores["eval/accuracy"] == 0.5
+        assert scores["eval/math_accuracy"] == 0.5
+
+    # resume: a fresh evaluator over the same output root re-marks DONE
+    ev2 = AutomaticEvaluator(
+        ckpt_root, "unused.jsonl", out_root, eval_argv=_ok_argv
+    )
+    assert sorted(ev2.results) == [2, 4]
+    ev.shutdown()
+
+
+def test_failed_eval_marked_not_logged(tmp_path):
+    ckpt_root = str(tmp_path / "ckpts")
+    _mk_ckpt(ckpt_root, 1, 1, 1)
+    metrics = StubMetrics()
+    ev = AutomaticEvaluator(
+        ckpt_root, "unused.jsonl", str(tmp_path / "eval"),
+        metrics=metrics, eval_argv=_fail_argv,
+    )
+    _drive(
+        ev,
+        lambda: all(
+            s.status in (EvalStatus.FAILED, EvalStatus.DONE)
+            for s in ev._steps.values()
+        )
+        and ev._steps,
+    )
+    assert ev._steps[1].status == EvalStatus.FAILED
+    assert metrics.logged == []
+
+
+def test_eval_result_json_roundtrip(tmp_path):
+    # the aggregate JSON the eval CLI writes is what _harvest parses
+    result = {
+        "accuracy": 0.25,
+        "per_task": {"math": {"accuracy": 0.25, "n": 4}},
+    }
+    p = tmp_path / "eval_result.json"
+    p.write_text(json.dumps(result))
+    loaded = json.loads(p.read_text())
+    assert loaded["per_task"]["math"]["n"] == 4

@@ -1,0 +1,42 @@
+"""Null PPO experiment e2e: the full master/worker/data plane with no-op
+model compute (reference: realhf/experiments/common/null_exp.py as the
+plumbing/profiling harness)."""
+
+import numpy as np
+
+from tests.fixtures import dataset, dataset_path, save_path, tokenizer  # noqa: F401
+
+
+def test_null_ppo_e2e(dataset_path, tokenizer, tmp_path, monkeypatch):
+    monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
+    monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
+    tokenizer_path = str(tmp_path / "tokenizer")
+    tokenizer.save_pretrained(tokenizer_path)
+    from areal_tpu.api.config import DatasetAbstraction
+    from areal_tpu.api.system_api import ExperimentSaveEvalControl
+    from areal_tpu.apps.local_runner import run_experiment_local
+    from areal_tpu.experiments.null_exp import NullPPOExperiment
+
+    exp = NullPPOExperiment(
+        experiment_name="test-null",
+        trial_name="e2e",
+        n_model_workers=1,
+        tokenizer_path=tokenizer_path,
+        exp_ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=1, benchmark_steps=2
+        ),
+        dataset=DatasetAbstraction(
+            "math_code_prompt",
+            {"dataset_path": dataset_path, "max_length": 64},
+        ),
+        train_bs_n_seqs=4,
+    )
+    cfg = exp.initial_setup()
+    assert {r.name for r in cfg.master.model_rpcs} == {
+        "reward",
+        "trainDefault",
+    }
+    master = run_experiment_local(cfg, timeout=300)
+    s = master.stats_history[-1]
+    assert s["trainDefault/null/n_seqs"] == 4.0
+    assert np.isfinite(s["time_perf/e2e"])
