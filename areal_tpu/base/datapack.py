@@ -31,6 +31,15 @@ def partition_balanced(nums: Sequence[int], k: int) -> List[List[int]]:
         raise ValueError(f"cannot partition {n} items into {k} non-empty groups")
     if k == 1:
         return [list(range(n))]
+    if n >= 64:  # amortize the ctypes boundary; parity tested either way
+        from areal_tpu.base import _native
+
+        cuts = _native.partition_balanced(nums, k)
+        if cuts is not None:
+            return [
+                list(range(int(cuts[j]), int(cuts[j + 1])))
+                for j in range(k)
+            ]
     prefix = np.concatenate([[0], np.cumsum(nums)])
     INF = float("inf")
     # dp[j][i]: minimal max-sum partitioning first i items into j groups
@@ -110,7 +119,18 @@ def ffd_allocate(
 def bin_pack_ffd(nums: Sequence[int], capacity: int) -> List[List[int]]:
     """First-fit-decreasing bin packing (non-contiguous), for packing variable
     length sequences into fixed token-capacity batches."""
-    order = np.argsort(nums)[::-1]
+    if len(nums) >= 64:
+        from areal_tpu.base import _native
+
+        packed = _native.ffd_pack(nums, capacity)
+        if packed is not None:
+            bin_of, n_bins = packed
+            native_bins: List[List[int]] = [[] for _ in range(n_bins)]
+            for i in np.argsort(nums, kind="stable")[::-1]:
+                native_bins[int(bin_of[i])].append(int(i))
+            return native_bins
+    # stable sort so tie order is deterministic and matches the native path
+    order = np.argsort(nums, kind="stable")[::-1]
     bins: List[List[int]] = []
     sums: List[int] = []
     for i in order:

@@ -290,6 +290,23 @@ def reconfigure(backend: str = "memory", **kwargs) -> NameRecordRepository:
         DEFAULT_REPOSITORY = MemoryNameRecordRepository()
     elif backend in ("nfs", "file"):
         DEFAULT_REPOSITORY = NfsNameRecordRepository(**kwargs)
+    elif backend == "server":
+        # in-repo ZMQ KV service (the redis/etcd3 role of the reference)
+        import os
+
+        from areal_tpu.base.name_resolve_server import (
+            ServerNameRecordRepository,
+        )
+
+        address = kwargs.pop(
+            "address", os.environ.get("AREAL_NAME_RESOLVE_ADDR", "")
+        )
+        if not address:
+            raise ValueError(
+                "server backend needs address=host:port or "
+                "AREAL_NAME_RESOLVE_ADDR"
+            )
+        DEFAULT_REPOSITORY = ServerNameRecordRepository(address)
     else:
         raise NotImplementedError(f"name_resolve backend {backend}")
     return DEFAULT_REPOSITORY

@@ -100,7 +100,7 @@ def _admit_rows(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "chunk_size", "stop_tokens", "sampling"),
+    static_argnames=("cfg", "chunk_size", "stop_tokens", "sampling", "attn_len"),
     donate_argnums=(2,),
 )
 def _decode_chunk(
@@ -114,6 +114,7 @@ def _decode_chunk(
     chunk_size: int,
     stop_tokens: Tuple[int, ...],
     sampling: SamplingParams,
+    attn_len: Optional[int] = None,
 ):
     """Generate up to ``chunk_size`` tokens for all active rows device-side.
 
@@ -145,6 +146,7 @@ def _decode_chunk(
             chunk_size,
             lambda logits, sub: sample_logits(logits, sub, sampling),
             is_stop,
+            attn_len=attn_len,
         )
 
     def body(i, state):
@@ -421,6 +423,25 @@ class ContinuousBatchingEngine:
         if ev:
             ev.set()
 
+    def _attn_bucket(self) -> int:
+        """Static attention prefix for the next chunk, as a power-of-two
+        bucket of the longest CACHED row (few recompiles, halved-or-better
+        KV streaming early in generation).  In-chunk tokens never need it
+        larger: their KV lives in the decode window, cache attention reads
+        only the frozen base_lens prefix, and the end-of-chunk scatter
+        targets the full unsliced cache."""
+        longest = 0
+        for row in self.rows:
+            if row is not None:
+                longest = max(
+                    longest, len(row.prompt) + len(row.generated) + 1
+                )
+        need = min(longest, self.kv_cache_len)
+        p = 256
+        while p < need:
+            p <<= 1
+        return min(p, self.kv_cache_len)
+
     def step(self) -> int:
         """One engine iteration: weight swap (if requested), admit, one decode
         chunk, harvest.  Returns number of tokens emitted this step."""
@@ -452,6 +473,7 @@ class ContinuousBatchingEngine:
             self.chunk_size,
             self.stop_tokens,
             self.sampling,
+            attn_len=self._attn_bucket(),
         )
         # ONE batched host fetch per chunk (separate np.asarray calls each
         # paid a full tunnel/PCIe round-trip)

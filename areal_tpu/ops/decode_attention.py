@@ -1,0 +1,200 @@
+"""Pallas flash-decode attention over a contiguous per-row KV cache.
+
+In-house TPU kernel for the rollout engine's decode hot loop (the role the
+reference delegates to SGLang/flashinfer paged decode kernels,
+realhf/impl/model/backend/sglang.py:369).  One query token per row attends
+over that row's cache prefix ``[0, length)``:
+
+* grid ``(B, Hkv, S/block)`` — the minor block axis iterates sequentially on
+  TPU, so online-softmax state (m/l/acc) lives in VMEM scratch across blocks
+  and the normalized output is emitted at the last block;
+* ``lengths`` rides scalar prefetch: the K/V index maps CLAMP the block
+  index to the last valid block of each row, so trailing blocks re-address
+  the same tile and the pipeline's revisiting logic skips their HBM->VMEM
+  copies — short rows stream only the KV they own, which is the entire
+  point: decode is HBM-bandwidth-bound on the KV stream;
+* GQA is grouped: the query head group ``r = Hq // Hkv`` shares one KV head
+  per grid cell, so the cache is read once per KV head (never
+  repeat-materialized).
+
+Returns UN-normalized partials ``(acc, m, l)`` so the caller can
+online-merge them with attention over KV that is not in the cache yet (the
+decode chunk's in-flight window, models/transformer.py:decode_chunk).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK = 256
+_NEG_INF = -1e30
+
+
+def _kernel(
+    lengths_ref,  # scalar prefetch [B]
+    q_ref,  # (1, 1, r, hd)
+    k_ref,  # (1, 1, BS, hd)
+    v_ref,  # (1, 1, BS, hd)
+    acc_ref,  # out (1, 1, r, hd) f32
+    m_ref,  # out (1, 1, r, 128) f32 (value replicated along lanes)
+    l_ref,  # out (1, 1, r, 128) f32
+    s_acc,  # scratch (r, hd) f32
+    s_m,  # scratch (r, 128) f32
+    s_l,  # scratch (r, 128) f32
+    *,
+    block_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        s_acc[:] = jnp.zeros_like(s_acc)
+        s_m[:] = jnp.full_like(s_m, _NEG_INF)
+        s_l[:] = jnp.zeros_like(s_l)
+
+    length = lengths_ref[b]
+    base = j * block_size
+
+    @pl.when(base < length)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)  # (r, hd)
+        k = k_ref[0, 0].astype(jnp.float32)  # (BS, hd)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (r, BS)
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+
+        m_prev = s_m[:, 0]  # (r,)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))  # (r,)
+        alpha = jnp.exp(m_prev - m_cur)  # (r,)
+        p = jnp.exp(s - m_cur[:, None])  # (r, BS)
+        v = v_ref[0, 0].astype(jnp.float32)  # (BS, hd)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (r, hd)
+        s_acc[:] = s_acc[:] * alpha[:, None] + pv
+        s_l[:] = s_l[:] * alpha[:, None] + jnp.sum(p, axis=1)[:, None]
+        s_m[:] = jnp.broadcast_to(m_cur[:, None], s_m.shape)
+
+    @pl.when(j == nb - 1)
+    def _emit():
+        acc_ref[0, 0] = s_acc[:]
+        m_ref[0, 0] = s_m[:]
+        l_ref[0, 0] = s_l[:]
+
+
+def _clamped_kv_map(b, h, j, lengths_ref, *, block_size):
+    # last block that holds any valid KV for row b (>= 0 so length-0 rows
+    # still address a real tile; their compute is skipped in the kernel)
+    last = jnp.maximum(
+        (lengths_ref[b] + block_size - 1) // block_size - 1, 0
+    )
+    return (b, h, jnp.minimum(j, last), 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "interpret"),
+)
+def flash_decode(
+    q: jax.Array,  # [B, Hq, hd]
+    k: jax.Array,  # [B, Hkv, S, hd]
+    v: jax.Array,  # [B, Hkv, S, hd]
+    lengths: jax.Array,  # [B] int32 — valid cache prefix per row
+    block_size: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Un-normalized online-softmax attention partials over the cache.
+
+    Returns ``(acc [B,Hq,hd] f32, m [B,Hq] f32, l [B,Hq] f32)`` with
+    ``out = acc / l`` the attention output when nothing else is merged.
+    Rows with ``length == 0`` return ``acc=0, l=0, m=-inf``.
+    """
+    B, Hq, hd = q.shape
+    Hkv, S = k.shape[1], k.shape[2]
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    r = Hq // Hkv
+    assert S % block_size == 0, (S, block_size)
+    nb = S // block_size
+    qg = q.reshape(B, Hkv, r, hd)
+
+    grid = (B, Hkv, nb)
+    kv_map = functools.partial(_clamped_kv_map, block_size=block_size)
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _kernel, block_size=block_size, scale=1.0 / np.sqrt(hd)
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, r, hd), lambda b, h, j, L: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_size, hd), kv_map),
+                pl.BlockSpec((1, 1, block_size, hd), kv_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, r, hd), lambda b, h, j, L: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, r, 128), lambda b, h, j, L: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, r, 128), lambda b, h, j, L: (b, h, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((r, hd), jnp.float32),
+                pltpu.VMEM((r, 128), jnp.float32),
+                pltpu.VMEM((r, 128), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, r, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, r, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, r, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qg, k, v)
+    return (
+        acc.reshape(B, Hq, hd),
+        m[..., 0].reshape(B, Hq),
+        l[..., 0].reshape(B, Hq),
+    )
+
+
+def reference_decode_partials(q, k, v, lengths):
+    """jnp reference for :func:`flash_decode` (same (acc, m, l) contract)."""
+    B, Hq, hd = q.shape
+    Hkv, S = k.shape[1], k.shape[2]
+    r = Hq // Hkv
+    qg = q.reshape(B, Hkv, r, hd).astype(jnp.float32)
+    s = jnp.einsum(
+        "bkrd,bksd->bkrs", qg, k.astype(jnp.float32)
+    ) / np.sqrt(hd)
+    mask = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(mask, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkrs,bksd->bkrd", p, v.astype(jnp.float32))
+    return (
+        acc.reshape(B, Hq, hd),
+        m.reshape(B, Hq),
+        l.reshape(B, Hq),
+    )

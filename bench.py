@@ -94,7 +94,9 @@ def bench_generation(cfg, params, n_reqs=32, prompt_len=512, max_new=512):
         t_decode = time.perf_counter() - t0
         return t_prefill, t_decode, n_decoded
 
-    run(65)  # warmup: compiles the same prefill/decode shapes
+    # warmup must cover every attention-length bucket the timed run will
+    # touch (the engine recompiles the decode chunk per pow2 cache prefix)
+    run(max_new)
     t_prefill, t_decode, n_decoded = run(max_new)
     return {
         "prefill_toks_per_sec": round(n_reqs * prompt_len / t_prefill, 1),

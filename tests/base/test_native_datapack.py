@@ -1,0 +1,100 @@
+"""Native (C++) datapack vs the pure-Python reference: bit-for-bit parity
+on the packing outputs, plus graceful fallback when disabled."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.base import _native, datapack
+
+
+def _python_ffd(nums, capacity):
+    order = np.argsort(nums, kind="stable")[::-1]
+    bins, sums = [], []
+    for i in order:
+        x = nums[i]
+        for b in range(len(bins)):
+            if sums[b] + x <= capacity:
+                bins[b].append(int(i))
+                sums[b] += x
+                break
+        else:
+            bins.append([int(i)])
+            sums.append(int(x))
+    return bins
+
+
+def _python_balanced(nums, k):
+    n = len(nums)
+    prefix = np.concatenate([[0], np.cumsum(nums)])
+    INF = float("inf")
+    dp = np.full((k + 1, n + 1), INF)
+    cut = np.zeros((k + 1, n + 1), dtype=int)
+    dp[0][0] = 0.0
+    for j in range(1, k + 1):
+        for i in range(j, n + 1):
+            for t in range(j - 1, i):
+                cost = max(dp[j - 1][t], prefix[i] - prefix[t])
+                if cost < dp[j][i]:
+                    dp[j][i] = cost
+                    cut[j][i] = t
+    groups, i = [], n
+    for j in range(k, 0, -1):
+        t = cut[j][i]
+        groups.append(list(range(t, i)))
+        i = t
+    groups.reverse()
+    return groups
+
+
+needs_native = pytest.mark.skipif(
+    _native.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+@needs_native
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_ffd_parity_with_python(seed):
+    rng = np.random.default_rng(seed)
+    nums = rng.integers(1, 512, 300).tolist()
+    got = datapack.bin_pack_ffd(nums, capacity=1024)
+    want = _python_ffd(nums, 1024)
+    assert got == want
+    # validity: every bin within capacity (singletons may exceed)
+    for b in got:
+        if len(b) > 1:
+            assert sum(nums[i] for i in b) <= 1024
+
+
+@needs_native
+@pytest.mark.parametrize("seed,k", [(0, 4), (1, 7), (2, 16)])
+def test_balanced_partition_parity_with_python(seed, k):
+    rng = np.random.default_rng(seed)
+    nums = rng.integers(1, 2048, 200).tolist()
+    got = datapack.partition_balanced(nums, k)
+    want = _python_balanced(nums, k)
+    assert got == want
+    assert [i for g in got for i in g] == list(range(len(nums)))
+    assert all(g for g in got)
+
+
+def test_fallback_when_disabled(monkeypatch):
+    monkeypatch.setenv("AREAL_NATIVE", "0")
+    nums = list(range(1, 100))
+    groups = datapack.partition_balanced(nums, 5)
+    assert [i for g in groups for i in g] == list(range(99))
+    bins = datapack.bin_pack_ffd(nums, 128)
+    assert sorted(i for b in bins for i in b) == list(range(99))
+
+
+@needs_native
+def test_native_large_partition_is_fast():
+    import time
+
+    rng = np.random.default_rng(0)
+    nums = rng.integers(1, 4096, 2000).tolist()
+    t0 = time.monotonic()
+    groups = datapack.partition_balanced(nums, 8)
+    dt = time.monotonic() - t0
+    assert len(groups) == 8
+    # pure Python takes tens of seconds at this size; native must be <2s
+    assert dt < 2.0, f"native partition too slow: {dt:.1f}s"

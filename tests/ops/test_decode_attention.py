@@ -1,0 +1,141 @@
+"""Pallas flash-decode kernel vs jnp reference (interpret mode on CPU;
+the same kernel compiles for TPU in the rollout engine's decode chunk)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.ops.decode_attention import (
+    flash_decode,
+    reference_decode_partials,
+)
+
+
+def _rand(B=4, Hq=8, Hkv=4, S=512, hd=128, dtype=jnp.bfloat16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, Hq, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (B, Hkv, S, hd), jnp.float32).astype(dtype)
+    v = jax.random.normal(ks[2], (B, Hkv, S, hd), jnp.float32).astype(dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize(
+    "lengths", [[512, 512, 512, 512], [1, 130, 256, 511], [0, 512, 37, 300]]
+)
+def test_flash_decode_matches_reference(lengths):
+    q, k, v = _rand()
+    lens = jnp.asarray(lengths, jnp.int32)
+    acc, m, l = flash_decode(q, k, v, lens, interpret=True)
+    acc_r, m_r, l_r = reference_decode_partials(q, k, v, lens)
+
+    valid = np.asarray(lens) > 0
+    np.testing.assert_allclose(
+        np.asarray(m)[valid], np.asarray(m_r)[valid], rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(l)[valid], np.asarray(l_r)[valid], rtol=2e-3, atol=2e-3
+    )
+    out = np.asarray(acc)[valid] / np.asarray(l)[valid][..., None]
+    out_r = np.asarray(acc_r)[valid] / np.asarray(l_r)[valid][..., None]
+    np.testing.assert_allclose(out, out_r, rtol=3e-3, atol=3e-3)
+    # empty rows: exact sentinel state for the caller's online merge
+    empty = ~valid
+    if empty.any():
+        assert (np.asarray(l)[empty] == 0).all()
+        assert (np.asarray(acc)[empty] == 0).all()
+
+
+def test_flash_decode_normalized_equals_softmax_attention():
+    q, k, v = _rand(B=2, Hq=4, Hkv=2, S=256, hd=128, seed=3)
+    lens = jnp.asarray([256, 200], jnp.int32)
+    acc, m, l = flash_decode(q, k, v, lens, interpret=True)
+    out = acc / l[..., None]
+
+    B, Hq, hd = q.shape
+    Hkv, S = k.shape[1], k.shape[2]
+    r = Hq // Hkv
+    kk = jnp.repeat(k.astype(jnp.float32), r, axis=1)
+    vv = jnp.repeat(v.astype(jnp.float32), r, axis=1)
+    s = jnp.einsum("bhd,bhsd->bhs", q, kk) / np.sqrt(hd)
+    mask = jnp.arange(S)[None, None, :] < lens[:, None, None]
+    s = jnp.where(mask, s, -1e30)
+    expected = jnp.einsum("bhs,bhsd->bhd", jax.nn.softmax(s, axis=-1), vv)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=3e-3, atol=3e-3
+    )
+
+
+def test_flash_decode_block_size_invariance():
+    q, k, v = _rand(B=2, Hq=2, Hkv=2, S=512, hd=128, seed=5)
+    lens = jnp.asarray([300, 512], jnp.int32)
+    a1, m1, l1 = flash_decode(q, k, v, lens, block_size=128, interpret=True)
+    a2, m2, l2 = flash_decode(q, k, v, lens, block_size=512, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(a1 / l1[..., None]),
+        np.asarray(a2 / l2[..., None]),
+        rtol=2e-3,
+        atol=2e-3,
+    )
+
+
+def test_decode_chunk_kernel_path_matches_dense(monkeypatch):
+    """The kernel-integrated decode chunk (forced, interpret mode) emits the
+    same greedy tokens as the dense jnp path."""
+    import dataclasses
+
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config(
+        n_layers=2,
+        hidden_dim=128,
+        n_q_heads=4,
+        n_kv_heads=2,
+        head_dim=128,
+        intermediate_dim=256,
+        vocab_size=128,
+        max_position_embeddings=512,
+        dtype="float32",
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    B, S, W = 4, 256, 8
+    rng = jax.random.PRNGKey(1)
+    prompt_lens = jnp.asarray([3, 17, 9, 1], jnp.int32)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (B, 64), 0, 128)
+    positions = jnp.tile(jnp.arange(64)[None], (B, 1))
+    seg = (positions < prompt_lens[:, None]).astype(jnp.int32)
+
+    def run(force):
+        monkeypatch.setenv(
+            "AREAL_FLASH_DECODE", "force" if force else "0"
+        )
+        cache = transformer.KVCache.zeros(cfg, B, S)
+        _, cache = transformer.prefill(
+            params, cfg, toks, positions, seg, cache
+        )
+        cur = jnp.asarray([5, 6, 7, 8], jnp.int32)
+        active = jnp.ones((B,), bool)
+        budgets = jnp.full((B,), W, jnp.int32)
+
+        def sample(logits, sub):
+            t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lp = jax.nn.log_softmax(logits)[jnp.arange(B), t]
+            return t, lp
+
+        out = transformer.decode_chunk(
+            params, cfg, cache, cur, active, budgets, rng, W,
+            sample, lambda t: jnp.zeros_like(t, bool), attn_len=256,
+        )
+        return out
+
+    c_d, t_d, l_d, e_d, *_ = run(False)
+    c_k, t_k, l_k, e_k, *_ = run(True)
+    np.testing.assert_array_equal(np.asarray(t_d), np.asarray(t_k))
+    np.testing.assert_allclose(
+        np.asarray(l_d), np.asarray(l_k), rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_array_equal(np.asarray(e_d), np.asarray(e_k))
+    np.testing.assert_allclose(
+        np.asarray(c_d.k), np.asarray(c_k.k), rtol=2e-2, atol=2e-2
+    )
