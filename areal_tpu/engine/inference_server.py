@@ -190,10 +190,38 @@ class ContinuousBatchingEngine:
         stop_tokens: Sequence[int] = (),
         seed: int = 0,
         device=None,
+        mesh=None,
     ):
+        """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
+        shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
+        cache shards its kv-head axis, and the jitted admit/decode paths run
+        SPMD (the role TP SGLang servers play for big models in the
+        reference's decoupled mode).  Mutually exclusive with ``device``."""
         self.cfg = cfg
         self.device = device
-        if device is not None:
+        self.mesh = mesh
+        self._param_shardings = None
+        self._cache_sharding = None
+        if mesh is not None:
+            assert device is None, "pass mesh OR device, not both"
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from areal_tpu.models.transformer import param_pspecs
+
+            pspecs = param_pspecs(cfg, params)
+            self._param_shardings = jax.tree.map(
+                lambda ps: NamedSharding(mesh, ps), pspecs
+            )
+            params = jax.device_put(params, self._param_shardings)
+            tp = mesh.shape.get("model", 1)
+            kv_axis = "model" if cfg.n_kv_heads % max(tp, 1) == 0 else None
+            self._cache_sharding = KVCache(
+                k=NamedSharding(mesh, P(None, None, kv_axis, None, None)),
+                v=NamedSharding(mesh, P(None, None, kv_axis, None, None)),
+                lengths=NamedSharding(mesh, P(None)),
+            )
+        elif device is not None:
             params = jax.device_put(params, device)
         self.params = params
         self.tokenizer = tokenizer
@@ -208,7 +236,15 @@ class ContinuousBatchingEngine:
         self.version = 0
 
         with jax.default_device(device) if device is not None else _nullctx():
-            self.cache = KVCache.zeros(cfg, max_batch, kv_cache_len)
+            if self._cache_sharding is not None:
+                # allocate directly sharded: a transient full-size cache on
+                # one chip would OOM exactly the models TP serving exists for
+                self.cache = jax.jit(
+                    lambda: KVCache.zeros(cfg, max_batch, kv_cache_len),
+                    out_shardings=self._cache_sharding,
+                )()
+            else:
+                self.cache = KVCache.zeros(cfg, max_batch, kv_cache_len)
             self.cur_tokens = jnp.zeros((max_batch,), jnp.int32)
             self.active = jnp.zeros((max_batch,), bool)
             self.budgets = jnp.zeros((max_batch,), jnp.int32)
@@ -289,7 +325,9 @@ class ContinuousBatchingEngine:
             self._new_params = None
         if new_params is None:
             return
-        if self.device is not None:
+        if self._param_shardings is not None:
+            new_params = jax.device_put(new_params, self._param_shardings)
+        elif self.device is not None:
             new_params = jax.device_put(new_params, self.device)
         self.params = new_params
         self.version = getattr(self, "_target_version", self.version + 1)

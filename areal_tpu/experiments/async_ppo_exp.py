@@ -70,13 +70,15 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
         # trainer graph is built (reference: decoupled AllocationMode carving
         # gen devices out of the cluster, experiments/common/utils.py:245)
         am = self.resolve_allocation()
+        gen_tp = 1
         if am is not None and am.is_decoupled():
             gen = am.gen_spec
-            if gen.model * gen.pipe * gen.seq * gen.expert != 1:
+            if gen.fsdp * gen.pipe * gen.seq * gen.expert != 1:
                 raise ValueError(
-                    "generation servers are single-chip engines for now; "
-                    f"use a data-only gen spec (got gen.{gen})"
+                    "gen specs support data (replica) and model (TP) axes "
+                    f"only (got gen.{gen})"
                 )
+            gen_tp = gen.model
             self.n_gen_servers = gen.data
             if self.gen_device_start is None:
                 # gen devices sit after the LARGEST per-MFC trainer mesh,
@@ -118,17 +120,22 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
 
         # -- rollout cluster ------------------------------------------------
         gen_gconfig = ppo.gen.new(n=self.group_size)
+        from areal_tpu.base.topology import MeshSpec
+
         cfg.gen_servers = [
             GenServerConfig(
                 worker_name=f"gen_server_{i}",
                 model=self.actor,
-                mesh_spec=self.mesh_spec,
+                # each server owns its OWN (usually tiny) mesh: 1 chip per
+                # replica, or a model-axis TP span when the allocation's gen
+                # spec asks for it — never the trainer's mesh shape
+                mesh_spec=MeshSpec(model=gen_tp),
                 tokenizer_path=self.tokenizer_path,
                 max_concurrent_batch=self.gen_max_concurrent_batch,
                 kv_cache_len=self.gen_kv_cache_len,
                 temperature=ppo.gen.temperature,
                 device_idx=(
-                    self.gen_device_start + i
+                    self.gen_device_start + i * gen_tp
                     if self.gen_device_start is not None
                     else None
                 ),

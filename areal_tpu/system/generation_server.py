@@ -41,8 +41,22 @@ class GenerationServerWorker(worker_base.Worker):
             tokenizer = dataset_api.load_hf_tokenizer(config.tokenizer_path)
         import jax
 
-        device = None
-        if config.device_idx is not None:
+        device = mesh = None
+        world = config.mesh_spec.world_size
+        if world > 1:
+            # tensor-parallel engine over a contiguous device span starting
+            # at device_idx (the reference's TP SGLang server role)
+            start = config.device_idx or 0
+            n = len(jax.devices())
+            if start + world > n:
+                raise ValueError(
+                    f"gen server {config.worker_name} needs devices "
+                    f"[{start}, {start + world}) but only {n} exist — "
+                    "the allocation oversubscribes the host"
+                )
+            devices = jax.devices()[start : start + world]
+            mesh = config.mesh_spec.make_mesh(devices)
+        elif config.device_idx is not None:
             device = jax.devices()[config.device_idx % len(jax.devices())]
         model = make_model(config.model, None, None, tokenizer=tokenizer)
         sampling = SamplingParams(temperature=config.temperature)
@@ -54,6 +68,7 @@ class GenerationServerWorker(worker_base.Worker):
             kv_cache_len=config.kv_cache_len,
             sampling=sampling,
             device=device,
+            mesh=mesh,
         )
 
         self._ctx = zmq.Context.instance()
