@@ -38,7 +38,10 @@ import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
+from areal_tpu.base import logging_
 from areal_tpu.models.config import TransformerConfig
+
+logger = logging_.getLogger("transformer")
 
 Params = Dict[str, Any]
 
@@ -354,7 +357,48 @@ def _attention_dispatch(
         and fa.supported(q.shape[1], k.shape[1], cfg.sliding_window)
     ):
         return fa.flash_attention(q, k, v, seg_ids)
+    _warn_dense_fallback(
+        q.shape[1], k.shape[1], cfg.sliding_window, seg_ids is None
+    )
     return reference_attention(q, k, v, mask)
+
+
+_warned_dense = set()
+
+
+def _warn_dense_fallback(
+    q_len: int, kv_len: int, sliding_window, no_seg_ids: bool
+):
+    """One warning per (cause, compile) when a long sequence pays the
+    O(T^2) dense path on TPU — round-1 review found these fallbacks silent
+    (mistral's sliding window, odd lengths, CP's block math).  Reports the
+    ACTUAL failing flash-attention constraints, in ``fa.supported`` order."""
+    T = q_len
+    if jax.default_backend() != "tpu" or T < 1024:
+        return
+    causes = []
+    if no_seg_ids:
+        causes.append("no segment ids")
+    if sliding_window is not None:
+        causes.append("sliding window")
+    if q_len != kv_len:
+        causes.append(f"q_len {q_len} != kv_len {kv_len}")
+    from areal_tpu.ops import flash_attention as fa
+
+    if q_len % min(fa._BLOCK, max(q_len, 1)) != 0:
+        causes.append(f"length {q_len} not block-aligned")
+    cause = ", ".join(causes) or f"unsupported length {T}"
+    key = (cause, T)
+    if key in _warned_dense:
+        return
+    _warned_dense.add(key)
+    logger.warning(
+        "attention falling back to the dense O(T^2) path at T=%d (%s): "
+        "expect quadratic memory/time; consider pad-to-block or removing "
+        "the constraint",
+        T,
+        cause,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -262,15 +262,21 @@ class ModelWorker(worker_base.Worker):
 
     def _param_realloc(self, source: str, target: str, eta: float):
         """target <- eta * source + (1 - eta) * target (EMA ref update /
-        layout move).  Both roles must be hosted here: on TPU weight movement
-        between layouts is a device_put, not an NCCL plan
-        (reference: realhf/system/model_worker.py:1046 + param_realloc.py)."""
-        src = self._models[source].engine
+        layout move).  Co-hosted roles move via device_put; a source hosted
+        on OTHER workers is pulled from its latest published sharded
+        checkpoint — the cross-host channel the reference implements with
+        NCCL realloc plans (realhf/impl/model/comm/param_realloc.py:351;
+        ours: realhf/system/model_worker.py:1046's role, orbax transport)."""
         dst = self._models[target].engine
+        src_params = (
+            self._models[source].engine.params
+            if source in self._models
+            else self._load_published_params(source, dst)
+        )
         if eta == 1.0:
             new = jax.tree.map(
                 lambda s, spec: jax.device_put(s, spec),
-                src.params,
+                src_params,
                 dst.param_shardings,
             )
         else:
@@ -284,8 +290,44 @@ class ModelWorker(worker_base.Worker):
                     d,
                 )
 
-            new = _ema(src.params, dst.params)
+            new = _ema(src_params, dst.params)
         dst.set_params(new)
+
+    def _load_published_params(self, source: str, dst_engine):
+        """Latest published sharded checkpoint of ``source``, restored
+        directly onto the destination engine's shardings."""
+        import pickle as _pickle
+
+        from areal_tpu.base import name_resolve, names
+        from areal_tpu.engine import checkpoint
+
+        role = source.split("@", 1)[0]
+        key = names.model_version(
+            constants.experiment_name(), constants.trial_name(), role
+        )
+        # the publisher GCs old snapshots (keep-last-2): a restore racing
+        # that deletion re-resolves the key and retries on a newer version
+        last_exc = None
+        for _ in range(3):
+            try:
+                payload = _pickle.loads(bytes.fromhex(name_resolve.get(key)))
+            except name_resolve.NameEntryNotFoundError:
+                raise RuntimeError(
+                    f"param_realloc: source {source!r} is not hosted on "
+                    f"{self.worker_name} and has never published weights; "
+                    "add a publish_weights post-hook to its train MFC"
+                ) from None
+            try:
+                return checkpoint.load_params_like(
+                    dst_engine.params, payload["path"]
+                )
+            except (FileNotFoundError, ValueError) as e:
+                last_exc = e
+                time.sleep(0.2)
+        raise RuntimeError(
+            f"param_realloc: published checkpoint for {source!r} kept "
+            "disappearing mid-restore (GC race)"
+        ) from last_exc
 
     def _publish_weights(self, model_name: str):
         """Write current weights to the realloc dir as a SHARDED raw-param

@@ -105,3 +105,57 @@ def test_publish_to_generation_server_hot_swap(trial):
         server.exit()
         mt.join(timeout=10)
         st.join(timeout=10)
+
+
+def test_cross_worker_param_realloc(trial, tmp_path):
+    """A realloc whose source role lives on ANOTHER worker pulls the
+    source's latest published sharded checkpoint (cross-host EMA channel;
+    reference: param_realloc.py:351's cross-GPU-set realloc)."""
+    from areal_tpu.base import name_resolve, names
+    from areal_tpu.engine import checkpoint
+    from areal_tpu.system.model_worker import ModelWorker
+
+    expr, tname = trial
+    src_params = {"w": jnp.full((4, 4), 3.0), "b": jnp.ones((4,))}
+    path = str(tmp_path / "pub" / "v7")
+    checkpoint.save_params(src_params, path)
+    name_resolve.add(
+        names.model_version(expr, tname, "actor"),
+        pickle.dumps(
+            {"version": 7, "path": path, "format": "params"}
+        ).hex(),
+        replace=True,
+    )
+
+    class _DstEngine:
+        def __init__(self):
+            self.params = {
+                "w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))
+            }
+            self.param_shardings = jax.tree.map(
+                lambda x: x.sharding, self.params
+            )
+            self.set_calls = []
+
+        def set_params(self, p):
+            self.params = p
+            self.set_calls.append(p)
+
+    class _DstModel:
+        engine = _DstEngine()
+
+    mw = ModelWorker.__new__(ModelWorker)
+    mw.worker_name = "model_worker_1"
+    mw._models = {"ref": _DstModel()}
+
+    # eta=0.5 EMA: dst starts at 0, src is 3 -> expect 1.5
+    mw._param_realloc("actor", "ref", eta=0.5)
+    got = mw._models["ref"].engine.params
+    np.testing.assert_allclose(np.asarray(got["w"]), 1.5)
+    np.testing.assert_allclose(np.asarray(got["b"]), 0.5)
+
+    # unpublished source -> actionable error
+    import pytest
+
+    with pytest.raises(RuntimeError, match="publish_weights"):
+        mw._param_realloc("critic", "ref", eta=1.0)
