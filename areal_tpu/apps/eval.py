@@ -41,6 +41,8 @@ def evaluate_checkpoint(
     from areal_tpu.models.hf.registry import load_hf_model
     from areal_tpu.verifiers.dispatch import verify_batch
 
+    from areal_tpu.engine.sampling import SamplingParams
+
     cfg, params = load_hf_model(ckpt_dir)
     tokenizer = AutoTokenizer.from_pretrained(ckpt_dir)
     engine = ContinuousBatchingEngine(
@@ -49,6 +51,9 @@ def evaluate_checkpoint(
         tokenizer=tokenizer,
         max_batch=max_batch,
         kv_cache_len=kv_cache_len,
+        # sampling is engine-level (compile-time): evals decode greedily so
+        # scores are deterministic and comparable across checkpoints
+        sampling=SamplingParams(greedy=True),
     )
 
     id2info, task_cnt = load_metadata(dataset_path)
@@ -57,10 +62,8 @@ def evaluate_checkpoint(
         max_new_tokens=max_new_tokens, greedy=True
     )
     t0 = time.time()
-    prompt_lens = {}
     for d in items:
         ids = tokenizer(d["prompt"])["input_ids"]
-        prompt_lens[d["query_id"]] = len(ids)
         engine.submit(
             APIGenerateInput(
                 qid=d["query_id"], prompt_ids=ids, input_ids=ids, gconfig=gcfg
@@ -79,9 +82,8 @@ def evaluate_checkpoint(
 
     texts, tasks, problems = [], [], []
     for d in items:
-        seq = outs[d["query_id"]].seqs[0]
         answer = tokenizer.decode(
-            seq[prompt_lens[d["query_id"]] :], skip_special_tokens=True
+            outs[d["query_id"]].output_ids, skip_special_tokens=True
         )
         texts.append(answer)
         tasks.append(d.get("task", "math"))

@@ -68,3 +68,38 @@ def test_async_ppo_mixed_math_code(
     master = run_experiment_local(cfg, timeout=600)
     assert len(master.stats_history) >= 2
     assert np.isfinite(master.stats_history[-1]["actor_train/loss"])
+
+
+def test_async_ppo_multi_turn_agent(
+    dataset_path, tokenizer_path, tmp_path, monkeypatch
+):
+    """Async PPO with the MULTI-TURN agent: each rollout is a
+    retry-with-feedback chain, every turn becomes its own trajectory with
+    turn-discounted reward-to-go, and training consumes them through the
+    same stream (reference: math_multi_turn_agent + AsyncRLOptions)."""
+    monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
+    monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
+
+    from areal_tpu.apps.local_runner import run_experiment_local
+    from tests.system.exp_factories import make_async_ppo_exp
+
+    exp = make_async_ppo_exp(
+        dataset_path,
+        tokenizer_path,
+        trial_name="e2e-multiturn",
+        agent_type="math-multi-turn",
+        num_turns=2,
+        turn_level_discount=0.5,
+        group_size=2,
+    )
+    cfg = exp.initial_setup()
+    # staleness accounting switched to the per-turn minimum yield (1), NOT
+    # the group size (2) — counting group_size seqs per rollout deadlocks
+    assert cfg.gserver_manager.group_size == 1
+    agent = cfg.rollout_workers[0].agent
+    assert agent.type_ == "math-multi-turn"
+    assert agent.args["num_turns"] == 2
+
+    master = run_experiment_local(cfg, timeout=600)
+    assert len(master.stats_history) >= 2
+    assert np.isfinite(master.stats_history[-1]["actor_train/loss"])
