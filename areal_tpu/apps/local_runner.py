@@ -80,6 +80,26 @@ def run_experiment_local(
     # rollout stack (async experiments)
     aux_threads, aux_workers = _start_rollout_stack(cfg, errors)
 
+    # automatic evaluator (same component the process launcher drives;
+    # reference: apps/main.py builds it alongside the monitor)
+    evaluator = None
+    eval_stop = threading.Event()
+    if cfg.evaluator is not None:
+        from areal_tpu.scheduler.evaluator import (
+            make_evaluator,
+            run_evaluator_loop,
+        )
+
+        evaluator = make_evaluator(cfg)
+        et = threading.Thread(
+            target=run_evaluator_loop,
+            args=(evaluator, eval_stop, cfg.evaluator.interval),
+            daemon=True,
+            name="evaluator",
+        )
+        et.start()
+        aux_threads.append(et)
+
     master = MasterWorker()
     master_err: List[BaseException] = []
 
@@ -92,17 +112,22 @@ def run_experiment_local(
     mt = threading.Thread(target=_run_master, daemon=True, name="master")
     mt.start()
     deadline = time.monotonic() + timeout if timeout else None
-    while mt.is_alive():
-        mt.join(timeout=0.5)
-        if errors:
-            for w in workers:
-                w.exit()
-            raise RuntimeError("worker failed") from errors[0]
-        if deadline and time.monotonic() > deadline:
-            raise TimeoutError("experiment timed out")
-    if master_err:
-        raise RuntimeError("master failed") from master_err[0]
-
+    try:
+        while mt.is_alive():
+            mt.join(timeout=0.5)
+            if errors:
+                for w in workers:
+                    w.exit()
+                raise RuntimeError("worker failed") from errors[0]
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError("experiment timed out")
+        if master_err:
+            raise RuntimeError("master failed") from master_err[0]
+    finally:
+        # stop the evaluator on every exit path (its subprocess is detached)
+        eval_stop.set()
+        if evaluator is not None:
+            evaluator.shutdown()
     for w in workers + aux_workers:
         w.exit()
     for t in threads + aux_threads:

@@ -139,25 +139,9 @@ def _make_evaluator(cfg: system_api.ExperimentConfig):
     """Checkpoint-watching evaluator driven by the controller loop
     (reference: realhf/apps/main.py:96-154 builds the AutomaticEvaluator and
     steps it while monitoring)."""
-    if cfg.evaluator is None:
-        return None
-    from areal_tpu.base.metrics import MetricsLogger
-    from areal_tpu.scheduler.evaluator import AutomaticEvaluator
+    from areal_tpu.scheduler.evaluator import make_evaluator
 
-    ecfg = cfg.evaluator
-    return AutomaticEvaluator(
-        ckpt_root=os.path.join(constants.get_save_path(), ecfg.model_name),
-        dataset_path=ecfg.dataset_path,
-        output_root=os.path.join(constants.get_log_path(), "eval"),
-        metrics=MetricsLogger(
-            os.path.join(constants.get_log_path(), "eval"),
-            experiment_name=cfg.experiment_name,
-            trial_name=cfg.trial_name,
-        ),
-        max_prompts=ecfg.max_prompts,
-        max_new_tokens=ecfg.max_new_tokens,
-        env={**os.environ, "JAX_PLATFORMS": ecfg.device},
-    )
+    return make_evaluator(cfg)
 
 
 def _monitor(

@@ -39,6 +39,9 @@ class CommonExperimentConfig(system_api.Experiment):
     # run on N virtual CPU devices instead of the accelerator (debug/CI mode,
     # mirrors the reference's CPU test harness realhf/base/testing.py)
     force_cpu_devices: Optional[int] = None
+    # automatic checkpoint evaluator (reference: exp_cfg.evaluator driven by
+    # apps/main.py); consumed by the process launcher's monitor loop
+    evaluator: Optional[system_api.EvaluatorConfig] = None
 
     def resolve_allocation(self):
         """Apply ``allocation_mode`` to mesh_spec; returns the parsed mode
@@ -148,4 +151,5 @@ class CommonExperimentConfig(system_api.Experiment):
                 seed=self.seed,
             ),
             model_workers=model_workers,
+            evaluator=self.evaluator,
         ).lazy_init()

@@ -118,6 +118,8 @@ class PPOMathExperiment(CommonExperimentConfig):
 
     def initial_setup(self) -> system_api.ExperimentConfig:
         self.resolve_allocation()  # allocation_mode -> mesh_spec
+        if self.tokenizer_path is None and self.actor.type_ == "hf":
+            self.tokenizer_path = self.actor.args["path"]
         ppo = self.ppo
         actor = ModelName("actor")
         critic = ModelName("critic")

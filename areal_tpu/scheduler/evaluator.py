@@ -202,6 +202,33 @@ class AutomaticEvaluator:
                 s.process.terminate()
 
 
+def make_evaluator(cfg) -> Optional[AutomaticEvaluator]:
+    """Build the checkpoint-watching evaluator for an ExperimentConfig
+    (None when the experiment configures none).  Shared by the process
+    launcher's monitor loop and the threaded local runner; the eval
+    subprocess runs on the configured JAX platform (cpu by default — the
+    training workers own the local chips)."""
+    if getattr(cfg, "evaluator", None) is None:
+        return None
+    from areal_tpu.base import constants
+    from areal_tpu.base.metrics import MetricsLogger
+
+    ecfg = cfg.evaluator
+    return AutomaticEvaluator(
+        ckpt_root=os.path.join(constants.get_save_path(), ecfg.model_name),
+        dataset_path=ecfg.dataset_path,
+        output_root=os.path.join(constants.get_log_path(), "eval"),
+        metrics=MetricsLogger(
+            os.path.join(constants.get_log_path(), "eval"),
+            experiment_name=cfg.experiment_name,
+            trial_name=cfg.trial_name,
+        ),
+        max_prompts=ecfg.max_prompts,
+        max_new_tokens=ecfg.max_new_tokens,
+        env={**os.environ, "JAX_PLATFORMS": ecfg.device},
+    )
+
+
 def run_evaluator_loop(
     evaluator: AutomaticEvaluator,
     stop_event,

@@ -1,0 +1,36 @@
+"""Example configs stay parseable against the real experiment dataclasses
+(schema drift in cli_args/experiments breaks these first)."""
+
+import glob
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+EXAMPLES = sorted(
+    glob.glob(os.path.join(REPO, "examples", "configs", "*.yaml"))
+) + sorted(glob.glob(os.path.join(REPO, "training", "configs", "*.yaml")))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_config_parses(path):
+    from areal_tpu.api.cli_args import parse_cli
+    from areal_tpu.experiments.async_ppo_exp import AsyncPPOMathExperiment
+    from areal_tpu.experiments.ppo_math_exp import PPOMathExperiment
+    from areal_tpu.experiments.sft_exp import SFTExperiment
+
+    name = os.path.basename(path)
+    if "sft" in name:
+        cls = SFTExperiment
+    elif "async" in name:
+        cls = AsyncPPOMathExperiment
+    else:
+        cls = PPOMathExperiment
+    exp = parse_cli(cls, argv=["--config", path])
+    assert exp.experiment_name
+    if getattr(exp, "allocation_mode", ""):
+        from areal_tpu.api.allocation import AllocationMode
+
+        AllocationMode.from_str(exp.allocation_mode)
+    if getattr(exp, "evaluator", None) is not None:
+        assert exp.evaluator.dataset_path

@@ -1,0 +1,125 @@
+"""Data preprocessing CLI: math join, code normalization, merge
+(reference: examples/data_preprocess/*.py behaviors)."""
+
+import json
+
+from areal_tpu.data.preprocess import (
+    main,
+    merge,
+    process_code,
+    process_math,
+)
+
+
+def test_math_join_drops_unknown_ids(caplog):
+    prompts = [
+        {"query_id": "a", "prompt": "1+1?"},
+        {"query_id": "zz", "prompt": "?"},  # not in id2info
+        {"prompt": "no id"},
+    ]
+    id2info = {"a": {"solutions": ["\\boxed{2}"]}}
+    rows = process_math(prompts, id2info)
+    assert rows == [
+        {
+            "prompt": "1+1?",
+            "task": "math",
+            "query_id": "a",
+            "solutions": ["\\boxed{2}"],
+        }
+    ]
+
+
+def test_code_normalization_and_template():
+    raw = [
+        {
+            "query_id": 7,
+            "question": "print hello",
+            "input_output": json.dumps(
+                {"inputs": [""], "outputs": ["hello\n"]}
+            ),
+            "timeout": 3,
+        },
+        {"query_id": 8},  # malformed: no input_output
+    ]
+    rows = process_code(raw, prompt_template="qwen-think")
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["query_id"] == "7" and r["task"] == "code"
+    assert "print hello" in r["prompt"] and "<think>" in r["prompt"]
+    assert json.loads(r["input_output"])["outputs"] == ["hello\n"]
+    assert r["timeout"] == 3
+
+
+def test_merge_dedup_and_shuffle_determinism():
+    a = [{"task": "math", "query_id": "1"}, {"task": "math", "query_id": "2"}]
+    b = [{"task": "math", "query_id": "2"}, {"task": "code", "query_id": "2"}]
+    rows = merge([a, b])
+    assert len(rows) == 3  # math/2 deduped; code/2 kept (different task)
+    s1 = merge([a, b], shuffle=True, seed=42)
+    s2 = merge([a, b], shuffle=True, seed=42)
+    assert s1 == s2
+
+
+def test_cli_end_to_end(tmp_path):
+    prompts = tmp_path / "p.jsonl"
+    prompts.write_text(
+        json.dumps({"query_id": "q1", "prompt": "2*3?"}) + "\n"
+    )
+    id2info = tmp_path / "id2info.json"
+    id2info.write_text(json.dumps({"q1": {"solutions": ["\\boxed{6}"]}}))
+    math_out = tmp_path / "math.jsonl"
+    assert (
+        main(
+            [
+                "math",
+                "--prompts",
+                str(prompts),
+                "--id2info",
+                str(id2info),
+                "--output",
+                str(math_out),
+            ]
+        )
+        == 0
+    )
+
+    code_in = tmp_path / "c.jsonl"
+    code_in.write_text(
+        json.dumps(
+            {
+                "query_id": "c1",
+                "question": "q",
+                "input_output": {"inputs": ["1"], "outputs": ["1"]},
+            }
+        )
+        + "\n"
+    )
+    code_out = tmp_path / "code.jsonl"
+    assert (
+        main(["code", "--input", str(code_in), "--output", str(code_out)])
+        == 0
+    )
+
+    merged = tmp_path / "mixed.jsonl"
+    assert (
+        main(
+            [
+                "merge",
+                "--inputs",
+                str(math_out),
+                str(code_out),
+                "--output",
+                str(merged),
+                "--shuffle",
+            ]
+        )
+        == 0
+    )
+    rows = [json.loads(x) for x in merged.read_text().splitlines()]
+    assert {r["task"] for r in rows} == {"math", "code"}
+
+    # the produced file loads through the actual training dataset metadata
+    from areal_tpu.data.math_code_dataset import load_metadata
+
+    id2, counts = load_metadata(str(merged))
+    assert set(id2) == {"q1", "c1"}

@@ -40,3 +40,44 @@ def test_null_ppo_e2e(dataset_path, tokenizer, tmp_path, monkeypatch):
     s = master.stats_history[-1]
     assert s["trainDefault/null/n_seqs"] == 4.0
     assert np.isfinite(s["time_perf/e2e"])
+
+
+def test_local_runner_drives_evaluator(dataset_path, tokenizer, tmp_path, monkeypatch):
+    """An experiment with an evaluator config gets a running evaluator
+    thread in the threaded runner too (not only the process launcher)."""
+    monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
+    monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
+    tokenizer_path = str(tmp_path / "tokenizer")
+    tokenizer.save_pretrained(tokenizer_path)
+    from areal_tpu.api.config import DatasetAbstraction
+    from areal_tpu.api.system_api import (
+        EvaluatorConfig,
+        ExperimentSaveEvalControl,
+    )
+    from areal_tpu.apps.local_runner import run_experiment_local
+    from areal_tpu.base import constants
+    from areal_tpu.experiments.null_exp import NullPPOExperiment
+
+    exp = NullPPOExperiment(
+        experiment_name="test-null-eval",
+        trial_name="e2e",
+        n_model_workers=1,
+        tokenizer_path=tokenizer_path,
+        exp_ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=1, benchmark_steps=2
+        ),
+        dataset=DatasetAbstraction(
+            "math_code_prompt",
+            {"dataset_path": dataset_path, "max_length": 64},
+        ),
+        train_bs_n_seqs=4,
+        evaluator=EvaluatorConfig(dataset_path=dataset_path, interval=0.1),
+    )
+    cfg = exp.initial_setup()
+    assert cfg.evaluator is not None  # threaded through make_config
+    master = run_experiment_local(cfg, timeout=300)
+    assert len(master.stats_history) >= 2
+    # the evaluator ran (its output root exists; no checkpoints -> no jobs)
+    import os
+
+    assert os.path.isdir(os.path.join(constants.get_log_path(), "eval"))
