@@ -22,6 +22,20 @@ import sys
 import time
 
 
+def pass_at_k(n_correct, n_samples: int, k: int) -> float:
+    """Unbiased pass@k over prompts: mean of 1 - C(n-c, k)/C(n, k)
+    (the reference evaluation suite's estimator)."""
+    from math import comb
+
+    vals = []
+    for c in n_correct:
+        if n_samples - c < k:
+            vals.append(1.0)
+        else:
+            vals.append(1.0 - comb(n_samples - c, k) / comb(n_samples, k))
+    return sum(vals) / max(1, len(vals))
+
+
 def evaluate_checkpoint(
     ckpt_dir: str,
     dataset_path: str,
@@ -29,7 +43,13 @@ def evaluate_checkpoint(
     max_new_tokens: int = 512,
     kv_cache_len: int = 2048,
     max_batch: int = 16,
+    n_samples: int = 1,
+    temperature: float = 0.6,
 ) -> dict:
+    """``n_samples == 1``: deterministic greedy accuracy.  ``n_samples > 1``:
+    temperature sampling with the unbiased pass@k estimator
+    (1 - C(n-c,k)/C(n,k); the reference's evaluation suite reports pass@k
+    over sampled generations, evaluation/eval_and_aggregate.py)."""
     from transformers import AutoTokenizer
 
     from areal_tpu.api.model_api import (
@@ -43,63 +63,86 @@ def evaluate_checkpoint(
 
     from areal_tpu.engine.sampling import SamplingParams
 
+    if n_samples < 1:
+        raise ValueError(f"n_samples must be >= 1, got {n_samples}")
     cfg, params = load_hf_model(ckpt_dir)
     tokenizer = AutoTokenizer.from_pretrained(ckpt_dir)
+    greedy = n_samples == 1
     engine = ContinuousBatchingEngine(
         cfg,
         params,
         tokenizer=tokenizer,
         max_batch=max_batch,
         kv_cache_len=kv_cache_len,
-        # sampling is engine-level (compile-time): evals decode greedily so
-        # scores are deterministic and comparable across checkpoints
-        sampling=SamplingParams(greedy=True),
+        # sampling is engine-level (compile-time): pass@1 decodes greedily
+        # so scores are deterministic and comparable across checkpoints
+        sampling=SamplingParams(greedy=greedy, temperature=temperature),
     )
 
     id2info, task_cnt = load_metadata(dataset_path)
     items = list(id2info.values())[:max_prompts]
     gcfg = GenerationHyperparameters(
-        max_new_tokens=max_new_tokens, greedy=True
+        max_new_tokens=max_new_tokens, greedy=greedy, temperature=temperature
     )
     t0 = time.time()
+    qids = []  # submit order = aggregation order, single-source format
     for d in items:
         ids = tokenizer(d["prompt"])["input_ids"]
-        engine.submit(
-            APIGenerateInput(
-                qid=d["query_id"], prompt_ids=ids, input_ids=ids, gconfig=gcfg
+        for s in range(n_samples):
+            qid = f"{d['query_id']}#{s}"
+            qids.append(qid)
+            engine.submit(
+                APIGenerateInput(
+                    qid=qid, prompt_ids=ids, input_ids=ids, gconfig=gcfg
+                )
             )
-        )
     outs = {}
-    while len(outs) < len(items):
+    while len(outs) < len(qids):
         engine.step()
-        for d in items:
-            if d["query_id"] in outs:
-                continue
-            r = engine.try_get_result(d["query_id"])
-            if r is not None:
-                outs[d["query_id"]] = r
+        for qid in qids:
+            if qid not in outs:
+                r = engine.try_get_result(qid)
+                if r is not None:
+                    outs[qid] = r
     gen_time = time.time() - t0
 
     texts, tasks, problems = [], [], []
-    for d in items:
-        answer = tokenizer.decode(
-            outs[d["query_id"]].output_ids, skip_special_tokens=True
-        )
-        texts.append(answer)
-        tasks.append(d.get("task", "math"))
-        problems.append(d)
+    for i, d in enumerate(items):
+        for s in range(n_samples):
+            texts.append(
+                tokenizer.decode(
+                    outs[qids[i * n_samples + s]].output_ids,
+                    skip_special_tokens=True,
+                )
+            )
+            tasks.append(d.get("task", "math"))
+            problems.append(d)
     rewards = verify_batch(tasks, texts, problems)
 
+    # group per prompt: c = correct count among n samples
     per_task: dict = {}
-    for t, r in zip(tasks, rewards):
-        per_task.setdefault(t, []).append(r)
+    n_correct = []
+    for i, d in enumerate(items):
+        rs = rewards[i * n_samples : (i + 1) * n_samples]
+        c = sum(1 for r in rs if r > 0)
+        n_correct.append(c)
+        per_task.setdefault(d.get("task", "math"), []).append(c)
+
+    ks = sorted({1, n_samples} | {k for k in (4, 8, 16) if k < n_samples})
     result = {
         "dataset": os.path.basename(dataset_path),
         "n_prompts": len(items),
-        "accuracy": sum(rewards) / max(1, len(rewards)),
+        "n_samples": n_samples,
+        "accuracy": pass_at_k(n_correct, n_samples, 1),
+        "pass_at_k": {
+            str(k): round(pass_at_k(n_correct, n_samples, k), 4) for k in ks
+        },
         "per_task": {
-            t: {"accuracy": sum(rs) / len(rs), "n": len(rs)}
-            for t, rs in per_task.items()
+            t: {
+                "accuracy": sum(cs) / (len(cs) * n_samples),
+                "n": len(cs),
+            }
+            for t, cs in per_task.items()
         },
         "gen_time_s": round(gen_time, 2),
     }
@@ -114,6 +157,8 @@ def main(argv=None) -> int:
     p.add_argument("--max-prompts", type=int, default=64)
     p.add_argument("--max-new-tokens", type=int, default=512)
     p.add_argument("--kv-cache-len", type=int, default=2048)
+    p.add_argument("--n-samples", type=int, default=1)
+    p.add_argument("--temperature", type=float, default=0.6)
     args = p.parse_args(argv)
     result = evaluate_checkpoint(
         args.ckpt,
@@ -121,6 +166,8 @@ def main(argv=None) -> int:
         max_prompts=args.max_prompts,
         max_new_tokens=args.max_new_tokens,
         kv_cache_len=args.kv_cache_len,
+        n_samples=args.n_samples,
+        temperature=args.temperature,
     )
     os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
     tmp = args.output + ".tmp"

@@ -59,12 +59,14 @@ class GserverManager(worker_base.Worker):
             time.sleep(0.1)
         self._clients = {a: GenServerClient(a) for a in self.server_addrs}
 
-        # rollout accounting
+        # rollout accounting (reference: monitor.RolloutStat threading
+        # through rollout_worker/gserver stats)
+        from areal_tpu.base.monitor import RolloutStat
+
         self._round_robin = 0
         self._qid_server: Dict[str, str] = {}
         self._server_load: Dict[str, int] = {a: 0 for a in self.server_addrs}
-        self.n_running_rollouts = 0
-        self.accepted_rollouts = 0  # finished & accepted (trained samples)
+        self.rollout_stat = RolloutStat()
         self._model_version = 0
 
         # service socket
@@ -98,7 +100,7 @@ class GserverManager(worker_base.Worker):
         (reference :417-453).  Rollouts are counted in sequences
         (``group_size`` per rollout) to match ``train_batch_size`` units."""
         n_seqs = (
-            self.accepted_rollouts + self.n_running_rollouts
+            self.rollout_stat.accepted + self.rollout_stat.running
         ) * max(1, self.config.group_size)
         expected_version = n_seqs // max(1, self.config.train_batch_size)
         return (
@@ -108,17 +110,18 @@ class GserverManager(worker_base.Worker):
 
     def _allocate_rollout(self, qid: str) -> Dict:
         cap = self.config.max_concurrent_rollouts or 10**9
-        if self.n_running_rollouts >= cap:
+        if self.rollout_stat.running >= cap:
             return {"ok": False, "reason": "capacity"}
         if self.is_staled():
             return {"ok": False, "reason": "staled"}
-        self.n_running_rollouts += 1
+        self.rollout_stat.submitted += 1
+        self.rollout_stat.running += 1
         return {"ok": True, "reason": ""}
 
     def _finish_rollout(self, qid: str, accepted: bool):
-        self.n_running_rollouts = max(0, self.n_running_rollouts - 1)
+        self.rollout_stat.running = max(0, self.rollout_stat.running - 1)
         if accepted:
-            self.accepted_rollouts += 1
+            self.rollout_stat.accepted += 1
         # scheduling registered per-group-member qids "{qid}-{i}"; multi-turn
         # agents prefix per-turn requests as "{qid}@t{j}" before the member
         # suffix, so both derived forms must be swept
@@ -217,8 +220,12 @@ class GserverManager(worker_base.Worker):
                 elif cmd == "get_status":
                     resp = {
                         "version": self._model_version,
-                        "n_running_rollouts": self.n_running_rollouts,
-                        "accepted_rollouts": self.accepted_rollouts,
+                        "n_running_rollouts": self.rollout_stat.running,
+                        "accepted_rollouts": self.rollout_stat.accepted,
+                        **{
+                            f"rollout_stat/{k}": v
+                            for k, v in self.rollout_stat.as_dict().items()
+                        },
                         "server_load": dict(self._server_load),
                     }
                 else:

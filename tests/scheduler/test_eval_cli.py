@@ -51,3 +51,48 @@ def test_eval_cli_end_to_end(tokenizer, tmp_path):
     assert 0.0 <= result["accuracy"] <= 1.0
     assert result["per_task"]["math"]["n"] == 4
     assert result["gen_time_s"] >= 0
+
+
+def test_eval_cli_pass_at_k(tokenizer, tmp_path):
+    _, ckpt = _tiny_hf_model("llama", tmp_path)
+    tokenizer.save_pretrained(ckpt)
+    rows = [
+        {
+            "query_id": "q0",
+            "prompt": "What is 1 + 1?",
+            "solutions": ["\\boxed{2}"],
+            "task": "math",
+        }
+    ]
+    data = tmp_path / "eval2.jsonl"
+    data.write_text("\n".join(json.dumps(r) for r in rows))
+
+    from areal_tpu.apps.eval import evaluate_checkpoint
+
+    result = evaluate_checkpoint(
+        ckpt,
+        str(data),
+        max_prompts=1,
+        max_new_tokens=8,
+        kv_cache_len=64,
+        n_samples=3,
+        temperature=1.0,
+    )
+    assert result["n_samples"] == 3
+    assert set(result["pass_at_k"]) == {"1", "3"}
+    # pass@k is monotone non-decreasing in k
+    assert result["pass_at_k"]["3"] >= result["pass_at_k"]["1"]
+    assert 0.0 <= result["accuracy"] <= 1.0
+
+
+def test_pass_at_k_estimator_math():
+    # exercises the REAL implementation: c=1 of n=4 -> pass@1=0.25,
+    # pass@2 = 1 - C(3,2)/C(4,2) = 0.5; c=n -> 1.0; c=0 -> 0.0
+    from areal_tpu.apps.eval import pass_at_k
+
+    assert pass_at_k([1], 4, 1) == 0.25
+    assert pass_at_k([1], 4, 2) == 0.5
+    assert pass_at_k([4], 4, 3) == 1.0
+    assert pass_at_k([0], 4, 4) == 0.0
+    # mean over prompts
+    assert pass_at_k([0, 4], 4, 1) == 0.5
