@@ -52,6 +52,7 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
     flush_request_timeout: float = 120.0
     gen_kv_cache_len: int = 32768
     gen_max_concurrent_batch: int = 16
+    gen_chunk_size: int = 32
     # device index hosting each gen server's engine (trainer/gen split)
     gen_device_start: Optional[int] = None
     success_rate_lb: float = 0.0
@@ -133,6 +134,7 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
                 tokenizer_path=self.tokenizer_path,
                 max_concurrent_batch=self.gen_max_concurrent_batch,
                 kv_cache_len=self.gen_kv_cache_len,
+                chunk_size=self.gen_chunk_size,
                 temperature=ppo.gen.temperature,
                 device_idx=(
                     self.gen_device_start + i * gen_tp

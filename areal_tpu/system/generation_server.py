@@ -66,6 +66,7 @@ class GenerationServerWorker(worker_base.Worker):
             tokenizer=tokenizer,
             max_batch=config.max_concurrent_batch,
             kv_cache_len=config.kv_cache_len,
+            chunk_size=config.chunk_size,
             sampling=sampling,
             device=device,
             mesh=mesh,
