@@ -1,0 +1,80 @@
+"""Dataflow-graph unit tests: key-matched wiring, levels, cycle/dup
+detection, producer/parent queries (reference: tests/data/test_dfg.py)."""
+
+import pytest
+
+from areal_tpu.api.config import ModelInterfaceAbstraction, ModelName
+from areal_tpu.api.dfg import (
+    MFCDef,
+    ModelInterfaceType,
+    build_graph,
+    topological_levels,
+)
+
+IFACE = ModelInterfaceAbstraction("null")
+
+
+def _mfc(name, inputs=(), outputs=(), itype=ModelInterfaceType.INFERENCE):
+    return MFCDef(
+        name=name,
+        model_name=ModelName(name.split("_")[0]),
+        interface_type=itype,
+        interface_impl=IFACE,
+        input_keys=tuple(inputs),
+        output_keys=tuple(outputs),
+        n_seqs=4,
+    )
+
+
+def _ppo_like():
+    gen = _mfc(
+        "actor_gen",
+        ["packed_prompts"],
+        ["packed_input_ids", "packed_logprobs"],
+        ModelInterfaceType.GENERATE,
+    )
+    rew = _mfc("rew_inf", ["packed_input_ids"], ["rewards"])
+    ref = _mfc("ref_inf", ["packed_input_ids"], ["packed_ref_logprobs"])
+    train = _mfc(
+        "actor_train",
+        ["packed_input_ids", "rewards", "packed_ref_logprobs"],
+        [],
+        ModelInterfaceType.TRAIN_STEP,
+    )
+    return gen, rew, ref, train
+
+
+def test_key_matched_edges_and_levels():
+    gen, rew, ref, train = _ppo_like()
+    G = build_graph([gen, rew, ref, train])
+    assert set(G.successors("actor_gen")) == {"rew_inf", "ref_inf", "actor_train"}
+    assert G.edges["actor_gen", "rew_inf"]["keys"] == ["packed_input_ids"]
+    levels = topological_levels(G)
+    names = [[r.name for r in lvl] for lvl in levels]
+    assert names[0] == ["actor_gen"]
+    assert set(names[1]) == {"rew_inf", "ref_inf"}  # independent: concurrent
+    assert names[2] == ["actor_train"]
+    # node-level queries
+    assert gen.is_src and train.is_dst
+    assert {p.name for p in train.parents} == {
+        "actor_gen",
+        "rew_inf",
+        "ref_inf",
+    }
+    assert train.data_producers["rewards"] == "rew_inf"
+    # externally-supplied key (dataset) has no producer
+    assert gen.data_producers["packed_prompts"] is None
+
+
+def test_duplicate_names_rejected():
+    a = _mfc("x", [], ["k"])
+    b = _mfc("x", ["k"], [])
+    with pytest.raises(ValueError, match="duplicate"):
+        build_graph([a, b])
+
+
+def test_cycle_rejected():
+    a = _mfc("a", ["kb"], ["ka"])
+    b = _mfc("b", ["ka"], ["kb"])
+    with pytest.raises(ValueError, match="cycle"):
+        build_graph([a, b])

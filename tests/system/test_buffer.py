@@ -1,0 +1,92 @@
+"""Async sequence buffer unit tests: readiness by key availability,
+birth-time dequeue order, amend merging, consumption GC (reference:
+realhf/system/buffer.py semantics, tested per SURVEY §4's unit layer)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.system.buffer import AsyncIOSequenceBuffer
+
+
+def _sample(sid, birth, keys=("packed_prompts",)):
+    data = {k: np.arange(3, dtype=np.int64) for k in keys}
+    return SequenceSample.from_default(
+        seqlens=[3], ids=[sid], data=data, metadata={"birth_time": [birth]}
+    )
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_birth_time_order_and_readiness():
+    async def main():
+        buf = AsyncIOSequenceBuffer()
+        await buf.put_batch([_sample("b", birth=2.0), _sample("a", birth=1.0)])
+        idxs, gathered = await buf.get_batch_for_rpc(
+            "gen", ["packed_prompts"], 2
+        )
+        assert gathered.ids == ["a", "b"]  # oldest first
+        # same rpc never sees the same sequences again
+        await buf.put_batch([_sample("c", birth=0.5)])
+        _, g2 = await buf.get_batch_for_rpc("gen", ["packed_prompts"], 1)
+        assert g2.ids == ["c"]
+
+    _run(main())
+
+
+def test_keys_gate_readiness_and_amend_unblocks():
+    async def main():
+        buf = AsyncIOSequenceBuffer()
+        await buf.put_batch([_sample("x", 1.0)])
+
+        got = []
+
+        async def consumer():
+            _, g = await buf.get_batch_for_rpc("train", ["rewards"], 1)
+            got.append(g)
+
+        task = asyncio.create_task(consumer())
+        await asyncio.sleep(0.05)
+        assert not got  # rewards key missing -> not ready
+        amend = SequenceSample.from_default(
+            seqlens=[1],
+            ids=["x"],
+            data={"rewards": np.asarray([1.0], np.float32)},
+        )
+        await buf.amend_batch(amend)
+        await asyncio.wait_for(task, timeout=2)
+        assert got and got[0].ids == ["x"]
+
+    _run(main())
+
+
+def test_consume_and_pop_consumed():
+    async def main():
+        buf = AsyncIOSequenceBuffer()
+        await buf.put_batch([_sample("1", 1.0), _sample("2", 2.0)])
+        await buf.get_batch_for_rpc("a", ["packed_prompts"], 2)
+        await buf.get_batch_for_rpc("b", ["packed_prompts"], 1)
+        done = await buf.pop_consumed(["a", "b"])
+        assert done == ["1"]
+        assert buf.size == 1
+        # terminal consume removes immediately
+        _, g = await buf.get_batch_for_rpc(
+            "b", ["packed_prompts"], 1, consume=True
+        )
+        assert g.ids == ["2"] and buf.size == 0
+
+    _run(main())
+
+
+def test_duplicate_id_rejected():
+    async def main():
+        buf = AsyncIOSequenceBuffer()
+        await buf.put_batch([_sample("d", 1.0)])
+        with pytest.raises(ValueError, match="duplicate"):
+            await buf.put_batch([_sample("d", 2.0)])
+
+    _run(main())

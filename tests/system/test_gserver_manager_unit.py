@@ -1,0 +1,92 @@
+"""Gserver manager scheduling/staleness unit tests without the ZMQ service
+(reference: tests/system/test_gserver_manager.py's routing + is_staled
+assertions against mock servers)."""
+
+import pytest
+
+from areal_tpu.api.system_api import GserverManagerConfig
+from areal_tpu.base.monitor import RolloutStat
+from areal_tpu.system.gserver_manager import GserverManager
+
+
+def _manager(policy="least_requests", **cfg_kwargs):
+    m = GserverManager.__new__(GserverManager)
+    m.config = GserverManagerConfig(
+        schedule_policy=policy,
+        n_servers=3,
+        **cfg_kwargs,
+    )
+    m.server_addrs = ["s0", "s1", "s2"]
+    m._round_robin = 0
+    m._qid_server = {}
+    m._server_load = {a: 0 for a in m.server_addrs}
+    m.rollout_stat = RolloutStat()
+    m._model_version = 0
+    return m
+
+
+def test_sticky_routing_reuses_server():
+    m = _manager()
+    first = m._schedule("q1")
+    assert m._schedule("q1") == first  # continuation: same KV cache
+
+
+def test_least_requests_balances():
+    m = _manager()
+    m._server_load.update({"s0": 5, "s1": 1, "s2": 3})
+    assert m._schedule("qa") == "s1"
+    assert m._server_load["s1"] == 2
+
+
+def test_round_robin_cycles():
+    m = _manager(policy="round_robin")
+    got = [m._schedule(f"q{i}") for i in range(4)]
+    assert got == ["s0", "s1", "s2", "s0"]
+
+
+def test_staleness_gate_units():
+    # 8 seqs/rollout, train batch 16, offpolicyness 0: after 2 rollouts a
+    # third would imply version 1 > 0 + 0 -> staled
+    m = _manager(
+        group_size=8, train_batch_size=16, max_head_offpolicyness=0
+    )
+    assert m._allocate_rollout("a")["ok"]
+    assert m._allocate_rollout("b")["ok"]
+    r = m._allocate_rollout("c")
+    assert not r["ok"] and r["reason"] == "staled"
+    # a version bump lifts the gate
+    m._model_version = 1
+    assert m._allocate_rollout("c")["ok"]
+
+
+def test_capacity_gate():
+    m = _manager(max_concurrent_rollouts=1, group_size=1, train_batch_size=100)
+    assert m._allocate_rollout("a")["ok"]
+    r = m._allocate_rollout("b")
+    assert not r["ok"] and r["reason"] == "capacity"
+    m._finish_rollout("a", accepted=True)
+    assert m._allocate_rollout("b")["ok"]
+    assert m.rollout_stat.accepted == 1 and m.rollout_stat.running == 1
+
+
+@pytest.mark.parametrize(
+    "key", ["q7", "q7-0", "q7-3", "q7@t1-0"]
+)
+def test_finish_sweeps_derived_qids(key):
+    # group members register '{qid}-{i}'; multi-turn turns '{qid}@t{j}-{i}'
+    m = _manager()
+    m._allocate_rollout("q7")
+    addr = m._schedule(key)
+    assert m._server_load[addr] == 1
+    m._finish_rollout("q7", accepted=False)
+    assert m._qid_server == {}
+    assert m._server_load[addr] == 0
+    assert m.rollout_stat.accepted == 0
+
+
+def test_finish_does_not_sweep_unrelated():
+    m = _manager()
+    m._schedule("q70")  # shares the 'q7' prefix but is a different rollout
+    m._allocate_rollout("q7")
+    m._finish_rollout("q7", accepted=True)
+    assert "q70" in m._qid_server

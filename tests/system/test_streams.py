@@ -1,0 +1,77 @@
+"""ZMQ push-pull stream + puller stream dataset unit tests (reference:
+tests/system/test_push_pull_stream.py / test_stream_dataset.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.base import constants, name_resolve
+from areal_tpu.system.push_pull_stream import (
+    NameResolvingZmqPusher,
+    NameResolvingZmqPuller,
+    ZMQJsonPuller,
+    ZMQJsonPusher,
+    queue_Empty,
+)
+
+
+@pytest.fixture
+def trial():
+    name_resolve.reconfigure("memory")
+    constants.set_experiment_trial_names("streamtest", "t0")
+    yield "streamtest", "t0"
+
+
+def test_push_pull_roundtrip():
+    puller = ZMQJsonPuller(host="127.0.0.1")  # random port
+    pusher = ZMQJsonPusher(host="127.0.0.1", port=puller.port)
+    try:
+        pusher.push({"a": 1})
+        pusher.push([1, 2, 3])
+        assert puller.pull(timeout_ms=2000) == {"a": 1}
+        assert puller.pull(timeout_ms=2000) == [1, 2, 3]
+        with pytest.raises(queue_Empty):
+            puller.pull(timeout_ms=50)
+    finally:
+        pusher.close()
+        puller.close()
+
+
+def test_name_resolving_pusher_finds_puller(trial):
+    expr, tname = trial
+    puller = NameResolvingZmqPuller(expr, tname, puller_index=0)
+    pusher = NameResolvingZmqPusher(expr, tname, pusher_index=0)
+    try:
+        pusher.push({"hello": "world"})
+        assert puller.pull(timeout_ms=2000) == {"hello": "world"}
+    finally:
+        pusher.close()
+        puller.close()
+
+
+def test_stream_dataset_receives_trajectories(trial):
+    expr, tname = trial
+    from areal_tpu.system.stream_dataset import PullerStreamDataset
+
+    ds = PullerStreamDataset(expr, tname, puller_index=0, dataset_size=64)
+    pusher = NameResolvingZmqPusher(expr, tname, pusher_index=0)
+    try:
+        sample = SequenceSample.from_default(
+            seqlens=[4],
+            ids=["traj0"],
+            data={"packed_input_ids": np.arange(4, dtype=np.int64)},
+        )
+        pusher.push([sample.as_json_compatible()])
+        deadline = time.monotonic() + 5
+        got = None
+        while got is None and time.monotonic() < deadline:
+            got = ds.get(timeout=0.2)
+        assert got is not None and got.ids == ["traj0"]
+        np.testing.assert_array_equal(
+            got.data["packed_input_ids"], np.arange(4)
+        )
+    finally:
+        pusher.close()
+        ds.close()
