@@ -119,9 +119,10 @@ def _decode_chunk(
     """Generate up to ``chunk_size`` tokens for all active rows device-side.
 
     Dispatches to the windowed :func:`transformer.decode_chunk` (one cache
-    scatter per chunk); sliding-window models fall back to the step-wise
-    loop.  Returns (cache, out_tokens [B,K], out_logps [B,K],
-    emitted [B,K] bool, cur_tokens, active, budgets, rng).
+    scatter per chunk), including sliding-window models whenever
+    ``chunk_size <= sliding_window``; only pathological window/chunk combos
+    fall back to the step-wise loop.  Returns (cache, out_tokens [B,K],
+    out_logps [B,K], emitted [B,K] bool, cur_tokens, active, budgets, rng).
     """
     B = cur_tokens.shape[0]
     S = cache.max_len
@@ -132,7 +133,7 @@ def _decode_chunk(
             stop |= tok == s
         return stop
 
-    if cfg.sliding_window is None:
+    if cfg.sliding_window is None or chunk_size <= cfg.sliding_window:
         from areal_tpu.models.transformer import decode_chunk
 
         return decode_chunk(

@@ -788,19 +788,33 @@ def decode_chunk(
     every row is shorter wastes the bandwidth the kernel lives on.  The
     caller must guarantee every row stays below ``attn_len`` through the
     whole chunk (engine buckets max in-flight length + chunk_size).
+    Sliding-window models mask beyond-window slots but still STREAM the
+    full prefix (per-row window offsets need gather/paged reads — the
+    flash-decode kernel's future window lower bound); at window << prefix
+    that is the known inefficiency of this path.
 
     Returns (cache, out_tokens [B,W], out_logps [B,W], emitted [B,W] bool,
     cur_tokens, active, budgets, rng).
     """
-    assert cfg.sliding_window is None, "use step-wise decode for sliding window"
+    if cfg.sliding_window is not None and chunk_size > cfg.sliding_window:
+        raise ValueError(
+            "chunked decode requires chunk_size <= sliding_window "
+            f"({chunk_size} > {cfg.sliding_window}); in-chunk KV must stay "
+            "inside every query's attention window"
+        )
     B = cur_tokens.shape[0]
     S = cache.max_len
     Sa = S if attn_len is None else min(attn_len, S)
     W = chunk_size
     L, Hkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     base_lens = cache.lengths  # frozen: main-cache valid region per row
-    mask_main = (jnp.arange(Sa)[None, :] < base_lens[:, None])  # [B,Sa]
-    use_kernel = _flash_decode_enabled() and Sa % 256 == 0 and hd % 128 == 0
+    mask_base = (jnp.arange(Sa)[None, :] < base_lens[:, None])  # [B,Sa]
+    use_kernel = (
+        _flash_decode_enabled()
+        and Sa % 256 == 0
+        and hd % 128 == 0
+        and cfg.sliding_window is None
+    )
 
     wk = jnp.zeros((L, W, B, Hkv, hd), cache.k.dtype)
     wv = jnp.zeros((L, W, B, Hkv, hd), cache.v.dtype)
@@ -818,6 +832,16 @@ def decode_chunk(
         )
         wvalid = wvalid.at[i].set(active)
         mask_win = wvalid.T[:, None, None, None, :]  # [B,1,1,1,W]
+        # per-step cache mask: base prefix, plus the sliding-window lower
+        # bound relative to the CURRENT query position (cache slot s holds
+        # absolute position s). Window entries are always in range because
+        # chunk_size <= sliding_window (checked above).
+        if cfg.sliding_window is not None:
+            mask_main = mask_base & (
+                jnp.arange(Sa)[None, :] > positions - cfg.sliding_window
+            )
+        else:
+            mask_main = mask_base
 
         def body(carry, xs):
             x, wk, wv = carry

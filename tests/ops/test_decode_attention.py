@@ -139,3 +139,85 @@ def test_decode_chunk_kernel_path_matches_dense(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(c_d.k), np.asarray(c_k.k), rtol=2e-2, atol=2e-2
     )
+
+
+def test_decode_chunk_sliding_window_matches_stepwise():
+    """Chunked decode with a sliding window must equal the step-wise
+    decode_step path (previously the ONLY sliding-window decode)."""
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config(
+        n_layers=2,
+        hidden_dim=64,
+        n_q_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        intermediate_dim=128,
+        vocab_size=64,
+        max_position_embeddings=256,
+        dtype="float32",
+        sliding_window=12,
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    B, S, W = 3, 64, 8
+    assert W <= cfg.sliding_window
+    toks = jax.random.randint(jax.random.PRNGKey(2), (B, 32), 0, 64)
+    positions = jnp.tile(jnp.arange(32)[None], (B, 1))
+    prompt_lens = jnp.asarray([20, 5, 32], jnp.int32)  # some exceed window
+    seg = (positions < prompt_lens[:, None]).astype(jnp.int32)
+
+    def fresh_cache():
+        cache = transformer.KVCache.zeros(cfg, B, S)
+        _, cache = transformer.prefill(
+            params, cfg, toks, positions, seg, cache
+        )
+        return cache
+
+    cur0 = jnp.asarray([1, 2, 3], jnp.int32)
+
+    def sample(logits, sub):
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp = jax.nn.log_softmax(logits)[jnp.arange(B), t]
+        return t, lp
+
+    # chunked path
+    out = transformer.decode_chunk(
+        params, cfg, fresh_cache(), cur0,
+        jnp.ones((B,), bool), jnp.full((B,), W, jnp.int32),
+        jax.random.PRNGKey(5), W, sample,
+        lambda t: jnp.zeros_like(t, bool),
+    )
+    chunk_toks = np.asarray(out[1])
+
+    # step-wise reference
+    cache = fresh_cache()
+    cur = cur0
+    step_toks = []
+    for _ in range(W):
+        logits, cache = transformer.decode_step(params, cfg, cur, cache)
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        step_toks.append(np.asarray(t))
+        cur = t
+    step_toks = np.stack(step_toks, axis=1)
+    np.testing.assert_array_equal(chunk_toks, step_toks)
+
+
+def test_decode_chunk_rejects_oversized_chunk_for_window():
+    import pytest
+
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config(sliding_window=4)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    cache = transformer.KVCache.zeros(cfg, 2, 32)
+    with pytest.raises(ValueError, match="sliding_window"):
+        transformer.decode_chunk(
+            params, cfg, cache,
+            jnp.zeros((2,), jnp.int32), jnp.ones((2,), bool),
+            jnp.full((2,), 8, jnp.int32), jax.random.PRNGKey(0), 8,
+            lambda l, s: (jnp.argmax(l, -1).astype(jnp.int32),
+                          jnp.zeros((2,), jnp.float32)),
+            lambda t: jnp.zeros_like(t, bool),
+        )
