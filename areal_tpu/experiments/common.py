@@ -18,6 +18,31 @@ from areal_tpu.api.system_api import (
 from areal_tpu.base.topology import MeshSpec
 
 
+def model_config_from_abstraction(model: Optional[ModelAbstraction]):
+    """TransformerConfig for a model abstraction ('hf' reads config.json
+    only, 'random' builds from args), or None when underivable.  Used by
+    the heuristic allocation hooks."""
+    if model is None:
+        return None
+    if model.type_ == "hf":
+        from areal_tpu.models.hf.registry import load_hf_config
+
+        _, cfg, _ = load_hf_config(model.args["path"])
+        return cfg
+    if model.type_ == "random":
+        from areal_tpu.models.config import TransformerConfig, tiny_config
+
+        args = dict(model.args)
+        args.pop("seed", None)
+        conf = args.pop("config", None)
+        if isinstance(conf, TransformerConfig):
+            return conf
+        if conf is not None:
+            return TransformerConfig(**conf)
+        return tiny_config(**args)
+    return None
+
+
 @dataclasses.dataclass
 class CommonExperimentConfig(system_api.Experiment):
     """Base options shared by quickstart experiments."""
@@ -60,10 +85,27 @@ class CommonExperimentConfig(system_api.Experiment):
 
     # -- heuristic allocation hooks (overridden by concrete experiments) ----
 
+    def _main_model(self) -> Optional[ModelAbstraction]:
+        """The trained model's abstraction (drives heuristic allocation and
+        tokenizer defaulting); None when the experiment has no single one."""
+        return None
+
+    def prepare_common(self):
+        """Shared initial_setup preamble: resolve the allocation string and
+        default the tokenizer to the main model's HF path."""
+        self.resolve_allocation()
+        main = self._main_model()
+        if (
+            self.tokenizer_path is None
+            and main is not None
+            and main.type_ == "hf"
+        ):
+            self.tokenizer_path = main.args["path"]
+
     def _heuristic_model_config(self):
         """TransformerConfig of the trained model, or None when the
         experiment cannot derive one."""
-        return None
+        return model_config_from_abstraction(self._main_model())
 
     def _heuristic_tokens_per_step(self) -> int:
         return 32768

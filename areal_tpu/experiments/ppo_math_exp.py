@@ -81,26 +81,8 @@ class PPOMathExperiment(CommonExperimentConfig):
     # verifier with the ref forward). Only takes effect when use_ref.
     fuse_rew_ref: bool = False
 
-    def _heuristic_model_config(self):
-        if self.actor is None:
-            return None
-        if self.actor.type_ == "hf":
-            from areal_tpu.models.hf.registry import load_hf_config
-
-            _, cfg, _ = load_hf_config(self.actor.args["path"])
-            return cfg
-        if self.actor.type_ == "random":
-            from areal_tpu.models.config import TransformerConfig, tiny_config
-
-            args = dict(self.actor.args)
-            args.pop("seed", None)
-            conf = args.pop("config", None)
-            if isinstance(conf, TransformerConfig):
-                return conf
-            if conf is not None:
-                return TransformerConfig(**conf)
-            return tiny_config(**args)
-        return None
+    def _main_model(self):
+        return self.actor
 
     def _heuristic_tokens_per_step(self) -> int:
         # prompts + generations for one train batch (upper bound: every
@@ -117,9 +99,7 @@ class PPOMathExperiment(CommonExperimentConfig):
         return self.ppo.kl_ctl != 0.0
 
     def initial_setup(self) -> system_api.ExperimentConfig:
-        self.resolve_allocation()  # allocation_mode -> mesh_spec
-        if self.tokenizer_path is None and self.actor.type_ == "hf":
-            self.tokenizer_path = self.actor.args["path"]
+        self.prepare_common()  # allocation_mode -> mesh_spec, tokenizer
         ppo = self.ppo
         actor = ModelName("actor")
         critic = ModelName("critic")

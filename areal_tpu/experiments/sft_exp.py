@@ -31,7 +31,11 @@ class SFTExperiment(CommonExperimentConfig):
         default_factory=OptimizerConfig
     )
 
+    def _main_model(self):
+        return self.model
+
     def initial_setup(self) -> system_api.ExperimentConfig:
+        self.prepare_common()
         model_name = ModelName("default")
         rpc = MFCDef(
             name="trainDefault",
