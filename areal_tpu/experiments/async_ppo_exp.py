@@ -52,7 +52,7 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
     flush_request_timeout: float = 120.0
     gen_kv_cache_len: int = 32768
     gen_max_concurrent_batch: int = 16
-    gen_chunk_size: int = 32
+    gen_chunk_size: int = 64  # measured on v5e: 3.7k tok/s @64 vs 3.9k @128
     # device index hosting each gen server's engine (trainer/gen split)
     gen_device_start: Optional[int] = None
     success_rate_lb: float = 0.0

@@ -71,7 +71,7 @@ def bench_generation(cfg, params, n_reqs=32, prompt_len=512, max_new=512):
             bf16,
             max_batch=n_reqs,
             kv_cache_len=bench_gen_cache_len(prompt_len, max_new),
-            chunk_size=64,
+            chunk_size=128,
         )
         gcfg = GenerationHyperparameters(
             max_new_tokens=max_new_tokens, temperature=1.0
