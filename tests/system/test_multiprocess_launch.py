@@ -103,3 +103,48 @@ def test_multiprocess_async_ppo(dataset_path, tokenizer_path, tmp_path, launch_e
     import numpy as np
 
     assert np.isfinite(steps[-1]["actor_train/loss"])
+
+
+def test_multiprocess_sync_ppo_server_backend(
+    dataset_path, tokenizer_path, tmp_path, launch_env, monkeypatch
+):
+    """Same multi-process launch, but cross-process discovery goes through
+    the in-repo ZMQ name-resolve SERVICE instead of the NFS tree (the
+    redis/etcd3 deployment shape; base/name_resolve_server.py)."""
+    from areal_tpu.apps.main import launch_experiment
+    from areal_tpu.base.name_resolve_server import NameResolveServer
+    from tests.system.exp_factories import make_sync_ppo_exp
+
+    server = NameResolveServer(port=0, host="127.0.0.1").start()
+    addr = f"127.0.0.1:{server.port}"
+    monkeypatch.setenv("AREAL_NAME_RESOLVE", "server")
+    monkeypatch.setenv("AREAL_NAME_RESOLVE_ADDR", addr)
+    env = {
+        **launch_env,
+        "AREAL_NAME_RESOLVE": "server",
+        "AREAL_NAME_RESOLVE_ADDR": addr,
+    }
+    try:
+        exp = make_sync_ppo_exp(
+            dataset_path,
+            tokenizer_path,
+            trial_name="mp-server",
+            kl_ctl=0.0,
+            disable_value=True,
+            use_decoupled_loss=True,
+        )
+        cfg = exp.initial_setup()
+        launch_experiment(cfg, mode="local", timeout=900, env=env)
+        steps = _read_master_stats(tmp_path, cfg.experiment_name, "mp-server")
+        assert len(steps) >= 2
+        import numpy as np
+
+        assert np.isfinite(steps[-1]["actor_train/loss"])
+    finally:
+        # restore the global backend BEFORE stopping the server: later tests
+        # in this process must not inherit a repository aimed at a dead ZMQ
+        # endpoint (reset() alone keeps the repository object)
+        from areal_tpu.base import name_resolve
+
+        name_resolve.reconfigure("memory")
+        server.stop()
