@@ -104,6 +104,13 @@ def _launch_once(
     sched = make_scheduler(mode, cfg.experiment_name, cfg.trial_name)
     wenv = {
         "AREAL_NAME_RESOLVE": backend,
+        # the server backend resolves its endpoint from this var — workers
+        # need it propagated just like the backend selector itself
+        **(
+            {"AREAL_NAME_RESOLVE_ADDR": os.environ["AREAL_NAME_RESOLVE_ADDR"]}
+            if os.environ.get("AREAL_NAME_RESOLVE_ADDR")
+            else {}
+        ),
         **({"AREAL_RECOVER": "1"} if recover else {}),
         **(env or {}),
     }

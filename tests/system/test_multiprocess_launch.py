@@ -119,11 +119,9 @@ def test_multiprocess_sync_ppo_server_backend(
     addr = f"127.0.0.1:{server.port}"
     monkeypatch.setenv("AREAL_NAME_RESOLVE", "server")
     monkeypatch.setenv("AREAL_NAME_RESOLVE_ADDR", addr)
-    env = {
-        **launch_env,
-        "AREAL_NAME_RESOLVE": "server",
-        "AREAL_NAME_RESOLVE_ADDR": addr,
-    }
+    # the launcher propagates backend + ADDR to workers; only the backend
+    # override is needed here (launch_env pins the nfs default)
+    env = {**launch_env, "AREAL_NAME_RESOLVE": "server"}
     try:
         exp = make_sync_ppo_exp(
             dataset_path,

@@ -1,0 +1,106 @@
+"""Partial-rollout manager unit tests with a mocked manager + generation
+server (mirrors the reference's mock-reply pattern for its partial-rollout
+tests, realhf/system/partial_rollout.py:29 semantics): chunked
+continuation, version accumulation across weight versions, early EOS
+stop, group reassembly."""
+
+import asyncio
+
+import pytest
+
+from areal_tpu.api import model_api
+from areal_tpu.system.partial_rollout import PartialRolloutManager
+
+
+class StubManagerClient:
+    def __init__(self):
+        self.calls = []
+
+    def call(self, cmd, payload):
+        self.calls.append((cmd, payload))
+        assert cmd == "schedule_request"
+        return {"url": "stub:0", "version": 0}
+
+
+class StubGenClient:
+    """Scripted per-chunk server: returns ``tokens_per_chunk`` tokens per
+    call, bumps its weight version between calls, EOS at ``eos_after``
+    total tokens."""
+
+    def __init__(self, tokens_per_chunk=4, eos_after=None):
+        self.tokens_per_chunk = tokens_per_chunk
+        self.eos_after = eos_after
+        self.version = 0
+        self.calls = []
+
+    def generate(self, inp: model_api.APIGenerateInput):
+        self.calls.append(inp)
+        start = len(inp.input_ids) - len(inp.prompt_ids)
+        n = min(self.tokens_per_chunk, inp.gconfig.max_new_tokens)
+        no_eos = True
+        if self.eos_after is not None and start + n >= self.eos_after:
+            n = self.eos_after - start
+            no_eos = False
+        out = model_api.APIGenerateOutput(
+            qid=inp.qid,
+            prompt_ids=inp.prompt_ids,
+            input_ids=inp.input_ids,
+            output_ids=[100 + start + j for j in range(n)],
+            output_logprobs=[-0.5] * n,
+            no_eos=no_eos,
+            version_start=self.version,
+            version_end=self.version,
+        )
+        self.version += 1  # weights swap between chunks
+        return out
+
+    def close(self):
+        pass
+
+
+def _manager(gen_client, max_new=10, chunk=4):
+    prm = PartialRolloutManager(
+        StubManagerClient(),
+        model_api.GenerationHyperparameters(max_new_tokens=max_new),
+        new_tokens_per_chunk=chunk,
+    )
+    prm._server_clients["stub:0"] = gen_client
+    return prm
+
+
+def test_chunked_continuation_accumulates_versions():
+    gen = StubGenClient(tokens_per_chunk=4)
+    prm = _manager(gen, max_new=10, chunk=4)
+    bundle = asyncio.run(prm.generate_group("q", [1, 2, 3], 1))
+    # 3 chunks: 4 + 4 + 2 tokens; continuations carry the full transcript
+    assert len(gen.calls) == 3
+    assert gen.calls[1].input_ids == [1, 2, 3, 100, 101, 102, 103]
+    assert gen.calls[2].gconfig.max_new_tokens == 2
+    # transcript = prompt + 10 sequential tokens
+    assert bundle.seqs[0] == [1, 2, 3] + [100 + j for j in range(10)]
+    # behavioral versions span the swaps: started at v0, ended at v2
+    assert bundle.version_start[0] == 0
+    assert bundle.version_end[0] == 2
+    assert bundle.no_eos[0] is True
+
+
+def test_eos_stops_early():
+    gen = StubGenClient(tokens_per_chunk=4, eos_after=6)
+    prm = _manager(gen, max_new=100, chunk=4)
+    bundle = asyncio.run(prm.generate_group("q", [7], 1))
+    assert len(bundle.seqs[0]) == 1 + 6
+    assert bundle.no_eos[0] is False
+    assert len(gen.calls) == 2  # 4 tokens, then the EOS chunk of 2
+
+
+def test_group_members_get_distinct_qids_and_reassemble():
+    gen = StubGenClient(tokens_per_chunk=8)
+    prm = _manager(gen, max_new=8, chunk=8)
+    bundle = asyncio.run(prm.generate_group("q9", [5, 5], 3))
+    assert bundle.qid == "q9"
+    assert len(bundle.seqs) == 3
+    member_qids = sorted(c.qid for c in gen.calls)
+    assert member_qids == ["q9-0", "q9-1", "q9-2"]
+    # packed logprob layout: len(seq) - 1 per member
+    for seq, lps in zip(bundle.seqs, bundle.logprobs):
+        assert len(lps) == len(seq) - 1
