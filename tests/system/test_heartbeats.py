@@ -1,0 +1,70 @@
+"""Heartbeat staleness detection unit tests (reference: the heartbeat/
+watch keys of realhf/system/worker_base.py:701-708): change-based ages on
+the observer's clock, terminal statuses exempt, never-beating workers not
+declared lost."""
+
+import time
+
+import pytest
+
+from areal_tpu.base import constants, name_resolve, names
+from areal_tpu.system.worker_base import (
+    WorkerControlPanel,
+    WorkerServerStatus,
+)
+
+EXPR, TRIAL = "hbtest", "t0"
+
+
+@pytest.fixture
+def panel():
+    name_resolve.reconfigure("memory")
+    constants.set_experiment_trial_names(EXPR, TRIAL)
+    p = WorkerControlPanel(EXPR, TRIAL)
+    yield p
+    p.close()
+
+
+def _beat(worker, value):
+    name_resolve.add(
+        names.worker_heartbeat(EXPR, TRIAL, worker), str(value), replace=True
+    )
+
+
+def _status(worker, status):
+    name_resolve.add(
+        names.worker_status(EXPR, TRIAL, worker),
+        status.value,
+        replace=True,
+    )
+
+
+def test_age_tracks_value_changes_not_wallclock(panel):
+    # a heartbeat with a SKEWED remote timestamp is fresh when first seen
+    _beat("w0", 123456.0)
+    assert panel.get_heartbeat_age("w0") == 0.0
+    time.sleep(0.05)
+    # unchanged value ages on the observer's clock
+    age = panel.get_heartbeat_age("w0")
+    assert 0.04 <= age < 5
+    # a changed value resets the age regardless of its numeric content
+    _beat("w0", 1.0)
+    assert panel.get_heartbeat_age("w0") == 0.0
+
+
+def test_never_beating_worker_is_not_stale(panel):
+    assert panel.get_heartbeat_age("ghost") is None
+    assert panel.find_stale_workers(["ghost"], timeout=0.0) == []
+
+
+def test_stale_detection_and_terminal_exemption(panel):
+    for w in ("alive", "dead", "done"):
+        _beat(w, 1.0)
+        panel.get_heartbeat_age(w)  # first observation
+    time.sleep(0.1)
+    _beat("alive", 2.0)  # alive keeps beating
+    _status("done", WorkerServerStatus.COMPLETED)  # finished cleanly
+    stale = panel.find_stale_workers(
+        ["alive", "dead", "done"], timeout=0.05
+    )
+    assert stale == ["dead"]

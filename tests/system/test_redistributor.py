@@ -1,0 +1,62 @@
+"""Redistribution planner unit tests (reference:
+tests/comm/test_data_transfer.py's planner assertions): ownership
+tracking, minimal pull plans, co-location preference, missing-owner
+errors."""
+
+import pytest
+
+from areal_tpu.system.redistributor import (
+    GlobalStorageTracker,
+    RedistribPlanner,
+)
+
+
+def test_no_pulls_when_dst_owns_everything():
+    t = GlobalStorageTracker()
+    t.add_data("w0", ["a", "b"], ["x"])
+    plan = RedistribPlanner(t).derive_plan(["w0"], ["a", "b"], ["x"])
+    assert plan == []
+
+
+def test_single_source_pull_groups_ids():
+    t = GlobalStorageTracker()
+    t.add_data("w0", ["a", "b"], ["x", "y"])
+    plan = RedistribPlanner(t).derive_plan(["w1"], ["a", "b"], ["x", "y"])
+    assert len(plan) == 1
+    step = plan[0]
+    assert (step.dst, step.src) == ("w1", "w0")
+    assert sorted(step.ids) == ["a", "b"] and sorted(step.keys) == ["x", "y"]
+    # the plan records the transfer: dst now owns the data
+    assert "w1" in t.owners("a", "x")
+
+
+def test_prefers_colocated_source():
+    t = GlobalStorageTracker()
+    t.add_data("w0", ["a"], ["x"])  # only x
+    t.add_data("w1", ["a"], ["x", "y"])  # both keys
+    plan = RedistribPlanner(t).derive_plan(["w2"], ["a"], ["x", "y"])
+    assert len(plan) == 1 and plan[0].src == "w1"
+
+
+def test_split_sources_when_no_single_owner():
+    t = GlobalStorageTracker()
+    t.add_data("w0", ["a"], ["x"])
+    t.add_data("w1", ["a"], ["y"])
+    plan = RedistribPlanner(t).derive_plan(["w2"], ["a"], ["x", "y"])
+    srcs = {(s.src, tuple(s.keys)) for s in plan}
+    assert srcs == {("w0", ("x",)), ("w1", ("y",))}
+
+
+def test_missing_owner_raises():
+    t = GlobalStorageTracker()
+    t.add_data("w0", ["a"], ["x"])
+    with pytest.raises(RuntimeError, match="no owner"):
+        RedistribPlanner(t).derive_plan(["w1"], ["a"], ["nope"])
+
+
+def test_drop_ids_gc():
+    t = GlobalStorageTracker()
+    t.add_data("w0", ["a", "b"], ["x"])
+    t.drop_ids(["a"])
+    assert t.owners("a", "x") == set()
+    assert t.owners("b", "x") == {"w0"}
