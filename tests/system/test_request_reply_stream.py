@@ -1,0 +1,83 @@
+"""Master<->worker request-reply stream unit tests (reference:
+realhf/system/request_reply_stream.py semantics): discovery via
+name_resolve, request/reply round trip with hook payloads, non-blocking
+NoMessage, reply attribution."""
+
+import pytest
+
+from areal_tpu.base import constants, name_resolve
+from areal_tpu.system.request_reply_stream import (
+    MasterRequestReplyStream,
+    NoMessage,
+    Payload,
+    WorkerRequestReplyStream,
+)
+
+EXPR, TRIAL = "rrstest", "t0"
+
+
+@pytest.fixture
+def streams():
+    name_resolve.reconfigure("memory")
+    constants.set_experiment_trial_names(EXPR, TRIAL)
+    master = MasterRequestReplyStream(EXPR, TRIAL)
+    w0 = WorkerRequestReplyStream(EXPR, TRIAL, "w0")
+    w1 = WorkerRequestReplyStream(EXPR, TRIAL, "w1")
+    master.connect(["w0", "w1"], timeout=10)
+    yield master, w0, w1
+    master.close()
+    w0.close()
+    w1.close()
+
+
+def test_request_reply_roundtrip(streams):
+    master, w0, w1 = streams
+    rid = master.post(
+        Payload(
+            handler="w0",
+            handle_name="train_step",
+            data={"model_name": "actor"},
+            pre_hooks=[{"type": "data_transfer"}],
+            post_hooks=[{"type": "publish_weights"}],
+        )
+    )
+    req = w0.poll_request(block=True, timeout=10)
+    assert req.request_id == rid
+    assert req.handle_name == "train_step"
+    assert req.pre_hooks == [{"type": "data_transfer"}]
+    w0.reply(req, data={"loss": 0.5})
+
+    reply = master.poll_reply(block=True, timeout=10)
+    assert reply.request_id == rid
+    assert reply.is_reply and reply.handled_by == "w0"
+    assert reply.data == {"loss": 0.5}
+
+
+def test_routing_targets_only_the_handler(streams):
+    master, w0, w1 = streams
+    master.post(Payload(handler="w1", handle_name="fetch"))
+    req = w1.poll_request(block=True, timeout=10)
+    assert req.handle_name == "fetch"
+    with pytest.raises(NoMessage):
+        w0.poll_request(block=False)
+
+
+def test_nonblocking_poll_raises_nomessage(streams):
+    master, w0, _ = streams
+    with pytest.raises(NoMessage):
+        master.poll_reply(block=False)
+    with pytest.raises(NoMessage):
+        w0.poll_request(block=False)
+
+
+def test_interleaved_replies_from_multiple_workers(streams):
+    master, w0, w1 = streams
+    r0 = master.post(Payload(handler="w0", handle_name="a"))
+    r1 = master.post(Payload(handler="w1", handle_name="b"))
+    w1.reply(w1.poll_request(block=True, timeout=10), data="from-w1")
+    w0.reply(w0.poll_request(block=True, timeout=10), data="from-w0")
+    got = {}
+    for _ in range(2):
+        rep = master.poll_reply(block=True, timeout=10)
+        got[rep.request_id] = (rep.handled_by, rep.data)
+    assert got == {r0: ("w0", "from-w0"), r1: ("w1", "from-w1")}
