@@ -1,0 +1,208 @@
+"""Math grader fidelity tests.
+
+The agreement test drives the reference's own fixture set
+(reference: tests/reward/math_answers_sample_cases.jsonl, graded by
+reference: tests/reward/test_math_reward.py — rewards are ±5, i.e.
+(label - 0.5) * 10) and requires >=99% agreement with the reference
+parser's recorded labels.  The unit tests pin the normalization and
+equivalence corners VERDICT round 2 called out: nested fracs, \\text
+answers, intervals/tuples, matrices, percent, comma ints, mixed latex.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from areal_tpu.data.math_parser import (
+    extract_answer,
+    extract_boxed,
+    math_equal,
+    strip_answer_string,
+    verify_math_solution,
+)
+
+FIXTURE = Path("/root/reference/tests/reward/math_answers_sample_cases.jsonl")
+
+
+@pytest.mark.skipif(not FIXTURE.exists(), reason="reference fixtures absent")
+def test_agreement_with_reference_labels():
+    total = agree = 0
+    disagreements = []
+    with open(FIXTURE) as f:
+        for line in f:
+            case = json.loads(line)
+            for gen, reward in zip(case["generateds"], case["rewards"]):
+                expected = int((reward / 10) + 0.5)  # ±5 -> 1/0
+                got = int(verify_math_solution(gen, case["solutions"]))
+                total += 1
+                agree += got == expected
+                if got != expected:
+                    disagreements.append(
+                        (case["solutions"], gen[-120:], expected, got)
+                    )
+    assert total == 160
+    assert agree / total >= 0.99, (
+        f"{agree}/{total} agreement; disagreements: {disagreements[:5]}"
+    )
+
+
+class TestExtraction:
+    def test_boxed_nested_braces(self):
+        assert extract_boxed(r"so \boxed{\frac{\sqrt{2}}{2}}") == \
+            r"\frac{\sqrt{2}}{2}"
+
+    def test_boxed_last_occurrence_wins(self):
+        text = r"first \boxed{3} then finally \boxed{7}"
+        assert extract_boxed(text) == "7"
+
+    def test_answer_is_clause(self):
+        assert extract_answer("The answer is 42.", use_last_number=False) == "42"
+
+    def test_no_final_answer_scores_zero(self):
+        # rambling text with numbers but no boxed/answer-is clause
+        assert verify_math_solution("we try 3 then 4 then 5", ["\\boxed{5}"]) == 0.0
+
+    def test_minerva_style(self):
+        text = "the final answer is $17$. I hope it is correct."
+        assert extract_answer(text, use_last_number=False) == "17"
+
+
+class TestNormalization:
+    def test_nested_frac_with_inner_braces(self):
+        s = strip_answer_string(r"\dfrac{\sqrt{a+b}}{c^{2}}")
+        assert "frac" in s and "sqrt" in s
+
+    def test_bare_frac_gets_braces(self):
+        assert strip_answer_string(r"\frac12") == r"\frac{1}{2}"
+        assert strip_answer_string(r"\frac1{72}") == r"\frac{1}{72}"
+
+    def test_a_slash_b(self):
+        assert strip_answer_string("3/4") == r"\frac{3}{4}"
+
+    def test_text_unit_suffix_dropped(self):
+        assert strip_answer_string(r"42 \text{ miles}") == "42"
+
+    def test_inline_text_content_kept(self):
+        assert strip_answer_string(r"\text{east}") != ""
+
+    def test_degree_mark(self):
+        assert strip_answer_string(r"45^\circ") == "45"
+        assert strip_answer_string(r"45^{\circ}") == "45"
+
+    def test_dollar_and_percent(self):
+        assert strip_answer_string(r"\$12.50") == "12.50"
+        assert strip_answer_string(r"85\%") == "85"
+
+    def test_short_lhs_stripped(self):
+        assert strip_answer_string("x=5") == "5"
+        assert strip_answer_string("k = 7") == "7"
+
+    def test_trailing_zero_decimal(self):
+        assert strip_answer_string("3.0") == "3"
+
+    def test_word_numbers(self):
+        assert strip_answer_string("twenty-three") == "23"
+
+    def test_sqrt_bare_arg(self):
+        assert strip_answer_string(r"\sqrt2") == r"\sqrt{2}"
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize(
+        "a,b",
+        [
+            ("0.5", r"\frac{1}{2}"),
+            (r"9\sqrt{2}", r"\sqrt{162}"),
+            (r"\frac{\sqrt{2}}{2}", r"\frac{1}{\sqrt{2}}"),
+            ("1,234", "1234"),
+            ("50", "0.5"),  # percent aliasing: 50 == 0.5*100
+            ("(1,2)", "[1,2]"),
+            (r"\frac{2}{3}x", r"\frac{2x}{3}"),
+            ("2pi", r"2\pi"),
+            (r"\sqrt{n+1}", r"\sqrt{n + 1}"),
+            ("0.25", "25\\%"),
+            ("11.0", "11"),
+        ],
+    )
+    def test_equal_pairs(self, a, b):
+        assert math_equal(a, b)
+
+    @pytest.mark.parametrize(
+        "a,b",
+        [
+            ("3", "4"),
+            (r"9\sqrt{2}", r"8\sqrt{2}"),
+            (r"\frac{1}{3}", r"\frac{1}{2}"),
+            ("(1,2)", "(2,1)"),
+            ("x+1", "x+2"),
+            ("", "5"),
+        ],
+    )
+    def test_unequal_pairs(self, a, b):
+        assert not math_equal(a, b)
+
+    def test_interval_elementwise(self):
+        assert math_equal(r"(0, \frac{1}{2})", "(0, 0.5)")
+        assert not math_equal(r"(0, \frac{1}{2}]", "(0, 0.6)")
+
+    def test_matrix_elementwise(self):
+        a = r"\begin{pmatrix}1 & 2\\3 & 4\end{pmatrix}"
+        b = r"\begin{bmatrix}1 & 2\\3 & 4\end{bmatrix}"
+        assert math_equal(a, strip_answer_string(b))
+        c = r"\begin{pmatrix}1 & 2\\3 & 5\end{pmatrix}"
+        assert not math_equal(a, c)
+
+    def test_equation_rearranged(self):
+        assert math_equal("2x + 3 = 7", "2x = 4")
+
+    def test_choice_letter(self):
+        assert math_equal("The correct option is (C)", "C")
+
+    def test_subscripted_symbols(self):
+        assert math_equal(r"\frac{4 S_{\triangle} R}{3}",
+                          r"\frac{4}{3} S_{\triangle} R")
+        assert not math_equal(r"\frac{4 S_{\triangle} R}{3}",
+                              r"\frac{4 S_{\square} R}{3}")
+
+
+class TestVerify:
+    def test_any_solution_matches(self):
+        assert verify_math_solution(
+            r"thus \boxed{\frac{1}{2}}", ["\\boxed{0.5}", "\\boxed{7}"]
+        ) == 1.0
+
+    def test_string_solution_accepted(self):
+        assert verify_math_solution(r"\boxed{4}", "\\boxed{4}") == 1.0
+
+    def test_adversarial_input_no_hang(self):
+        # pathological pseudo-latex must grade 0 quickly, not hang
+        evil = "\\boxed{" + "(" * 200 + "x" + ")" * 200 + "^" * 50 + "}"
+        assert verify_math_solution(evil, ["\\boxed{1}"]) in (0.0, 1.0)
+
+
+class TestUnitStrippingSafety:
+    """Unit words must only strip when anchored to a number — algebraic
+    answers using m/g/in as SYMBOLS must survive (code-review r3 finding)."""
+
+    def test_variable_m_not_eaten(self):
+        assert strip_answer_string("m/2") == "m/2"
+        assert strip_answer_string(r"\frac{m}{2}") == r"\frac{m}{2}"
+        assert verify_math_solution(
+            r"so \boxed{m/2}", [r"\boxed{\frac{m}{2}}"]
+        ) == 1.0
+
+    def test_function_g_not_eaten(self):
+        assert "g" in strip_answer_string("g(x)+1")
+
+    def test_number_anchored_units_still_strip(self):
+        assert strip_answer_string("42 miles") == "42"
+        assert strip_answer_string("3.5 kg") == "3.5"
+        assert strip_answer_string("7 dollars") == "7"
+
+    def test_embedded_equals_not_mangled(self):
+        # "2x=4" must NOT lose its 'x=' (prefix-only removal); the short-lhs
+        # rule and the equation branch handle it correctly instead
+        assert strip_answer_string("2x=4") != "24"
+        assert verify_math_solution(r"\boxed{2x=4}", [r"\boxed{4}"]) == 1.0
+        assert strip_answer_string("x=5") == "5"
