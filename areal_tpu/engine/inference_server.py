@@ -64,6 +64,17 @@ class _Row:
     version_start: int
     no_eos: bool = False
     cur_token: int = -1  # pending token (KV not yet in cache)
+    budget_left: int = 0  # host-side view of remaining new-token budget
+    # a PARKED row finished a chunk without EOS and keeps its KV resident so
+    # the sticky-routed continuation resumes decoding instead of re-prefilling
+    # the whole prefix (the radix-cache role of the reference's SGLang server,
+    # reference: patch/sglang/v0.4.6.post2.patch +
+    # realhf/impl/model/backend/sglang.py:369).  The parking clock counts
+    # engine STEPS, not wall time: multi-host SPMD serving replays the same
+    # command stream on every controller, and step counts agree where
+    # wall-clocks never would (eviction must be deterministic).
+    parked: bool = False
+    park_step: int = 0
 
 
 @partial(jax.jit, static_argnames=("cfg", "sampling"), donate_argnums=(2,))
@@ -71,30 +82,34 @@ def _admit_rows(
     params,
     cfg: TransformerConfig,
     cache: KVCache,
-    tokens: jax.Array,  # [n, T] right-padded prompts
-    lengths: jax.Array,  # [n]
+    tokens: jax.Array,  # [m, T] right-padded UNIQUE prompts
+    lengths: jax.Array,  # [m]
     rows: jax.Array,  # [n] target cache rows; >= B entries are dropped
+    src: jax.Array,  # [n] which unique prompt each target row copies
     rng: jax.Array,
     sampling: SamplingParams,
 ) -> Tuple[KVCache, jax.Array, jax.Array]:
-    """Batched prefill: fill ``rows`` of the (donated) cache with up to ``n``
-    prompts in ONE device call and sample each row's first token.
-
-    Replaces the round-1 one-request-at-a-time admission that copied the
-    full cache per request (reference analogue: SGLang's batched prefill
-    admission, realhf/impl/model/backend/sglang.py:369)."""
-    n, T = tokens.shape
-    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (n, 1))
+    """Batched prefill: run ``m`` unique prompts through the model ONCE and
+    scatter each prompt's KV into every target row that shares it (``src``
+    maps target row -> unique prompt).  A group of ``n`` samples over one
+    prompt therefore pays ONE prefill, not ``n`` (the prompt-KV sharing the
+    reference gets from SGLang's radix cache,
+    reference: realhf/impl/model/backend/sglang.py:369); each target row
+    still samples its own independent first token."""
+    m, T = tokens.shape
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (m, 1))
     seg = (positions < lengths[:, None]).astype(jnp.int32)
-    mini = KVCache.zeros(cfg, n, T, dtype=cache.k.dtype)
+    mini = KVCache.zeros(cfg, m, T, dtype=cache.k.dtype)
     logits, mini = prefill(params, cfg, tokens, positions, seg, mini)
-    k = cache.k.at[:, rows, :, :T].set(mini.k, mode="drop")
-    v = cache.v.at[:, rows, :, :T].set(mini.v, mode="drop")
-    new_lengths = cache.lengths.at[rows].set(lengths, mode="drop")
+    k = cache.k.at[:, rows, :, :T].set(mini.k[:, src], mode="drop")
+    v = cache.v.at[:, rows, :, :T].set(mini.v[:, src], mode="drop")
+    new_lengths = cache.lengths.at[rows].set(lengths[src], mode="drop")
     last = jnp.take_along_axis(
         logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
     )[:, 0]
-    tok, logp = sample_logits(last.astype(jnp.float32), rng, sampling)
+    tok, logp = sample_logits(
+        last[src].astype(jnp.float32), rng, sampling
+    )
     return KVCache(k=k, v=v, lengths=new_lengths), tok, logp
 
 
@@ -259,6 +274,14 @@ class ContinuousBatchingEngine:
         self._new_params = None
         self._paused = threading.Event()
         self.gen_tokens_total = 0
+        self.prefill_tokens_total = 0  # unique-prompt tokens actually run
+        self.prefill_calls = 0
+        self.resumed_total = 0  # continuations resumed with zero prefill
+        self.park_ttl_steps = 512  # engine steps a parked row may idle
+        self._step_seq = 0  # deterministic clock (one tick per step())
+        # the dispatched-but-unharvested decode chunk (pipelined stepping):
+        # (out_t, out_l, emitted, active, cur, snapshot_row_ids)
+        self._pending_chunk = None
 
     # -- client API (any thread) -------------------------------------------
 
@@ -288,13 +311,25 @@ class ContinuousBatchingEngine:
                 return self._results.pop(qid)
         return None
 
+    def drain_results(self) -> Dict[str, model_api.APIGenerateOutput]:
+        """Pop every finished result (SPMD follower controllers discard
+        theirs — the leader owns client replies)."""
+        with self._lock:
+            out = dict(self._results)
+            self._results.clear()
+            for qid in out:
+                self._result_events.pop(qid, None)
+        return out
+
     def update_weights(self, params, version: Optional[int] = None) -> int:
         """Swap weights between chunks; in-flight rows' KV is recomputed under
         the new weights on the next loop iteration.  Returns the number of
         interrupted (in-flight) requests — the patch's return contract."""
         with self._lock:
             self._new_params = params
-            n_inflight = sum(r is not None for r in self.rows)
+            n_inflight = sum(
+                r is not None and not r.parked for r in self.rows
+            )
             if version is not None:
                 self._target_version = version
         return n_inflight
@@ -307,7 +342,12 @@ class ContinuousBatchingEngine:
 
     @property
     def n_inflight(self) -> int:
-        return sum(r is not None for r in self.rows)
+        """Actively decoding rows (parked rows are idle KV residents)."""
+        return sum(r is not None and not r.parked for r in self.rows)
+
+    @property
+    def n_parked(self) -> int:
+        return sum(r is not None and r.parked for r in self.rows)
 
     @property
     def n_pending(self) -> int:
@@ -315,12 +355,24 @@ class ContinuousBatchingEngine:
 
     @property
     def has_work(self) -> bool:
-        # host-side bookkeeping only — no device fetch
-        return self.n_pending > 0 or any(r is not None for r in self.rows)
+        # host-side bookkeeping only — no device fetch; parked rows are
+        # idle and do not keep the loop hot
+        return (
+            self.n_pending > 0
+            or self.n_inflight > 0
+            or self._pending_chunk is not None
+        )
 
     # -- engine loop (owner thread) ----------------------------------------
 
     def _apply_pending_weights(self):
+        with self._lock:
+            if self._new_params is None:
+                return
+        # the host row state must be exact before re-prefilling in-flight
+        # rows: drain the pipelined chunk first
+        self._harvest(self._pending_chunk)
+        self._pending_chunk = None
         with self._lock:
             new_params = self._new_params
             self._new_params = None
@@ -332,6 +384,17 @@ class ContinuousBatchingEngine:
             new_params = jax.device_put(new_params, self.device)
         self.params = new_params
         self.version = getattr(self, "_target_version", self.version + 1)
+        # parked rows hold KV computed under the OLD weights; resuming over
+        # it would mix weight versions in attention.  Evict them — their
+        # continuation re-prefills under the new weights, which is exactly
+        # the reference's refresh-after-update semantics.
+        n_evicted = 0
+        for row_id, row in enumerate(self.rows):
+            if row is not None and row.parked:
+                self.rows[row_id] = None
+                n_evicted += 1
+        if n_evicted:
+            logger.info("weight update evicted %d parked rows", n_evicted)
         # recompute in-flight KV under the new weights (pause -> reload ->
         # resume; reference patch interrupts and re-prefills continuations).
         # The pending cur_token (last generated) must stay OUT of the cache —
@@ -358,17 +421,33 @@ class ContinuousBatchingEngine:
 
     def _prefill_rows(self, entries: List[Tuple[int, List[int]]]):
         """Batched prefill of ``(row_id, token_seq)`` entries; returns the
-        per-entry sampled next token and its logprob (np arrays)."""
+        per-entry sampled next token and its logprob (np arrays).
+
+        Entries sharing an identical token sequence (a sampling group's n
+        copies of one prompt) are deduplicated: the model runs each unique
+        sequence once and the KV is scattered to every target row."""
         n = len(entries)
-        n_pad = 1 << (n - 1).bit_length()  # row-count bucket: fewer recompiles
+        uniq: Dict[Tuple[int, ...], int] = {}
+        src_idx = []
+        for _, seq in entries:
+            key = tuple(seq)
+            if key not in uniq:
+                uniq[key] = len(uniq)
+            src_idx.append(uniq[key])
+        m = len(uniq)
+        m_pad = 1 << (m - 1).bit_length()  # bucket: fewer recompiles
+        n_pad = 1 << (n - 1).bit_length()
         T = bucket_len(max(max(len(seq) for _, seq in entries), 1))
-        toks = np.zeros((n_pad, T), np.int32)
-        lens = np.ones((n_pad,), np.int32)
+        toks = np.zeros((m_pad, T), np.int32)
+        lens = np.ones((m_pad,), np.int32)
+        for key, i in uniq.items():
+            toks[i, : len(key)] = key
+            lens[i] = len(key)
         rows = np.full((n_pad,), self.max_batch, np.int32)  # OOB -> dropped
-        for i, (rid, seq) in enumerate(entries):
-            toks[i, : len(seq)] = seq
-            lens[i] = len(seq)
+        src = np.zeros((n_pad,), np.int32)
+        for i, (rid, _) in enumerate(entries):
             rows[i] = rid
+            src[i] = src_idx[i]
         self.rng, sub = jax.random.split(self.rng)
         self.cache, tok, logp = _admit_rows(
             self.params,
@@ -377,19 +456,99 @@ class ContinuousBatchingEngine:
             jnp.asarray(toks),
             jnp.asarray(lens),
             jnp.asarray(rows),
+            jnp.asarray(src),
             sub,
             self.sampling,
         )
+        self.prefill_calls += 1
+        self.prefill_tokens_total += int(lens[:m].sum())
         return np.asarray(tok)[:n], np.asarray(logp)[:n]
 
+    def _try_resume(self, req: model_api.APIGenerateInput) -> bool:
+        """Resume a parked row whose resident KV matches this continuation:
+        same qid AND identical token prefix (token-exact, so a client that
+        edited the context falls through to a fresh prefill)."""
+        prompt = list(req.input_ids or req.prompt_ids)
+        for row_id, row in enumerate(self.rows):
+            if (
+                row is None
+                or not row.parked
+                or row.req.qid != req.qid
+                or row.prompt + row.generated != prompt
+            ):
+                continue
+            if len(prompt) + 1 >= self.kv_cache_len:
+                # no room to continue: report empty so the client stops
+                self.rows[row_id] = None
+                done = _Row(
+                    req=req, prompt=prompt, generated=[], logprobs=[],
+                    version_start=self.version, no_eos=True,
+                )
+                self._finish(-1, done, started=False)
+                return True
+            max_new = req.gconfig.max_new_tokens
+            if len(prompt) + max_new > self.kv_cache_len:
+                max_new = max(1, self.kv_cache_len - len(prompt))
+            # cache already holds KV for prompt[:-1]; prompt[-1] is the
+            # pending cur_token, so decoding picks up exactly where the
+            # previous chunk stopped — zero prefill FLOPs.
+            row.req = req
+            row.prompt = prompt
+            row.generated = []
+            row.logprobs = []
+            row.version_start = self.version
+            row.no_eos = False
+            row.parked = False
+            row.budget_left = max_new
+            rid = np.array([row_id], np.int32)
+            self.cur_tokens = self.cur_tokens.at[rid].set(row.cur_token)
+            self.active = self.active.at[rid].set(True)
+            self.budgets = self.budgets.at[rid].set(max_new)
+            self.resumed_total += 1
+            return True
+        return False
+
+    def _evict_parked(self, keep_qids=()) -> Optional[int]:
+        """Free the longest-parked row (its continuation will re-prefill).
+        Oldest-by-(park_step, row_id): fully deterministic under SPMD."""
+        oldest, oldest_id = None, None
+        for row_id, row in enumerate(self.rows):
+            if row is not None and row.parked and row.req.qid not in keep_qids:
+                if oldest is None or row.park_step < oldest:
+                    oldest, oldest_id = row.park_step, row_id
+        if oldest_id is not None:
+            self.rows[oldest_id] = None
+        return oldest_id
+
     def _admit(self):
+        # expired parked rows first: a row parked past the TTL is likely
+        # abandoned (rollout dropped, or the group finished elsewhere)
+        for row_id, row in enumerate(self.rows):
+            if row is not None and row.parked and (
+                self._step_seq - row.park_step > self.park_ttl_steps
+            ):
+                self.rows[row_id] = None
         free = [i for i, r in enumerate(self.rows) if r is None]
         to_admit: List[Tuple[int, model_api.APIGenerateInput, List[int], int]] = []
-        while free:
+        while True:
             with self._lock:
                 if not self._pending:
                     break
                 req = self._pending.pop(0)
+            if self._try_resume(req):
+                continue
+            if not free:
+                # make room by evicting a parked row — but never one whose
+                # own continuation is already queued (evicting it would
+                # trade this request's prefill for that one's)
+                with self._lock:
+                    queued_qids = {r.qid for r in self._pending}
+                evicted = self._evict_parked(keep_qids=queued_qids)
+                if evicted is None:
+                    with self._lock:
+                        self._pending.insert(0, req)
+                    break
+                free.append(evicted)
             # input_ids = prompt + previously generated tokens (chunked
             # continuation); falls back to the bare prompt
             prompt = list(req.input_ids or req.prompt_ids)
@@ -431,6 +590,7 @@ class ContinuousBatchingEngine:
                 self._finish(row_id, row, started=False)
                 continue
             row.cur_token = tok_i
+            row.budget_left = max_new - 1
             self.rows[row_id] = row
             started_ids.append(row_id)
             started_curs.append(tok_i)
@@ -445,15 +605,24 @@ class ContinuousBatchingEngine:
                 np.array(started_budgets, np.int32)
             )
 
-    def _finish(self, row_id: int, row: _Row, started: bool = True):
+    def _finish(
+        self, row_id: int, row: _Row, started: bool = True, park: bool = False
+    ):
         out = model_api.APIGenerateOutput.from_input(row.req)
-        out.output_ids = row.generated
-        out.output_logprobs = row.logprobs
+        out.output_ids = list(row.generated)
+        out.output_logprobs = list(row.logprobs)
         out.no_eos = row.no_eos
         out.version_start = row.version_start
         out.version_end = self.version
         self.gen_tokens_total += len(row.generated)
-        if started:
+        if started and park:
+            # keep KV resident; the last generated token is the pending
+            # cur_token (its KV was never written — see decode_chunk)
+            row.parked = True
+            row.park_step = self._step_seq
+            row.cur_token = row.generated[-1]
+            self.active = self.active.at[row_id].set(False)
+        elif started:
             self.rows[row_id] = None
             self.active = self.active.at[row_id].set(False)
         with self._lock:
@@ -462,35 +631,34 @@ class ContinuousBatchingEngine:
         if ev:
             ev.set()
 
-    def _attn_bucket(self) -> int:
+    def _attn_bucket(self, extra: int = 0) -> int:
         """Static attention prefix for the next chunk, as a power-of-two
         bucket of the longest CACHED row (few recompiles, halved-or-better
         KV streaming early in generation).  In-chunk tokens never need it
         larger: their KV lives in the decode window, cache attention reads
         only the frozen base_lens prefix, and the end-of-chunk scatter
-        targets the full unsliced cache."""
+        targets the full unsliced cache.  ``extra`` covers lengths the host
+        has not harvested yet (one chunk_size per in-flight pipelined
+        chunk)."""
         longest = 0
         for row in self.rows:
-            if row is not None:
+            if row is not None and not row.parked:
                 longest = max(
                     longest, len(row.prompt) + len(row.generated) + 1
                 )
-        need = min(longest, self.kv_cache_len)
+        need = min(longest + extra, self.kv_cache_len)
         p = 256
         while p < need:
             p <<= 1
         return min(p, self.kv_cache_len)
 
-    def step(self) -> int:
-        """One engine iteration: weight swap (if requested), admit, one decode
-        chunk, harvest.  Returns number of tokens emitted this step."""
-        if self._paused.is_set():
-            time.sleep(0.01)
-            return 0
-        self._apply_pending_weights()
-        self._admit()
-        if not any(r is not None for r in self.rows):
-            return 0
+    def _dispatch_chunk(self, extra_len: int):
+        """Enqueue one decode chunk on the device (async) and record its
+        output futures + the in-flight row snapshot for a later harvest."""
+        snapshot = [
+            i for i, r in enumerate(self.rows)
+            if r is not None and not r.parked
+        ]
         self.rng, sub = jax.random.split(self.rng)
         (
             self.cache,
@@ -512,27 +680,95 @@ class ContinuousBatchingEngine:
             self.chunk_size,
             self.stop_tokens,
             self.sampling,
-            attn_len=self._attn_bucket(),
+            attn_len=self._attn_bucket(extra=extra_len),
         )
+        self._pending_chunk = (
+            out_t, out_l, emitted, self.active, self.cur_tokens, snapshot
+        )
+
+    def _harvest(self, pending) -> int:
+        """Fetch one dispatched chunk's outputs and fold them into the host
+        rows.  Only the rows in the dispatch-time snapshot are touched —
+        rows admitted after the dispatch emitted nothing in this chunk."""
+        if pending is None:
+            return 0
+        out_t, out_l, emitted, active_dev, cur_dev, snapshot = pending
         # ONE batched host fetch per chunk (separate np.asarray calls each
-        # paid a full tunnel/PCIe round-trip)
-        out_t, out_l, emitted, active, cur = jax.device_get(
-            (out_t, out_l, emitted, self.active, self.cur_tokens)
+        # paid a full tunnel/PCIe round-trip).  Multi-host meshes: the
+        # outputs are replicated but not fully addressable from one
+        # process — swap in the local replica first, then one device_get.
+        arrs = tuple(
+            x.addressable_data(0)
+            if isinstance(x, jax.Array) and not x.is_fully_addressable
+            else x
+            for x in (out_t, out_l, emitted, active_dev, cur_dev)
         )
+        out_t, out_l, emitted, active, cur = jax.device_get(arrs)
         n_tokens = 0
-        for row_id, row in enumerate(self.rows):
-            if row is None:
+        for row_id in snapshot:
+            row = self.rows[row_id]
+            if row is None or row.parked:
                 continue
             cols = emitted[row_id]
             toks = out_t[row_id][cols].tolist()
             lps = out_l[row_id][cols].tolist()
             row.generated.extend(toks)
             row.logprobs.extend(lps)
+            row.budget_left -= len(toks)
             n_tokens += len(toks)
             if not active[row_id]:
                 last = row.generated[-1] if row.generated else -1
                 row.no_eos = last not in self.stop_tokens
-                self._finish(row_id, row)
+                # budget-exhausted rows with cache headroom stay resident so
+                # the chunked continuation resumes without re-prefill
+                park = (
+                    row.no_eos
+                    and len(row.prompt) + len(row.generated) + 1
+                    < self.kv_cache_len
+                )
+                self._finish(row_id, row, park=park)
             else:
                 row.cur_token = int(cur[row_id])
         return n_tokens
+
+    def _worth_dispatching(self, prev) -> bool:
+        """Skip a dispatch that could only decode rows the un-harvested
+        chunk ``prev`` is certain to finish (budget exhaustion is
+        deterministic; EOS is not, so an occasional wasted tail chunk
+        remains)."""
+        prev_rows = set(prev[5]) if prev is not None else set()
+        for row_id, row in enumerate(self.rows):
+            if row is None or row.parked:
+                continue
+            if prev is None or row.budget_left > self.chunk_size:
+                return True
+            # rows admitted/resumed after the pending dispatch still have
+            # their full budget and are certainly alive
+            if row_id not in prev_rows:
+                return True
+        return False
+
+    def step(self) -> int:
+        """One engine iteration, PIPELINED: weight swap (if requested),
+        admit, dispatch chunk N+1, then harvest chunk N.  Dispatch-before-
+        harvest keeps the device busy while the host pays the fetch
+        round-trip (through a tunnel that round-trip can exceed the chunk's
+        own device time — measured 2.5x decode throughput on v5e).  Returns
+        the number of tokens emitted (from chunk N)."""
+        self._step_seq += 1
+        if self._paused.is_set():
+            # drain the in-flight chunk so pause means quiesced
+            n = self._harvest(self._pending_chunk)
+            self._pending_chunk = None
+            if n == 0:
+                time.sleep(0.01)
+            return n
+        self._apply_pending_weights()
+        self._admit()
+        prev = self._pending_chunk
+        self._pending_chunk = None
+        if self.n_inflight > 0 and self._worth_dispatching(prev):
+            self._dispatch_chunk(
+                extra_len=self.chunk_size if prev is not None else 0
+            )
+        return self._harvest(prev)

@@ -788,10 +788,14 @@ def decode_chunk(
     every row is shorter wastes the bandwidth the kernel lives on.  The
     caller must guarantee every row stays below ``attn_len`` through the
     whole chunk (engine buckets max in-flight length + chunk_size).
-    Sliding-window models mask beyond-window slots but still STREAM the
-    full prefix (per-row window offsets need gather/paged reads — the
-    flash-decode kernel's future window lower bound); at window << prefix
-    that is the known inefficiency of this path.
+
+    Sliding-window models with a long cache take the WINDOW-GATHER path:
+    each row's last ``window`` cache slots are gathered into a compact
+    [L, B, Hkv, Ww, hd] buffer ONCE per chunk, and every decode step streams
+    only that buffer — per-row bounded KV reads (the role flash-attn's
+    windowed kvcache path plays in the reference,
+    realhf/impl/model/modules/attn.py flash_attn_with_kvcache) instead of
+    masked full-prefix streaming.
 
     Returns (cache, out_tokens [B,W], out_logps [B,W], emitted [B,W] bool,
     cur_tokens, active, budgets, rng).
@@ -808,6 +812,29 @@ def decode_chunk(
     W = chunk_size
     L, Hkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
     base_lens = cache.lengths  # frozen: main-cache valid region per row
+
+    # window-gather dispatch: pays 2x window of copy traffic once per chunk
+    # to save (Sa - Ww) of streaming on EVERY step — wins whenever the
+    # bucketed prefix exceeds the (padded) window
+    Ww = 0
+    if cfg.sliding_window is not None:
+        Ww = -(-min(cfg.sliding_window, Sa) // 128) * 128  # round up to tile
+    use_window_gather = 0 < Ww < Sa
+    if use_window_gather:
+        # absolute cache slots gathered per row: the last Ww below base_len
+        gidx = base_lens[:, None] - Ww + jnp.arange(Ww)[None, :]  # [B,Ww]
+        gclamped = jnp.clip(gidx, 0, S - 1)
+        attn_k = jnp.take_along_axis(
+            cache.k, gclamped[None, :, None, :, None], axis=3
+        )  # [L,B,Hkv,Ww,hd]
+        attn_v = jnp.take_along_axis(
+            cache.v, gclamped[None, :, None, :, None], axis=3
+        )
+        Seff = Ww
+    else:
+        gidx = None
+        attn_k, attn_v = cache.k, cache.v
+        Seff = Sa
     mask_base = (jnp.arange(Sa)[None, :] < base_lens[:, None])  # [B,Sa]
     use_kernel = (
         _flash_decode_enabled()
@@ -836,7 +863,13 @@ def decode_chunk(
         # bound relative to the CURRENT query position (cache slot s holds
         # absolute position s). Window entries are always in range because
         # chunk_size <= sliding_window (checked above).
-        if cfg.sliding_window is not None:
+        if use_window_gather:
+            # gathered slots carry their absolute position in gidx;
+            # clamped (out-of-range) entries have gidx < 0
+            mask_main = (gidx >= 0) & (
+                gidx > positions - cfg.sliding_window
+            )  # [B,Ww]
+        elif cfg.sliding_window is not None:
             mask_main = mask_base & (
                 jnp.arange(Sa)[None, :] > positions - cfg.sliding_window
             )
@@ -845,8 +878,8 @@ def decode_chunk(
 
         def body(carry, xs):
             x, wk, wv = carry
-            lp, l, kc, vc = xs  # kc/vc [B,Hkv,S,hd]
-            if Sa < S:
+            lp, l, kc, vc = xs  # kc/vc [B,Hkv,Seff|S,hd]
+            if not use_window_gather and Sa < S:
                 # static prefix slice: fuses into the dot's HBM->VMEM read
                 # (no materialized copy), so attention streams only the
                 # slots rows can actually occupy this chunk
@@ -904,7 +937,7 @@ def decode_chunk(
                 )
                 s = jnp.concatenate([s_main, s_win], axis=-1)
                 p = jax.nn.softmax(s, axis=-1)
-                p_main, p_win = p[..., :Sa], p[..., Sa:]
+                p_main, p_win = p[..., :Seff], p[..., Seff:]
                 attn = jnp.einsum(
                     "bkrts,bksd->btkrd", p_main.astype(vc.dtype), vc
                 ) + jnp.einsum(
@@ -920,7 +953,7 @@ def decode_chunk(
         (x, wk, wv), _ = jax.lax.scan(
             body,
             (x, wk, wv),
-            (params["layers"], jnp.arange(L), cache.k, cache.v),
+            (params["layers"], jnp.arange(L), attn_k, attn_v),
         )
         logits = _head(params, cfg, x)[:, 0]
         rng, sub = jax.random.split(rng)

@@ -155,3 +155,137 @@ def test_version_stamps_without_update():
     run_until_done(eng)
     out = eng.wait_result(qid, timeout=5)
     assert out.version_start == 0 and out.version_end == 0
+
+
+def test_group_prefill_dedup():
+    """A sampling group's n requests over one prompt must pay ONE prefill
+    (unique-prompt dedup in _prefill_rows), with every member still decoded
+    independently."""
+    eng, cfg, params = make_engine(max_batch=4)
+    gconfig = GenerationHyperparameters(max_new_tokens=6, greedy=True)
+    prompt = [7, 8, 9, 10]
+    qids = [
+        eng.submit(
+            APIGenerateInput(
+                qid=f"g0-{i}", prompt_ids=prompt, input_ids=prompt,
+                gconfig=gconfig,
+            )
+        )
+        for i in range(4)
+    ]
+    run_until_done(eng)
+    outs = [eng.wait_result(q, timeout=5) for q in qids]
+    # one prefill call over one unique prompt: exactly len(prompt) tokens ran
+    assert eng.prefill_tokens_total == len(prompt)
+    # greedy members of a shared-KV group must agree token-for-token
+    for o in outs[1:]:
+        assert o.output_ids == outs[0].output_ids
+
+
+def test_chunked_continuation_resumes_without_prefill():
+    """The partial-rollout chunk pattern: a budget-exhausted row parks its
+    KV; the continuation (same qid, token-exact context) resumes decoding
+    with ZERO additional prefill and the concatenated output matches one
+    unchunked run."""
+    eng, cfg, params = make_engine(max_batch=2, chunk_size=4)
+    prompt = [11, 12, 13]
+    full = GenerationHyperparameters(max_new_tokens=12, greedy=True)
+    from areal_tpu.engine.generation import generate_tokens
+
+    ref = generate_tokens(
+        params, cfg, [prompt], full, EOS, jax.random.PRNGKey(1)
+    )[0]["output_ids"]
+
+    got = []
+    cur = list(prompt)
+    remaining = 12
+    n_chunks = 0
+    while remaining > 0:
+        qid = eng.submit(
+            APIGenerateInput(
+                qid="c0",
+                prompt_ids=prompt,
+                input_ids=cur,
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=min(4, remaining), greedy=True
+                ),
+            )
+        )
+        run_until_done(eng)
+        out = eng.wait_result(qid, timeout=5)
+        got.extend(out.output_ids)
+        cur = cur + list(out.output_ids)
+        remaining -= len(out.output_ids)
+        n_chunks += 1
+        if not out.no_eos or not out.output_ids:
+            break
+    assert got == ref
+    # first chunk prefilled the prompt; every later chunk resumed in place
+    assert eng.prefill_tokens_total == len(prompt)
+    assert eng.resumed_total == n_chunks - 1 >= 1
+
+
+def test_parked_row_evicted_for_fresh_request():
+    """With every row parked, a new request evicts the oldest parked row
+    instead of deadlocking."""
+    eng, cfg, params = make_engine(max_batch=1, chunk_size=4)
+    q1 = eng.submit(
+        APIGenerateInput(
+            qid="a", prompt_ids=[3, 4], input_ids=[3, 4],
+            gconfig=GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        )
+    )
+    run_until_done(eng)
+    out1 = eng.wait_result(q1, timeout=5)
+    assert out1.no_eos and eng.n_parked == 1
+    q2 = eng.submit(
+        APIGenerateInput(
+            qid="b", prompt_ids=[9, 10], input_ids=[9, 10],
+            gconfig=GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        )
+    )
+    run_until_done(eng)
+    out2 = eng.wait_result(q2, timeout=5)
+    assert len(out2.output_ids) >= 1
+    assert eng.n_parked == 1  # q2 is now the parked one
+
+
+def test_continuation_after_weight_update_reprefills():
+    """A weight update evicts parked KV (computed under old weights); the
+    continuation re-prefills and decodes under the NEW weights."""
+    eng, cfg, params = make_engine(max_batch=2, chunk_size=4)
+    prompt = [7, 8, 9]
+    q1 = eng.submit(
+        APIGenerateInput(
+            qid="w0", prompt_ids=prompt, input_ids=prompt,
+            gconfig=GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        )
+    )
+    run_until_done(eng)
+    out1 = eng.wait_result(q1, timeout=5)
+    assert out1.no_eos and eng.n_parked == 1
+
+    params2 = transformer.init_params(cfg, jax.random.PRNGKey(99))
+    assert eng.update_weights(params2, version=1) == 0  # parked != in-flight
+    cur = prompt + list(out1.output_ids)
+    q2 = eng.submit(
+        APIGenerateInput(
+            qid="w0", prompt_ids=prompt, input_ids=cur,
+            gconfig=GenerationHyperparameters(max_new_tokens=4, greedy=True),
+        )
+    )
+    run_until_done(eng)
+    out2 = eng.wait_result(q2, timeout=5)
+    assert eng.resumed_total == 0  # stale KV was evicted, not resumed
+    assert out2.version_start == 1
+
+    from areal_tpu.engine.generation import generate_tokens
+
+    ref = generate_tokens(
+        params2, cfg, [cur],
+        GenerationHyperparameters(
+            max_new_tokens=len(out2.output_ids), greedy=True
+        ),
+        EOS, jax.random.PRNGKey(5),
+    )[0]["output_ids"]
+    assert out2.output_ids == ref

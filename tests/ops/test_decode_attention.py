@@ -221,3 +221,69 @@ def test_decode_chunk_rejects_oversized_chunk_for_window():
                           jnp.zeros((2,), jnp.float32)),
             lambda t: jnp.zeros_like(t, bool),
         )
+
+
+def test_decode_chunk_window_gather_matches_stepwise():
+    """The window-GATHER path (long cache, bounded per-row reads: Ww < Sa)
+    must equal the step-wise decode_step reference.  Sizes chosen so the
+    padded window (128) is strictly below the attention prefix (512)."""
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config(
+        n_layers=2,
+        hidden_dim=64,
+        n_q_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        intermediate_dim=128,
+        vocab_size=64,
+        max_position_embeddings=1024,
+        dtype="float32",
+        sliding_window=100,
+    )
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    B, S, W = 3, 512, 8
+    T = 320  # prompts LONGER than the window: gather must drop old slots
+    toks = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, 64)
+    positions = jnp.tile(jnp.arange(T)[None], (B, 1))
+    prompt_lens = jnp.asarray([300, 64, 320], jnp.int32)
+    seg = (positions < prompt_lens[:, None]).astype(jnp.int32)
+
+    def fresh_cache():
+        cache = transformer.KVCache.zeros(cfg, B, S)
+        _, cache = transformer.prefill(
+            params, cfg, toks, positions, seg, cache
+        )
+        return cache
+
+    cur0 = jnp.asarray([1, 2, 3], jnp.int32)
+
+    def sample(logits, sub):
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp = jax.nn.log_softmax(logits)[jnp.arange(B), t]
+        return t, lp
+
+    out = transformer.decode_chunk(
+        params, cfg, fresh_cache(), cur0,
+        jnp.ones((B,), bool), jnp.full((B,), W, jnp.int32),
+        jax.random.PRNGKey(5), W, sample,
+        lambda t: jnp.zeros_like(t, bool), attn_len=512,
+    )
+    chunk_toks = np.asarray(out[1])
+
+    cache = fresh_cache()
+    cur = cur0
+    step_toks = []
+    for _ in range(W):
+        logits, cache = transformer.decode_step(params, cfg, cur, cache)
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        step_toks.append(np.asarray(t))
+        cur = t
+    step_toks = np.stack(step_toks, axis=1)
+    np.testing.assert_array_equal(chunk_toks, step_toks)
+
+    # post-chunk cache must also agree (scatter targets the full cache)
+    np.testing.assert_allclose(
+        np.asarray(out[0].k), np.asarray(cache.k), rtol=1e-5, atol=1e-5
+    )
