@@ -128,6 +128,15 @@ class GenServerConfig:
     # which local device hosts this server's engine (trainer/generation
     # device split on one host; None = default device)
     device_idx: Optional[int] = None
+    # multi-host serving: when num_processes > 1 this worker is one SPMD
+    # controller of a TP mesh spanning jax.distributed processes (the role
+    # of the reference's multi-node SGLang servers).  Process 0 is the
+    # leader: it owns the client-facing socket and broadcasts the command
+    # stream; followers replay it in lockstep so every controller issues
+    # identical device programs.
+    coordinator: str = ""  # jax.distributed coordinator host:port
+    num_processes: int = 1
+    process_id: int = 0
 
 
 @dataclasses.dataclass
@@ -153,10 +162,13 @@ class EvaluatorConfig:
     max_prompts: int = 64
     max_new_tokens: int = 256
     interval: float = 5.0
-    # JAX platform for the eval subprocess. Default "cpu": the training
-    # workers already own the local accelerator chips (one process per
-    # chip), so an eval job sharing the host must not touch them. Set to
-    # "tpu" only when the evaluator runs on its own host/slice.
+    # JAX platform for the eval subprocess. Default "cpu" because the
+    # in-repo launchers co-locate training workers on every local chip and
+    # an eval job sharing the host must not contend for them.  Set "" to
+    # inherit the host platform (i.e. run ON-CHIP) when the evaluator has a
+    # dedicated chip/host — the reference's dedicated eval partition
+    # (realhf/scheduler/evaluator.py:34); exercised on-chip via
+    # `python -m areal_tpu.apps.eval` directly.
     device: str = "cpu"
 
 

@@ -79,6 +79,17 @@ def gen_server(experiment_name: str, trial_name: str, server_idx) -> str:
     return f"{gen_servers(experiment_name, trial_name)}{server_idx}"
 
 
+def gen_server_spmd(
+    experiment_name: str, trial_name: str, server_idx, sub: str
+) -> str:
+    """Multi-host gen-server control keys (leader PUB address, follower
+    readiness).  Deliberately OUTSIDE the ``gen_servers/`` subtree: the
+    gserver manager discovers servers by subtree scan, and control keys
+    there would be mistaken for server addresses."""
+    root = trial_root(experiment_name, trial_name)
+    return f"{root}/gen_server_spmd/{server_idx}/{sub}"
+
+
 def gen_server_manager(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/gen_server_manager"
 

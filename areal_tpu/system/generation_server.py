@@ -41,11 +41,28 @@ class GenerationServerWorker(worker_base.Worker):
             tokenizer = dataset_api.load_hf_tokenizer(config.tokenizer_path)
         import jax
 
+        # multi-host SPMD serving: join the jax.distributed cluster first so
+        # jax.devices() below is the GLOBAL device list and the TP mesh can
+        # span hosts (the reference's multi-node SGLang server role)
+        self._n_procs = max(1, config.num_processes)
+        self._is_leader = config.process_id == 0
+        if self._n_procs > 1:
+            from areal_tpu.parallel import distributed as dist
+
+            if not config.coordinator:
+                raise ValueError(
+                    "multi-host gen server needs config.coordinator"
+                )
+            dist.initialize(
+                config.coordinator, self._n_procs, config.process_id
+            )
+
         device = mesh = None
         world = config.mesh_spec.world_size
         if world > 1:
             # tensor-parallel engine over a contiguous device span starting
-            # at device_idx (the reference's TP SGLang server role)
+            # at device_idx (single-host) or over the global device list
+            # (multi-host; every controller builds the identical mesh)
             start = config.device_idx or 0
             n = len(jax.devices())
             if start + world > n:
@@ -73,25 +90,67 @@ class GenerationServerWorker(worker_base.Worker):
         )
 
         self._ctx = zmq.Context.instance()
-        self._sock = self._ctx.socket(zmq.ROUTER)
-        port = self._sock.bind_to_random_port("tcp://*")
-        self.addr = f"{network.gethostip()}:{port}"
-        name_resolve.add(
-            names.gen_server(
-                constants.experiment_name(),
-                constants.trial_name(),
-                config.worker_name,
-            ),
-            self.addr,
-            replace=True,
+        self._sock = None
+        self._ctrl_pub = self._ctrl_sub = None
+        self._ctrl_seq = 0
+        expr, tr = constants.experiment_name(), constants.trial_name()
+        base_key = names.gen_server(expr, tr, config.worker_name)
+        # control keys live OUTSIDE the gen_servers/ subtree: the gserver
+        # manager scans that subtree for server addresses and must not see
+        # ctrl/readiness entries (code-review r3 finding)
+        ctrl_key = names.gen_server_spmd(
+            expr, tr, config.worker_name, "ctrl"
         )
-        # qid -> ROUTER identity awaiting the result
+        if self._is_leader:
+            self._sock = self._ctx.socket(zmq.ROUTER)
+            port = self._sock.bind_to_random_port("tcp://*")
+            self.addr = f"{network.gethostip()}:{port}"
+            name_resolve.add(base_key, self.addr, replace=True)
+            if self._n_procs > 1:
+                # command-stream broadcast to follower controllers
+                self._ctrl_pub = self._ctx.socket(zmq.PUB)
+                cport = self._ctrl_pub.bind_to_random_port("tcp://*")
+                name_resolve.add(
+                    ctrl_key,
+                    f"{network.gethostip()}:{cport}",
+                    replace=True,
+                )
+                # slow-joiner barrier: publish nothing until every follower
+                # has connected its SUB and said so
+                for pid in range(1, self._n_procs):
+                    name_resolve.wait(
+                        names.gen_server_spmd(
+                            expr, tr, config.worker_name, f"ready/{pid}"
+                        ),
+                        timeout=120,
+                    )
+                time.sleep(0.3)  # let late SUB handshakes settle
+        else:
+            ctrl_addr = name_resolve.wait(ctrl_key, timeout=120)
+            self._ctrl_sub = self._ctx.socket(zmq.SUB)
+            self._ctrl_sub.connect(f"tcp://{ctrl_addr}")
+            self._ctrl_sub.setsockopt(zmq.SUBSCRIBE, b"")
+            name_resolve.add(
+                names.gen_server_spmd(
+                    expr, tr, config.worker_name,
+                    f"ready/{config.process_id}",
+                ),
+                "1",
+                replace=True,
+            )
+        # qid -> ROUTER identity awaiting the result (leader only)
         self._waiting: Dict[str, bytes] = {}
+        self._update_reply_idents = []  # clients awaiting update_weights
         self._start_time = time.monotonic()
 
     # -- API ---------------------------------------------------------------
 
     def _serve_api(self):
+        """Drain client requests into an ordered command batch (leader).
+        Read-only queries are answered immediately; state-mutating commands
+        are returned for (broadcast +) lockstep application so every SPMD
+        controller sees the identical stream."""
+        batch = []
         for _ in range(64):
             try:
                 ident, _, msg = self._sock.recv_multipart(flags=zmq.NOBLOCK)
@@ -100,17 +159,18 @@ class GenerationServerWorker(worker_base.Worker):
             try:
                 cmd, payload = pickle.loads(msg)
                 if cmd == "generate":
-                    self.engine.submit(payload)
                     self._waiting[payload.qid] = ident
+                    batch.append((cmd, payload))
                     continue  # reply when the result is ready
                 elif cmd == "update_weights":
-                    n = self._update_weights(payload)
-                    resp = {"num_interrupted": n, "version": self.engine.version}
+                    self._update_reply_idents.append(ident)
+                    batch.append((cmd, payload))
+                    continue  # reply after the (lockstep) apply
                 elif cmd == "pause":
-                    self.engine.pause()
+                    batch.append((cmd, payload))
                     resp = "paused"
                 elif cmd == "resume":
-                    self.engine.resume()
+                    batch.append((cmd, payload))
                     resp = "resumed"
                 elif cmd == "metrics":
                     resp = self.metrics()
@@ -120,6 +180,33 @@ class GenerationServerWorker(worker_base.Worker):
                 self.logger.exception("api request failed")
                 resp = {"error": repr(e)}
             self._sock.send_multipart([ident, b"", pickle.dumps(resp)])
+        return batch
+
+    def _apply_commands(self, batch):
+        """Apply one command batch to the local engine (every controller
+        runs this with the identical batch, in the identical step)."""
+        for cmd, payload in batch:
+            if cmd == "generate":
+                self.engine.submit(payload)
+            elif cmd == "update_weights":
+                try:
+                    n = self._update_weights(payload)
+                    resp = {
+                        "num_interrupted": n,
+                        "version": self.engine.version,
+                    }
+                except Exception as e:  # noqa: BLE001
+                    self.logger.exception("weight update failed")
+                    resp = {"error": repr(e)}
+                if self._is_leader and self._update_reply_idents:
+                    ident = self._update_reply_idents.pop(0)
+                    self._sock.send_multipart(
+                        [ident, b"", pickle.dumps(resp)]
+                    )
+            elif cmd == "pause":
+                self.engine.pause()
+            elif cmd == "resume":
+                self.engine.resume()
 
     def _reply_finished(self):
         if not self._waiting:
@@ -161,14 +248,39 @@ class GenerationServerWorker(worker_base.Worker):
     # -- poll ---------------------------------------------------------------
 
     def _poll(self) -> worker_base.PollResult:
-        self._serve_api()
+        if self._is_leader:
+            batch = self._serve_api()
+            if self._ctrl_pub is not None:
+                # publish BEFORE applying: followers must dispatch their
+                # part of this step's device programs (TP collectives span
+                # all controllers) while the leader runs its own
+                self._ctrl_seq += 1
+                self._ctrl_pub.send(pickle.dumps((self._ctrl_seq, batch)))
+            self._apply_commands(batch)
+            n = self.engine.step()
+            self._reply_finished()
+            return worker_base.PollResult(sample_count=n)
+        # follower: lockstep replay of the leader's command stream — one
+        # engine.step() per published message, so chunk dispatches pair up
+        if not self._ctrl_sub.poll(timeout=100):
+            return worker_base.PollResult(sample_count=0)
+        seq, batch = pickle.loads(self._ctrl_sub.recv())
+        if seq != self._ctrl_seq + 1:
+            raise RuntimeError(
+                f"gen-server control stream gap: got seq {seq}, expected "
+                f"{self._ctrl_seq + 1} — SPMD controllers have diverged"
+            )
+        self._ctrl_seq = seq
+        self._apply_commands(batch)
         n = self.engine.step()
-        self._reply_finished()
+        self.engine.drain_results()  # leader owns client replies
         return worker_base.PollResult(sample_count=n)
 
     def _exit_hook(self):
-        if hasattr(self, "_sock"):
-            self._sock.close(linger=0)
+        for name in ("_sock", "_ctrl_pub", "_ctrl_sub"):
+            sock = getattr(self, name, None)
+            if sock is not None:
+                sock.close(linger=0)
 
 
 class GenServerClient:

@@ -1,0 +1,49 @@
+"""Subprocess entry for multi-host generation-server tests: one SPMD
+controller of a TP mesh spanning jax.distributed processes.
+
+Usage: python tests/helpers/run_gen_server.py CONFIG.json
+(env: AREAL_NAME_RESOLVE_ROOT, XLA_FLAGS with device count, JAX_PLATFORMS)
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with open(sys.argv[1]) as f:
+        spec = json.load(f)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from areal_tpu.api.config import ModelAbstraction
+    from areal_tpu.api.system_api import GenServerConfig
+    from areal_tpu.base import constants, name_resolve
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.system.generation_server import GenerationServerWorker
+
+    name_resolve.reconfigure(
+        "nfs", record_root=os.environ["AREAL_NAME_RESOLVE_ROOT"]
+    )
+    constants.set_experiment_trial_names(spec["expr"], spec["trial"])
+
+    cfg = GenServerConfig(
+        worker_name=spec["worker_name"],
+        model=ModelAbstraction("random", spec["model_kwargs"]),
+        mesh_spec=MeshSpec(model=spec["tp"]),
+        max_concurrent_batch=spec.get("max_batch", 2),
+        kv_cache_len=spec.get("kv_cache_len", 64),
+        chunk_size=spec.get("chunk_size", 4),
+        coordinator=spec["coordinator"],
+        num_processes=spec["num_processes"],
+        process_id=spec["process_id"],
+    )
+    worker = GenerationServerWorker()
+    worker.run(cfg)
+
+
+if __name__ == "__main__":
+    main()
