@@ -158,6 +158,22 @@ class FunctionExecutor:
             self.tracker.add_data(w, meta.ids, list(meta.keys))
             await self.buffer.put_batch([meta])
             loaded += meta.bs
+            self._bump_training_samples(meta.bs)
+
+    def _bump_training_samples(self, n: int):
+        """Advance the globally-trained sample counter the gserver manager's
+        staleness gate reads (reference: function_executor.py:185-200); the
+        master seeds it on (re)start so it survives recovery."""
+        from areal_tpu.base import constants, name_resolve, names
+
+        key = names.training_samples(
+            constants.experiment_name(), constants.trial_name()
+        )
+        try:
+            cnt = int(name_resolve.get(key))
+        except name_resolve.NameEntryNotFoundError:
+            cnt = 0
+        name_resolve.add(key, str(cnt + n), replace=True)
 
     # -- one MFC ------------------------------------------------------------
 

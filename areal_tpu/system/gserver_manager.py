@@ -95,13 +95,31 @@ class GserverManager(worker_base.Worker):
         self._server_load[addr] += 1
         return addr
 
+    def get_training_sample_cnt(self) -> int:
+        """Globally-trained sample count published by the master
+        (reference: realhf/system/gserver_manager.py:344-349).  Unlike a
+        local accepted counter this SURVIVES restarts: the master re-seeds
+        it from the recovered global_step, so the staleness gate stays
+        correct after a recover (a local counter would reset to 0 while
+        model_version stays high, silently loosening the bound)."""
+        try:
+            return int(
+                name_resolve.get(
+                    names.training_samples(self._expr, self._trial)
+                )
+            )
+        except name_resolve.NameEntryNotFoundError:
+            return 0
+
     def is_staled(self) -> bool:
         """Would a rollout started now exceed the staleness bound?
-        (reference :417-453).  Rollouts are counted in sequences
-        (``group_size`` per rollout) to match ``train_batch_size`` units."""
+        (reference: realhf/system/gserver_manager.py:417-453).  In-flight
+        rollouts are counted in sequences (``group_size`` per rollout) to
+        match ``train_batch_size`` units."""
         n_seqs = (
-            self.rollout_stat.accepted + self.rollout_stat.running
-        ) * max(1, self.config.group_size)
+            self.get_training_sample_cnt()
+            + self.rollout_stat.running * max(1, self.config.group_size)
+        )
         expected_version = n_seqs // max(1, self.config.train_batch_size)
         return (
             expected_version

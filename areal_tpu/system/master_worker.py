@@ -18,6 +18,8 @@ from areal_tpu.api.dfg import ModelInterfaceType
 from areal_tpu.base import (
     constants,
     logging_,
+    name_resolve,
+    names,
     recover,
     seeding,
     stats_tracker,
@@ -143,6 +145,23 @@ class MasterWorker(worker_base.AsyncWorker):
             self.logger.info(
                 "recovered at step %s", self._step_info
             )
+        # seed the globally-trained sample counter the staleness gate reads:
+        # fresh start -> 0, recover -> batch * completed steps, so the gate
+        # never loosens after a restart (reference: master_worker.py:148-158)
+        train_rpcs = [
+            r
+            for r in self.config.model_rpcs
+            if r.interface_type == ModelInterfaceType.TRAIN_STEP
+        ]
+        if train_rpcs:
+            hist = train_rpcs[0].n_seqs * self._step_info.global_step
+            name_resolve.add(
+                names.training_samples(
+                    constants.experiment_name(), constants.trial_name()
+                ),
+                str(hist),
+                replace=True,
+            )
         self._initialized = True
         self.logger.info(
             "master initialized: dataset_size=%d steps/epoch=%d total=%d",
@@ -186,6 +205,7 @@ class MasterWorker(worker_base.AsyncWorker):
                     "ckpt",
                     data={"model_name": mname, "path": path},
                 )
+                self._prune_recover_ckpts(mname, keep=2)
             else:
                 path = os.path.join(
                     constants.get_save_path(),
@@ -202,6 +222,28 @@ class MasterWorker(worker_base.AsyncWorker):
                     data={"model_name": mname, "path": path},
                 )
             self.logger.info("saved %s (%s) -> %s", mname, tag, path)
+
+    def _prune_recover_ckpts(self, mname: str, keep: int = 2):
+        """Drop recover checkpoints older than the newest ``keep`` — they are
+        full sharded train states (params + optimizer), so an unbounded run
+        would otherwise grow disk without limit (the publish path already
+        GCs this way; recover checkpoints must too)."""
+        import os
+        import re
+        import shutil
+
+        root = os.path.join(constants.get_recover_path(), mname)
+        try:
+            dirs = [
+                (int(m.group(1)), d)
+                for d in os.listdir(root)
+                if (m := re.fullmatch(r"globalstep(\d+)", d))
+            ]
+        except FileNotFoundError:
+            return
+        for _, d in sorted(dirs)[:-keep]:
+            shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+            self.logger.info("pruned old recover ckpt %s/%s", mname, d)
 
     def _recover_save(self):
         # _step_info counts COMPLETED steps (incremented after each step),
