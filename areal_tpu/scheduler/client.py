@@ -3,9 +3,9 @@
 Rebuild of the reference's scheduler layer (reference:
 realhf/scheduler/client.py:52 ``SchedulerClient`` ABC,
 realhf/scheduler/local/client.py:71 ``LocalSchedulerClient`` — subprocess
-spawn + wait loop; the slurm client realhf/scheduler/slurm/client.py maps to
-whatever cluster scheduler fronts the TPU pod and is out of scope for a
-single-host image, its submit/wait contract is identical).
+spawn + wait loop).  The slurm client (reference:
+realhf/scheduler/slurm/client.py) lives in areal_tpu/scheduler/slurm.py:
+sbatch array jobs with squeue/sacct polling, one process per TPU host.
 """
 
 from __future__ import annotations
@@ -216,4 +216,8 @@ def make_scheduler(
 ) -> SchedulerClient:
     if mode == "local":
         return LocalSchedulerClient(expr_name, trial_name, **kwargs)
+    if mode == "slurm":
+        from areal_tpu.scheduler.slurm import SlurmSchedulerClient
+
+        return SlurmSchedulerClient(expr_name, trial_name, **kwargs)
     raise ValueError(f"unknown scheduler mode {mode!r}")

@@ -1,0 +1,131 @@
+"""Slurm scheduler client tests against mocked sbatch/squeue/sacct/scancel
+binaries (no slurm in the image), mirroring the reference's submit/wait
+contract (reference: realhf/scheduler/slurm/client.py)."""
+
+import os
+import stat
+import subprocess
+
+import pytest
+
+from areal_tpu.scheduler.client import JobException, JobState, make_scheduler
+
+
+@pytest.fixture
+def slurm_env(tmp_path, monkeypatch):
+    """Fake slurm binaries driven by a state file the test controls."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    state_file = tmp_path / "state.txt"  # lines: <jobid> <STATE>
+    state_file.write_text("")
+    cancel_log = tmp_path / "cancelled.txt"
+
+    def script(name, body):
+        p = bindir / name
+        p.write_text("#!/bin/bash\n" + body)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+
+    script(
+        "sbatch",
+        f'echo "$1" >> {tmp_path}/submitted.txt\n'
+        'NEXT=$(( $(cat %s 2>/dev/null | wc -l) + 100 ))\n'
+        "echo \"Submitted batch job $NEXT\"\n" % (tmp_path / "submitted.txt"),
+    )
+    script(
+        "squeue",
+        # prints "<id> <STATE>" for ids still in the state file
+        f"cat {state_file}\n",
+    )
+    script(
+        "sacct",
+        # job id is $2 after -j; report what the sacct file says or COMPLETED
+        f"cat {tmp_path}/sacct.txt 2>/dev/null || echo COMPLETED\n",
+    )
+    script("scancel", f'echo "$1" >> {cancel_log}\nexit 0\n')
+
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return {
+        "state_file": state_file,
+        "cancel_log": cancel_log,
+        "sacct_file": tmp_path / "sacct.txt",
+        "script_dir": str(tmp_path / "scripts"),
+    }
+
+
+def _client(slurm_env):
+    return make_scheduler(
+        "slurm",
+        "e1",
+        "t1",
+        partition="tpu",
+        script_dir=slurm_env["script_dir"],
+    )
+
+
+def test_submit_writes_array_script_and_parses_job_id(slurm_env):
+    c = _client(slurm_env)
+    c.submit_array("worker", [["echo", "a"], ["echo", "b"], ["echo", "c"]])
+    script = open(os.path.join(slurm_env["script_dir"], "worker.sbatch")).read()
+    assert "#SBATCH --array=0-2" in script
+    assert "#SBATCH --partition=tpu" in script
+    assert "exec echo a" in script and "exec echo c" in script
+    assert c._job_ids["worker"] == "101"
+
+
+def test_wait_returns_when_job_leaves_queue_completed(slurm_env):
+    c = _client(slurm_env)
+    c.submit("worker", ["true"])
+    jid = c._job_ids["worker"]
+    # in queue: RUNNING
+    slurm_env["state_file"].write_text(f"{jid} RUNNING\n")
+    jobs = c.find_all()
+    assert jobs[0].state == JobState.RUNNING
+    # left the queue; sacct says COMPLETED
+    slurm_env["state_file"].write_text("")
+    slurm_env["sacct_file"].write_text("COMPLETED\n")
+    c.wait(timeout=5, poll_interval=0.05)
+
+
+def test_wait_raises_on_failed_job(slurm_env):
+    c = _client(slurm_env)
+    c.submit("worker", ["false"])
+    jid = c._job_ids["worker"]
+    slurm_env["state_file"].write_text(f"{jid} FAILED\n")
+    with pytest.raises(JobException) as exc:
+        c.wait(timeout=5, poll_interval=0.05)
+    assert exc.value.reason == JobState.FAILED
+
+
+def test_sacct_failure_detected_after_queue_exit(slurm_env):
+    c = _client(slurm_env)
+    c.submit("worker", ["false"])
+    slurm_env["state_file"].write_text("")  # vanished from squeue
+    slurm_env["sacct_file"].write_text("FAILED\n")
+    with pytest.raises(JobException):
+        c.wait(timeout=5, poll_interval=0.05)
+
+
+def test_stop_all_scancels(slurm_env):
+    c = _client(slurm_env)
+    c.submit("w1", ["sleep", "99"])
+    c.submit("w2", ["sleep", "99"])
+    c.stop_all()
+    cancelled = slurm_env["cancel_log"].read_text().split()
+    assert set(cancelled) == set(c._job_ids.values())
+    assert all(j.state == JobState.CANCELLED for j in c._jobs.values())
+
+
+def test_array_element_states_aggregate(slurm_env):
+    c = _client(slurm_env)
+    c.submit_array("worker", [["a"], ["b"]])
+    jid = c._job_ids["worker"]
+    # one element running, one pending -> array RUNNING
+    slurm_env["state_file"].write_text(
+        f"{jid}_0 RUNNING\n{jid}_1 PENDING\n"
+    )
+    assert c.find_all()[0].state == JobState.RUNNING
+    # any failed element fails the array
+    slurm_env["state_file"].write_text(
+        f"{jid}_0 RUNNING\n{jid}_1 FAILED\n"
+    )
+    assert c.find_all()[0].state == JobState.FAILED
