@@ -1,16 +1,25 @@
-"""Offline evaluation CLI: score a saved checkpoint on a prompt dataset.
+"""Offline evaluation CLI: score a saved checkpoint on a prompt dataset or
+a benchmark file (AIME24 / MATH-500 / AMC / GPQA-style jsonl).
 
 The in-repo eval job the automatic evaluator submits per checkpoint
 (reference: the ``evaluation/`` suite invoked by
 realhf/scheduler/evaluator.py via ``install_deps_and_eval.sh``; ours loads
 the HF-format checkpoint into the native continuous-batching engine,
-generates one answer per prompt, scores with the local verifiers, and
-writes an aggregate JSON).
+generates n answers per prompt, scores with the hardened math parser /
+local verifiers, and writes per-task pass@1/pass@k JSON).
+
+Dataset schema is auto-detected per file: training-style
+({query_id, prompt, solutions}) loads through the math_code dataset
+validator; benchmark-style ({problem|question, answer}, reference:
+evaluation/data/*/test.jsonl) normalizes through
+areal_tpu/data/benchmarks.py, which appends the boxed-answer instruction
+and handles multiple-choice options.
 
 Usage::
 
     python -m areal_tpu.apps.eval --ckpt DIR --dataset D.jsonl \
-        --output OUT.json [--max-prompts N] [--max-new-tokens M]
+        --output OUT.json [--max-prompts N] [--max-new-tokens M] \
+        [--n-samples K] [--no-chat-template]
 """
 
 from __future__ import annotations
@@ -36,6 +45,24 @@ def pass_at_k(n_correct, n_samples: int, k: int) -> float:
     return sum(vals) / max(1, len(vals))
 
 
+def load_eval_dataset(dataset_path: str):
+    """(id2info, style) from either a training-style or benchmark-style
+    jsonl (schema sniffed from the first record).  ``style`` is
+    "training" or "benchmark" — benchmark prompts are bare problems that
+    want the model's chat template; training prompts are already in the
+    exact surface form the training pipeline tokenizes raw."""
+    with open(dataset_path) as f:
+        first = json.loads(next(line for line in f if line.strip()))
+    if "query_id" in first and "prompt" in first:
+        from areal_tpu.data.math_code_dataset import load_metadata
+
+        id2info, _ = load_metadata(dataset_path)
+        return id2info, "training"
+    from areal_tpu.data.benchmarks import load_benchmark
+
+    return load_benchmark(dataset_path), "benchmark"
+
+
 def evaluate_checkpoint(
     ckpt_dir: str,
     dataset_path: str,
@@ -45,6 +72,7 @@ def evaluate_checkpoint(
     max_batch: int = 16,
     n_samples: int = 1,
     temperature: float = 0.6,
+    chat_template: bool = True,
 ) -> dict:
     """``n_samples == 1``: deterministic greedy accuracy.  ``n_samples > 1``:
     temperature sampling with the unbiased pass@k estimator
@@ -56,7 +84,6 @@ def evaluate_checkpoint(
         APIGenerateInput,
         GenerationHyperparameters,
     )
-    from areal_tpu.data.math_code_dataset import load_metadata
     from areal_tpu.engine.inference_server import ContinuousBatchingEngine
     from areal_tpu.models.hf.registry import load_hf_model
     from areal_tpu.verifiers.dispatch import verify_batch
@@ -79,15 +106,29 @@ def evaluate_checkpoint(
         sampling=SamplingParams(greedy=greedy, temperature=temperature),
     )
 
-    id2info, task_cnt = load_metadata(dataset_path)
+    id2info, style = load_eval_dataset(dataset_path)
     items = list(id2info.values())[:max_prompts]
     gcfg = GenerationHyperparameters(
         max_new_tokens=max_new_tokens, greedy=greedy, temperature=temperature
     )
+    # chat template only for benchmark-style bare problems: training-style
+    # prompts already carry their exact surface form (the training pipeline
+    # tokenizes them raw), and double-wrapping would skew scores
+    use_chat = (
+        chat_template
+        and style == "benchmark"
+        and getattr(tokenizer, "chat_template", None)
+    )
     t0 = time.time()
     qids = []  # submit order = aggregation order, single-source format
     for d in items:
-        ids = tokenizer(d["prompt"])["input_ids"]
+        if use_chat:
+            ids = tokenizer.apply_chat_template(
+                [{"role": "user", "content": d["prompt"]}],
+                add_generation_prompt=True,
+            )
+        else:
+            ids = tokenizer(d["prompt"])["input_ids"]
         for s in range(n_samples):
             qid = f"{d['query_id']}#{s}"
             qids.append(qid)
@@ -159,6 +200,11 @@ def main(argv=None) -> int:
     p.add_argument("--kv-cache-len", type=int, default=2048)
     p.add_argument("--n-samples", type=int, default=1)
     p.add_argument("--temperature", type=float, default=0.6)
+    p.add_argument(
+        "--no-chat-template",
+        action="store_true",
+        help="tokenize prompts raw even when the tokenizer has a chat template",
+    )
     args = p.parse_args(argv)
     result = evaluate_checkpoint(
         args.ckpt,
@@ -168,6 +214,7 @@ def main(argv=None) -> int:
         kv_cache_len=args.kv_cache_len,
         n_samples=args.n_samples,
         temperature=args.temperature,
+        chat_template=not args.no_chat_template,
     )
     os.makedirs(os.path.dirname(os.path.abspath(args.output)), exist_ok=True)
     tmp = args.output + ".tmp"

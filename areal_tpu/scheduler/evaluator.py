@@ -206,8 +206,9 @@ def make_evaluator(cfg) -> Optional[AutomaticEvaluator]:
     """Build the checkpoint-watching evaluator for an ExperimentConfig
     (None when the experiment configures none).  Shared by the process
     launcher's monitor loop and the threaded local runner; the eval
-    subprocess runs on the configured JAX platform (cpu by default — the
-    training workers own the local chips)."""
+    subprocess runs on ``EvaluatorConfig.device`` ("cpu" by default —
+    training workers own every local chip), or inherits the host platform
+    when set to "" (dedicated eval chip/host)."""
     if getattr(cfg, "evaluator", None) is None:
         return None
     from areal_tpu.base import constants
@@ -225,7 +226,11 @@ def make_evaluator(cfg) -> Optional[AutomaticEvaluator]:
         ),
         max_prompts=ecfg.max_prompts,
         max_new_tokens=ecfg.max_new_tokens,
-        env={**os.environ, "JAX_PLATFORMS": ecfg.device},
+        env=(
+            {**os.environ, "JAX_PLATFORMS": ecfg.device}
+            if ecfg.device
+            else dict(os.environ)  # inherit: evals run on-chip by default
+        ),
     )
 
 
