@@ -37,13 +37,28 @@ JOB_WALL_TIMEOUT = 200
 
 
 def _run_sandbox(job: Dict, wall_timeout: float) -> Dict:
-    """One sandbox subprocess; hard process-group kill on timeout."""
+    """One sandbox subprocess; hard process-group kill on timeout.
+
+    The child gets a scrubbed environment (no worker env vars / credentials),
+    a throwaway scratch directory as cwd+HOME+TMPDIR (relative-path writes
+    land there and are deleted), its own session for group kill, and rlimits
+    applied inside sandbox_runner before user code runs.  See the
+    sandbox_runner module docstring for the honest trust model."""
     tmp = tempfile.gettempdir()
     tag = uuid.uuid4().hex
     in_path = os.path.join(tmp, f"areal-code-{tag}-in.json")
     out_path = os.path.join(tmp, f"areal-code-{tag}-out.json")
+    scratch = tempfile.mkdtemp(prefix=f"areal-sbx-{tag}-")
     with open(in_path, "w") as f:
         json.dump(job, f)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    child_env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "PYTHONPATH": repo_root,
+        "HOME": scratch,
+        "TMPDIR": scratch,
+        "LANG": os.environ.get("LANG", "C.UTF-8"),
+    }
     proc = subprocess.Popen(
         [
             sys.executable,
@@ -55,7 +70,8 @@ def _run_sandbox(job: Dict, wall_timeout: float) -> Dict:
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
         start_new_session=True,
-        cwd=os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        cwd=scratch,
+        env=child_env,
     )
     try:
         proc.wait(timeout=wall_timeout)
@@ -79,6 +95,9 @@ def _run_sandbox(job: Dict, wall_timeout: float) -> Dict:
                 os.remove(p)
             except FileNotFoundError:
                 pass
+        import shutil
+
+        shutil.rmtree(scratch, ignore_errors=True)
     return result
 
 
@@ -96,9 +115,10 @@ def _problem_jobs(
     outputs = io_spec.get("outputs", [])
     assert len(inputs) == len(outputs), problem.get("query_id")
     fn_name = io_spec.get("fn_name", "")
-    timeout = int(
-        min(100, max(1, float(problem.get("timeout", timeout_per_case))))
-    )
+    # per-problem timeout: top-level field wins, then one embedded in the
+    # input_output spec, then the caller default
+    raw_timeout = problem.get("timeout", io_spec.get("timeout", timeout_per_case))
+    timeout = int(min(100, max(1, float(raw_timeout))))
     if not inputs:
         # unit-test style: one load-and-run job
         return [

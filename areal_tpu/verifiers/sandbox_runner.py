@@ -2,8 +2,20 @@
 
 Executes one generated solution against a list of testcases inside THIS
 process — which the parent (areal_tpu/verifiers/code_verify.py) always
-spawns as a disposable, resource-limited, process-group-isolated child, so a
-malicious or runaway solution can only kill its own sandbox.
+spawns as a disposable, process-group-isolated child with a scrubbed
+environment and a throwaway scratch cwd.
+
+**Trust model (read before deploying):** the isolation here is resource
+limits (CPU/memory/procs/file-size via rlimits), a hard process-group kill,
+env-var scrubbing, and best-effort API neutering — NOT an OS security
+boundary.  There is no syscall filter, user namespace, or network
+isolation in this image (no nsjail/bubblewrap), so deliberately malicious
+code can still read world-readable files and open sockets.  This matches
+training-time use on model-generated competition code; for adversarial or
+multi-tenant inputs, route verification through the HTTP verifier service
+(areal_tpu/verifiers/service.py) on an isolated host, which is how the
+reference deploys it (reference: functioncall/ FaaS cluster,
+functioncall/code/verify.py:111).
 
 Semantics follow the reference's LiveCodeBench-derived checker
 (reference: functioncall/code/function/testing_util.py ``run_test`` — two
@@ -60,14 +72,16 @@ def apply_rlimits(cpu_seconds: int = 60, mem_bytes: int = 4 << 30):
     import resource
 
     resource.setrlimit(resource.RLIMIT_CPU, (cpu_seconds, cpu_seconds + 5))
-    try:
-        resource.setrlimit(resource.RLIMIT_AS, (mem_bytes, mem_bytes))
-    except (ValueError, OSError):
-        pass
-    try:
-        resource.setrlimit(resource.RLIMIT_NPROC, (64, 64))
-    except (ValueError, OSError):
-        pass
+    for limit, value in (
+        ("RLIMIT_AS", (mem_bytes, mem_bytes)),
+        ("RLIMIT_NPROC", (64, 64)),
+        ("RLIMIT_FSIZE", (64 << 20, 64 << 20)),  # cap runaway file writes
+        ("RLIMIT_CORE", (0, 0)),  # no core dumps from crashing solutions
+    ):
+        try:
+            resource.setrlimit(getattr(resource, limit), value)
+        except (ValueError, OSError, AttributeError):
+            pass
 
 
 def neuter_destructive_apis():

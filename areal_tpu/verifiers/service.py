@@ -149,7 +149,10 @@ class VerifierClient:
                     ValueError,
                 ) as e:
                     last_err = e
-                    time.sleep(self.backoff * (2**attempt))
+            # back off OUTSIDE the semaphore: a flaky server must not pin a
+            # concurrency slot for the whole exponential wait, throttling
+            # healthy requests
+            time.sleep(self.backoff * (2**attempt))
         logger.warning(
             "verifier requests failed after %d retries: %r; scoring 0",
             self.retries,
