@@ -75,6 +75,11 @@ class _Row:
     # wall-clocks never would (eviction must be deterministic).
     parked: bool = False
     park_step: int = 0
+    # monotone stamp, bumped on every admit AND resume: a pipelined chunk's
+    # harvest must only touch the occupant the dispatch snapshotted — a row
+    # freed-and-reused between dispatch and harvest (park->resume, or
+    # finish->new admission) carries a different epoch and is skipped
+    epoch: int = 0
 
 
 @partial(jax.jit, static_argnames=("cfg", "sampling"), donate_argnums=(2,))
@@ -100,13 +105,16 @@ def _admit_rows(
     positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (m, 1))
     seg = (positions < lengths[:, None]).astype(jnp.int32)
     mini = KVCache.zeros(cfg, m, T, dtype=cache.k.dtype)
-    logits, mini = prefill(params, cfg, tokens, positions, seg, mini)
+    # last_pos: only each prompt's final logits are computed — full [m,T,V]
+    # logits at a 152k vocab would be multiple GB of HBM
+    logits, mini = prefill(
+        params, cfg, tokens, positions, seg, mini,
+        last_pos=jnp.maximum(lengths - 1, 0),
+    )
     k = cache.k.at[:, rows, :, :T].set(mini.k[:, src], mode="drop")
     v = cache.v.at[:, rows, :, :T].set(mini.v[:, src], mode="drop")
     new_lengths = cache.lengths.at[rows].set(lengths[src], mode="drop")
-    last = jnp.take_along_axis(
-        logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
-    )[:, 0]
+    last = logits[:, 0]  # [m, V]
     tok, logp = sample_logits(
         last[src].astype(jnp.float32), rng, sampling
     )
@@ -278,7 +286,10 @@ class ContinuousBatchingEngine:
         self.prefill_calls = 0
         self.resumed_total = 0  # continuations resumed with zero prefill
         self.park_ttl_steps = 512  # engine steps a parked row may idle
+        # True = decode only, admit nothing (drain-before-update servers)
+        self.hold_admissions = False
         self._step_seq = 0  # deterministic clock (one tick per step())
+        self._epoch_counter = 0  # admission/resume stamp source
         # the dispatched-but-unharvested decode chunk (pipelined stepping):
         # (out_t, out_l, emitted, active, cur, snapshot_row_ids)
         self._pending_chunk = None
@@ -500,6 +511,8 @@ class ContinuousBatchingEngine:
             row.no_eos = False
             row.parked = False
             row.budget_left = max_new
+            self._epoch_counter += 1
+            row.epoch = self._epoch_counter
             rid = np.array([row_id], np.int32)
             self.cur_tokens = self.cur_tokens.at[rid].set(row.cur_token)
             self.active = self.active.at[rid].set(True)
@@ -521,6 +534,8 @@ class ContinuousBatchingEngine:
         return oldest_id
 
     def _admit(self):
+        if self.hold_admissions:
+            return
         # expired parked rows first: a row parked past the TTL is likely
         # abandoned (rollout dropped, or the group finished elsewhere)
         for row_id, row in enumerate(self.rows):
@@ -591,6 +606,8 @@ class ContinuousBatchingEngine:
                 continue
             row.cur_token = tok_i
             row.budget_left = max_new - 1
+            self._epoch_counter += 1
+            row.epoch = self._epoch_counter
             self.rows[row_id] = row
             started_ids.append(row_id)
             started_curs.append(tok_i)
@@ -656,7 +673,7 @@ class ContinuousBatchingEngine:
         """Enqueue one decode chunk on the device (async) and record its
         output futures + the in-flight row snapshot for a later harvest."""
         snapshot = [
-            i for i, r in enumerate(self.rows)
+            (i, r.epoch) for i, r in enumerate(self.rows)
             if r is not None and not r.parked
         ]
         self.rng, sub = jax.random.split(self.rng)
@@ -705,9 +722,11 @@ class ContinuousBatchingEngine:
         )
         out_t, out_l, emitted, active, cur = jax.device_get(arrs)
         n_tokens = 0
-        for row_id in snapshot:
+        for row_id, epoch in snapshot:
             row = self.rows[row_id]
-            if row is None or row.parked:
+            # skip freed-and-reused slots: the dispatch-time occupant is
+            # gone and this chunk says nothing about the new one
+            if row is None or row.parked or row.epoch != epoch:
                 continue
             cols = emitted[row_id]
             toks = out_t[row_id][cols].tolist()
@@ -742,9 +761,10 @@ class ContinuousBatchingEngine:
                 continue
             if prev is None or row.budget_left > self.chunk_size:
                 return True
-            # rows admitted/resumed after the pending dispatch still have
-            # their full budget and are certainly alive
-            if row_id not in prev_rows:
+            # rows admitted/resumed after the pending dispatch (epoch not in
+            # the snapshot) still have their full budget and are certainly
+            # alive — matching the harvest's (row_id, epoch) identity
+            if (row_id, row.epoch) not in prev_rows:
                 return True
         return False
 

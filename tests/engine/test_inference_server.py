@@ -289,3 +289,50 @@ def test_continuation_after_weight_update_reprefills():
         EOS, jax.random.PRNGKey(5),
     )[0]["output_ids"]
     assert out2.output_ids == ref
+
+
+def test_resume_race_with_pipelined_harvest():
+    """A parked row resumed between a chunk's dispatch and its harvest must
+    NOT be touched by that harvest (the dispatch-time snapshot refers to the
+    previous occupancy).  Regression: this raced in the async PPO e2e and
+    crashed _finish on an empty generation (round-3 pipelining bug)."""
+    eng, cfg, params = make_engine(max_batch=2, chunk_size=4)
+    long_g = GenerationHyperparameters(max_new_tokens=40, greedy=True)
+    short_g = GenerationHyperparameters(max_new_tokens=4, greedy=True)
+    prompt_a, prompt_b = [11, 12, 13], [7, 8]
+    eng.submit(APIGenerateInput(
+        qid="b", prompt_ids=prompt_b, input_ids=prompt_b, gconfig=long_g))
+    eng.submit(APIGenerateInput(
+        qid="a", prompt_ids=prompt_a, input_ids=prompt_a, gconfig=short_g))
+
+    # drive until A's first chunk completes; the NEXT chunk (with A in its
+    # stale snapshot) is already dispatched because B keeps running
+    out_a = None
+    for _ in range(50):
+        eng.step()
+        out_a = eng.try_get_result("a")
+        if out_a is not None:
+            break
+    assert out_a is not None and out_a.no_eos
+    assert eng._pending_chunk is not None  # the stale-snapshot chunk
+
+    # resume A immediately — before the stale chunk is harvested
+    cur = prompt_a + list(out_a.output_ids)
+    eng.submit(APIGenerateInput(
+        qid="a", prompt_ids=prompt_a, input_ids=cur, gconfig=short_g))
+    run_until_done(eng, max_steps=100)
+    out_a2 = eng.wait_result("a", timeout=5)
+    assert len(out_a2.output_ids) >= 1  # continuation really decoded
+    assert eng.resumed_total >= 1
+    eng.drain_results()
+
+    # full chunked output must equal the unchunked reference
+    from areal_tpu.engine.generation import generate_tokens
+
+    ref = generate_tokens(
+        params, cfg, [prompt_a],
+        GenerationHyperparameters(max_new_tokens=8, greedy=True),
+        EOS, jax.random.PRNGKey(1),
+    )[0]["output_ids"]
+    got = list(out_a.output_ids) + list(out_a2.output_ids)
+    assert got == ref[: len(got)]
