@@ -66,6 +66,13 @@ class GserverManager(worker_base.Worker):
         self._round_robin = 0
         self._qid_server: Dict[str, str] = {}
         self._server_load: Dict[str, int] = {a: 0 for a in self.server_addrs}
+        # estimated resident tokens per server (prompt + a discounted new-
+        # token budget, reference: realhf/system/gserver_manager.py:400-405);
+        # per-qid shares so finish_rollout can release them
+        self._server_tokens: Dict[str, float] = {
+            a: 0.0 for a in self.server_addrs
+        }
+        self._qid_tokens: Dict[str, float] = {}
         self.rollout_stat = RolloutStat()
         self._model_version = 0
 
@@ -83,16 +90,40 @@ class GserverManager(worker_base.Worker):
 
     # -- scheduling / staleness --------------------------------------------
 
-    def _schedule(self, qid: str) -> str:
+    def _schedule(
+        self, qid: str, prompt_len: int = 0, new_token_budget: int = 0
+    ) -> str:
         if qid in self._qid_server:  # sticky: KV reuse on continuation
-            return self._qid_server[qid]
+            addr = self._qid_server[qid]
+            if prompt_len or new_token_budget:
+                # refresh the resident-token estimate: a chunked rollout's
+                # context grows every continuation, and keeping the first
+                # chunk's estimate would let the token-usage policy pile
+                # new work onto an actually-full server
+                est = float(prompt_len) + 0.4 * float(new_token_budget)
+                prev = self._qid_tokens.get(qid, 0.0)
+                self._qid_tokens[qid] = est
+                self._server_tokens[addr] = max(
+                    0.0, self._server_tokens[addr] - prev + est
+                )
+            return addr
         if self.config.schedule_policy == "least_requests":
             addr = min(self.server_addrs, key=lambda a: self._server_load[a])
+        elif self.config.schedule_policy == "least_token_usage":
+            # route by estimated resident tokens: prompt + 0.4x budget (the
+            # reference's expected-completion discount, gserver_manager
+            # :400-405) — a far better KV-pressure signal than request count
+            addr = min(
+                self.server_addrs, key=lambda a: self._server_tokens[a]
+            )
         else:  # round_robin
             addr = self.server_addrs[self._round_robin % len(self.server_addrs)]
             self._round_robin += 1
         self._qid_server[qid] = addr
         self._server_load[addr] += 1
+        est = float(prompt_len) + 0.4 * float(new_token_budget)
+        self._qid_tokens[qid] = est
+        self._server_tokens[addr] += est
         return addr
 
     def get_training_sample_cnt(self) -> int:
@@ -150,6 +181,9 @@ class GserverManager(worker_base.Worker):
         ]:
             srv = self._qid_server.pop(k)
             self._server_load[srv] = max(0, self._server_load[srv] - 1)
+            self._server_tokens[srv] = max(
+                0.0, self._server_tokens[srv] - self._qid_tokens.pop(k, 0.0)
+            )
 
     # -- weight updates -----------------------------------------------------
 
@@ -226,7 +260,11 @@ class GserverManager(worker_base.Worker):
             try:
                 cmd, payload = pickle.loads(msg)
                 if cmd == "schedule_request":
-                    addr = self._schedule(payload["qid"])
+                    addr = self._schedule(
+                        payload["qid"],
+                        payload.get("prompt_len", 0),
+                        payload.get("new_token_budget", 0),
+                    )
                     resp = {"url": addr, "version": self._model_version}
                 elif cmd == "allocate_rollout":
                     resp = self._allocate_rollout(payload["qid"])
@@ -245,6 +283,7 @@ class GserverManager(worker_base.Worker):
                             for k, v in self.rollout_stat.as_dict().items()
                         },
                         "server_load": dict(self._server_load),
+                        "server_tokens": dict(self._server_tokens),
                     }
                 else:
                     resp = {"error": f"unknown command {cmd}"}

@@ -52,11 +52,18 @@ class PartialRolloutManager:
         version_end = -1
         no_eos = True
         while remaining > 0:
+            chunk = min(self.new_tokens_per_chunk, remaining)
             sched = await asyncio.to_thread(
-                self.manager_client.call, "schedule_request", {"qid": qid}
+                self.manager_client.call,
+                "schedule_request",
+                {
+                    "qid": qid,
+                    # load signal for least_token_usage routing
+                    "prompt_len": len(cur),
+                    "new_token_budget": chunk,
+                },
             )
             client = self._client(sched["url"])
-            chunk = min(self.new_tokens_per_chunk, remaining)
             inp = model_api.APIGenerateInput(
                 qid=qid,
                 prompt_ids=prompt_ids,
