@@ -59,6 +59,11 @@ class TransformerConfig:
     # attention-block recompute in backward at ~200MB/layer for 32k tokens);
     # "dots" = save every matmul output (cheapest backward, most memory).
     remat_policy: str = "none"
+    # context-parallel attention over a sharded `seq` mesh axis:
+    # "ring" rotates KV blocks with n ppermutes (scales to any length);
+    # "ulysses" pays two all-to-alls and runs full attention on a head
+    # subset (fewer collectives; needs per-device q heads % cp degree == 0)
+    cp_impl: str = "ring"
 
     def __post_init__(self):
         assert self.n_q_heads % self.n_kv_heads == 0
@@ -66,6 +71,9 @@ class TransformerConfig:
         assert self.norm_type in ("rms", "layer")
         assert self.remat_policy in ("none", "qkv_attn", "dots"), (
             f"unknown remat_policy {self.remat_policy!r}"
+        )
+        assert self.cp_impl in ("ring", "ulysses"), (
+            f"unknown cp_impl {self.cp_impl!r}"
         )
 
     @property

@@ -334,13 +334,26 @@ def _attention_dispatch(
 
     mesh = _seq_parallel_mesh()
     if mesh is not None and seg_ids is not None and positions is not None:
-        from areal_tpu.ops.ring_attention import ring_attention
-
         head_axis = (
             "model"
             if cfg.n_kv_heads % mesh.shape.get("model", 1) == 0
             else None
         )
+        if cfg.cp_impl == "ulysses":
+            from areal_tpu.ops.ulysses import ulysses_attention
+
+            return ulysses_attention(
+                q,
+                k,
+                v,
+                seg_ids,
+                positions,
+                mesh=mesh,
+                head_axis=head_axis,
+                sliding_window=cfg.sliding_window,
+            )
+        from areal_tpu.ops.ring_attention import ring_attention
+
         return ring_attention(
             q,
             k,
