@@ -343,10 +343,14 @@ def main():
     train_toks_per_sec = tokens_per_step / train_dt
     mfu = train_toks_per_sec * 6 * n_params / peak_flops(dev)
 
-    # generation throughput at 0.5B, batch sweep
+    # generation throughput at 0.5B, batch sweep (tiny shapes off-TPU:
+    # a CPU smoke run needs signal, not 512-token decode waves)
     gen = {}
+    gen_shape = {} if on_tpu else {"prompt_len": 32, "max_new": 16}
     for B in gen_batches:
-        gen[f"b{B}"] = bench_generation(cfg, gen_params, n_reqs=B)
+        gen[f"b{B}"] = bench_generation(
+            cfg, gen_params, n_reqs=B, **gen_shape
+        )
 
     # interruption A/B + update-visibility latency
     interruption = (
