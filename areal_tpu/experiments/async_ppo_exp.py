@@ -47,6 +47,9 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
     n_rollout_workers: int = 1
     n_gen_servers: int = 1
     max_head_offpolicyness: int = 0
+    # round_robin | least_requests | least_token_usage (KV-pressure-aware;
+    # the continuation-refreshed estimate, gserver_manager._schedule)
+    gen_schedule_policy: str = "least_requests"
     max_concurrent_rollouts: Optional[int] = None
     new_tokens_per_chunk: int = 1 << 30
     flush_request_timeout: float = 120.0
@@ -154,7 +157,7 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
         )
         cfg.gserver_manager = GserverManagerConfig(
             n_servers=self.n_gen_servers,
-            schedule_policy="least_requests",
+            schedule_policy=self.gen_schedule_policy,
             max_head_offpolicyness=self.max_head_offpolicyness,
             train_batch_size=self.train_bs_n_seqs,
             group_size=staleness_group_size,

@@ -116,9 +116,16 @@ class GserverManager(worker_base.Worker):
             addr = min(
                 self.server_addrs, key=lambda a: self._server_tokens[a]
             )
-        else:  # round_robin
+        elif self.config.schedule_policy == "round_robin":
             addr = self.server_addrs[self._round_robin % len(self.server_addrs)]
             self._round_robin += 1
+        else:
+            # a typo'd policy silently degrading to round_robin would hide
+            # the scheduling the user asked for
+            raise ValueError(
+                f"unknown schedule_policy {self.config.schedule_policy!r}; "
+                "expected round_robin | least_requests | least_token_usage"
+            )
         self._qid_server[qid] = addr
         self._server_load[addr] += 1
         est = float(prompt_len) + 0.4 * float(new_token_budget)

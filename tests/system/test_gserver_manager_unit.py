@@ -163,3 +163,9 @@ def test_finish_releases_token_estimates():
     assert m._server_tokens[srv] == 1000 + 0.4 * 1000
     m._finish_rollout("q1", accepted=True)
     assert m._server_tokens[srv] == 0.0
+
+
+def test_unknown_policy_fails_loudly():
+    m = _manager(policy="least_tokens")  # typo'd policy
+    with pytest.raises(ValueError, match="schedule_policy"):
+        m._schedule("q1")
