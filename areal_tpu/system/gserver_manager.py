@@ -39,6 +39,14 @@ class GserverManager(worker_base.Worker):
 
         self._expr = constants.experiment_name()
         self._trial = constants.trial_name()
+        if config.schedule_policy not in (
+            "round_robin", "least_requests", "least_token_usage",
+        ):
+            # fail at startup, not as per-request errors mid-training
+            raise ValueError(
+                f"unknown schedule_policy {config.schedule_policy!r}; "
+                "expected round_robin | least_requests | least_token_usage"
+            )
 
         # discover generation servers
         self.server_addrs: List[str] = []
@@ -73,6 +81,8 @@ class GserverManager(worker_base.Worker):
             a: 0.0 for a in self.server_addrs
         }
         self._qid_tokens: Dict[str, float] = {}
+        # rollout group key -> server (group affinity for prompt-KV dedup)
+        self._group_server: Dict[str, str] = {}
         self.rollout_stat = RolloutStat()
         self._model_version = 0
 
@@ -89,6 +99,15 @@ class GserverManager(worker_base.Worker):
         self._last_version_check = 0.0
 
     # -- scheduling / staleness --------------------------------------------
+
+    @staticmethod
+    def _group_key(qid: str) -> str:
+        """Rollout-level key of a member qid: '{qid}-{i}' group members and
+        '{qid}@t{j}-{i}' multi-turn members share their rollout's key, so
+        the whole group lands on ONE server and the engine's group-prompt
+        KV dedup fires (one prefill per group instead of per member)."""
+        base = qid.rsplit("-", 1)[0] if "-" in qid else qid
+        return base.split("@", 1)[0]
 
     def _schedule(
         self, qid: str, prompt_len: int = 0, new_token_budget: int = 0
@@ -107,7 +126,14 @@ class GserverManager(worker_base.Worker):
                     0.0, self._server_tokens[addr] - prev + est
                 )
             return addr
-        if self.config.schedule_policy == "least_requests":
+        # group affinity: a sibling member of this rollout already picked a
+        # server — co-locate so the engine prefills the shared prompt ONCE
+        # and scatters the KV to all members
+        group = self._group_key(qid)
+        sibling = self._group_server.get(group)
+        if sibling is not None:
+            addr = sibling
+        elif self.config.schedule_policy == "least_requests":
             addr = min(self.server_addrs, key=lambda a: self._server_load[a])
         elif self.config.schedule_policy == "least_token_usage":
             # route by estimated resident tokens: prompt + 0.4x budget (the
@@ -116,17 +142,11 @@ class GserverManager(worker_base.Worker):
             addr = min(
                 self.server_addrs, key=lambda a: self._server_tokens[a]
             )
-        elif self.config.schedule_policy == "round_robin":
+        else:  # round_robin (policy validated at _configure)
             addr = self.server_addrs[self._round_robin % len(self.server_addrs)]
             self._round_robin += 1
-        else:
-            # a typo'd policy silently degrading to round_robin would hide
-            # the scheduling the user asked for
-            raise ValueError(
-                f"unknown schedule_policy {self.config.schedule_policy!r}; "
-                "expected round_robin | least_requests | least_token_usage"
-            )
         self._qid_server[qid] = addr
+        self._group_server[group] = addr
         self._server_load[addr] += 1
         est = float(prompt_len) + 0.4 * float(new_token_budget)
         self._qid_tokens[qid] = est
@@ -191,6 +211,7 @@ class GserverManager(worker_base.Worker):
             self._server_tokens[srv] = max(
                 0.0, self._server_tokens[srv] - self._qid_tokens.pop(k, 0.0)
             )
+        self._group_server.pop(qid, None)
 
     # -- weight updates -----------------------------------------------------
 

@@ -22,6 +22,7 @@ def _manager(policy="least_requests", **cfg_kwargs):
     m._server_load = {a: 0 for a in m.server_addrs}
     m._server_tokens = {a: 0.0 for a in m.server_addrs}
     m._qid_tokens = {}
+    m._group_server = {}
     m.rollout_stat = RolloutStat()
     m._model_version = 0
     m._expr, m._trial = "test-exp", "test-trial"
@@ -165,7 +166,41 @@ def test_finish_releases_token_estimates():
     assert m._server_tokens[srv] == 0.0
 
 
-def test_unknown_policy_fails_loudly():
-    m = _manager(policy="least_tokens")  # typo'd policy
+def test_unknown_policy_fails_loudly_at_configure():
+    """A typo'd policy must fail at worker startup, not as per-request
+    errors mid-training (validated before server discovery)."""
+    from areal_tpu.base import constants
+
+    constants.set_experiment_trial_names("polexp", "t0")
+    m = GserverManager.__new__(GserverManager)
+    m.worker_name = "gm"
     with pytest.raises(ValueError, match="schedule_policy"):
-        m._schedule("q1")
+        m._configure(
+            GserverManagerConfig(
+                worker_name="gm", schedule_policy="least_tokens", n_servers=1
+            )
+        )
+
+
+def test_group_members_colocate_for_prompt_kv_dedup():
+    """All '{qid}-{i}' members of one rollout route to ONE server (the
+    engine prefills the shared prompt once and scatters the KV); distinct
+    rollouts still spread."""
+    m = _manager(policy="round_robin")
+    servers = {m._schedule(f"r1-{i}") for i in range(8)}
+    assert len(servers) == 1
+    # multi-turn members of the same rollout co-locate too
+    assert m._schedule("r1@t2-0") in servers
+    # a different rollout is free to land elsewhere
+    assert m._schedule("r2-0") != next(iter(servers))
+    # finish clears the affinity so the key can be reused fresh
+    m._finish_rollout("r1", accepted=True)
+    assert "r1" not in m._group_server
+
+
+def test_group_affinity_with_uuid_dashes():
+    # rollout qids contain dashes (uuid4); only the member suffix strips
+    m = _manager(policy="round_robin")
+    base = "f305140d-4fda-4442-a873-8cfc54bb2a4e#0"
+    s = {m._schedule(f"{base}-{i}") for i in range(4)}
+    assert len(s) == 1
