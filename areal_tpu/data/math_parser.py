@@ -139,12 +139,17 @@ def _strip_unit_words(s: str) -> str:
     "\\frac{m}{2}", "g(x)" all use unit-word letters as SYMBOLS and must
     not be eaten (a bare word-boundary rule mis-grades them).  A unit word
     that IS the whole answer (e.g. "east") also survives.
+
+    A separator between the digit and the unit is REQUIRED: "2m" is the
+    monomial 2*m, not "2 meters" — the reference's boundary rule
+    (reference: realhf/impl/dataset/math_parser.py:267, ``(^|\\W)unit($|\\W)``)
+    likewise leaves digit-adjacent letters alone.
     """
     for _ in range(3):  # chains: "42 cu. ft." needs repeated passes
         for w in _UNIT_WORDS:
-            # number then unit: "42 miles", "3.5sq", "7 p . m"
+            # number, a separator, then the unit: "42 miles", "7 p . m"
             t = re.sub(
-                r"(\d)[\s.]*" + w + r"(?![a-zA-Z])", r"\1", s
+                r"(\d)[\s.]+" + w + r"(?![a-zA-Z])", r"\1", s
             )
             # a unit word that IS the whole answer survives
             if t.strip(" {}()[].,"):
@@ -486,8 +491,19 @@ def _parse_number(s) -> Optional[float]:
 
 
 def _clean_choice(pred: str) -> str:
+    """Extract a multiple-choice letter from a prose prediction.
+
+    Matches on the RAW string: an uppercase standalone A-E, or a
+    parenthesized letter of either case ("(c)").  Upper-casing first would
+    turn the English article "a" into choice A (code-review r4 finding).
+    """
     pred = pred.strip("\n").rstrip(".").rstrip("/").strip().lstrip(":")
-    letters = re.findall(r"\b([A-E])\b", pred.upper())
+    # lowercase b-e are unambiguous as standalone words; lowercase "a" only
+    # counts when parenthesized (else every English article grades as A)
+    letters = [
+        (m.group(1) or m.group(2)).upper()
+        for m in re.finditer(r"\(([A-Ea-e])\)|\b([A-Eb-e])\b", pred)
+    ]
     if letters:
         return letters[-1]
     return pred.strip().strip(".")

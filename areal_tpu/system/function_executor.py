@@ -131,6 +131,9 @@ class FunctionExecutor:
         self._fetch_cycle = itertools.cycle(data_owner_workers)
         self.epoch = 0
         self.is_new_epoch = False
+        # in-process absolute trained-sample counter (single writer); read
+        # lazily so the master's recovery seed lands first
+        self._training_samples: Optional[int] = None
 
     # -- data loading -------------------------------------------------------
 
@@ -163,17 +166,26 @@ class FunctionExecutor:
     def _bump_training_samples(self, n: int):
         """Advance the globally-trained sample counter the gserver manager's
         staleness gate reads (reference: function_executor.py:185-200); the
-        master seeds it on (re)start so it survives recovery."""
+        master seeds it on (re)start so it survives recovery.
+
+        The counter is owned IN-PROCESS after the first bump and published
+        as an absolute value: a name_resolve read-modify-write would lose
+        increments if a second writer ever appeared (code-review r4
+        finding).  Single-writer assumption: exactly one FunctionExecutor
+        (the master's) bumps this key; the master's recovery seed happens
+        before the first bump, so reading it once here is race-free."""
         from areal_tpu.base import constants, name_resolve, names
 
         key = names.training_samples(
             constants.experiment_name(), constants.trial_name()
         )
-        try:
-            cnt = int(name_resolve.get(key))
-        except name_resolve.NameEntryNotFoundError:
-            cnt = 0
-        name_resolve.add(key, str(cnt + n), replace=True)
+        if self._training_samples is None:
+            try:
+                self._training_samples = int(name_resolve.get(key))
+            except name_resolve.NameEntryNotFoundError:
+                self._training_samples = 0
+        self._training_samples += n
+        name_resolve.add(key, str(self._training_samples), replace=True)
 
     # -- one MFC ------------------------------------------------------------
 

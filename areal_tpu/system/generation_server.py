@@ -107,8 +107,14 @@ class GenerationServerWorker(worker_base.Worker):
             self.addr = f"{network.gethostip()}:{port}"
             name_resolve.add(base_key, self.addr, replace=True)
             if self._n_procs > 1:
-                # command-stream broadcast to follower controllers
+                # command-stream broadcast to follower controllers.
+                # HWM must be unbounded: the default (1000) silently DROPS
+                # messages under a sustained leader/follower rate mismatch,
+                # and the follower's seq-gap check then kills the server —
+                # lockstep correctness requires every message delivered
+                # (code-review r4 finding)
                 self._ctrl_pub = self._ctx.socket(zmq.PUB)
+                self._ctrl_pub.setsockopt(zmq.SNDHWM, 0)
                 cport = self._ctrl_pub.bind_to_random_port("tcp://*")
                 name_resolve.add(
                     ctrl_key,
@@ -128,6 +134,7 @@ class GenerationServerWorker(worker_base.Worker):
         else:
             ctrl_addr = name_resolve.wait(ctrl_key, timeout=120)
             self._ctrl_sub = self._ctx.socket(zmq.SUB)
+            self._ctrl_sub.setsockopt(zmq.RCVHWM, 0)  # never drop (see PUB)
             self._ctrl_sub.connect(f"tcp://{ctrl_addr}")
             self._ctrl_sub.setsockopt(zmq.SUBSCRIBE, b"")
             name_resolve.add(

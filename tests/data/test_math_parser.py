@@ -200,6 +200,26 @@ class TestUnitStrippingSafety:
         assert strip_answer_string("3.5 kg") == "3.5"
         assert strip_answer_string("7 dollars") == "7"
 
+    def test_digit_adjacent_variable_not_eaten(self):
+        # "2m" is the monomial 2*m, NOT "2 meters" (advisor r3 medium):
+        # a separator between digit and unit word is required to strip
+        assert strip_answer_string("2m") == "2m"
+        assert strip_answer_string("2m+1") == "2m+1"
+        assert verify_math_solution(r"\boxed{2m}", [r"\boxed{2}"]) == 0.0
+        assert verify_math_solution(r"\boxed{3g}", [r"\boxed{3}"]) == 0.0
+        # with a separator the unit still strips
+        assert strip_answer_string("2 m") == "2"
+
+    def test_lowercase_article_not_choice_letter(self):
+        # the English article "a" must not grade as choice A (advisor r3)
+        assert not math_equal("so the answer is not B but a smaller value", "A")
+        # ...but genuine letters, upper or parenthesized-lower, still do
+        assert math_equal("The answer is B", "B")
+        assert math_equal("the answer is (c)", "C")
+        # standalone lowercase b-e are unambiguous (no article collision)
+        assert math_equal("so the answer is c", "C")
+        assert math_equal("the answer is (a)", "A")
+
     def test_embedded_equals_not_mangled(self):
         # "2x=4" must NOT lose its 'x=' (prefix-only removal); the short-lhs
         # rule and the equation branch handle it correctly instead
