@@ -253,9 +253,16 @@ def search_allocation(
             },
         )
 
+    # NOTE: no pipe tier here — under this cost model a fitting pipe spec is
+    # always dominated by folding the pipe factor into fsdp (identical state
+    # sharding, smaller per-device batch, lower comm penalty), so enumerating
+    # pipe would be dead code.  Pipeline parallelism is a MANUAL choice for
+    # the regimes the model doesn't capture (cross-slice DCN, extreme fsdp
+    # widths): spell it in the allocation string, e.g. ``d2p2m2``
+    # (docs/parallelism.md).
     best = None
     for data, model in _pow2_factorizations(n_devices):
-        for fsdp_of_data in (d for d in _divisors_pow2(data)):
+        for fsdp_of_data in _divisors_pow2(data):
             spec = MeshSpec(
                 data=data // fsdp_of_data, fsdp=fsdp_of_data, model=model
             )

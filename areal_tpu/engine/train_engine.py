@@ -74,7 +74,8 @@ class TrainEngine:
         from areal_tpu.parallel import distributed as dist
 
         self._dist = dist
-        self.pspecs = param_pspecs(model_cfg, params)
+        self.pipe_size = mesh.shape.get("pipe", 1)
+        self.pspecs = param_pspecs(model_cfg, params, pipe=self.pipe_size > 1)
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), self.pspecs
         )
@@ -109,6 +110,16 @@ class TrainEngine:
     def dp_size(self) -> int:
         return self.mesh.shape["data"] * self.mesh.shape["fsdp"]
 
+    @property
+    def row_quantum(self) -> int:
+        """Row-count multiple batches are padded to: the DP shard count,
+        times the pipeline micro-batch count when a ``pipe`` axis is live
+        (so every pipeline micro-batch stays DP-divisible)."""
+        if self.pipe_size > 1:
+            m = self.model_cfg.pipe_microbatches or 2 * self.pipe_size
+            return self.dp_size * m
+        return self.dp_size
+
     def _device_batch(self, pb: batching.PaddedBatch) -> Dict[str, jax.Array]:
         batch = {
             "tokens": pb.tokens,
@@ -129,8 +140,8 @@ class TrainEngine:
         return batching.pad_batch(
             sample,
             token_key=token_key,
-            row_multiple=self.dp_size,
-            min_rows=self.dp_size,
+            row_multiple=self.row_quantum,
+            min_rows=self.row_quantum,
         )
 
     # -- training -----------------------------------------------------------
@@ -205,11 +216,11 @@ class TrainEngine:
     def _stack_batches(self, mbs, token_key: str):
         """Pad every micro-batch to a common [B, T] and stack to [n, B, T]."""
         seqlens = [
-            [l[0] for l in mb.seqlens[token_key]] for mb in mbs
+            [l for ls in mb.seqlens[token_key] for l in ls] for mb in mbs
         ]
         rows = max(
-            batching.pad_rows(max(len(s) for s in seqlens), self.dp_size),
-            self.dp_size,
+            batching.pad_rows(max(len(s) for s in seqlens), self.row_quantum),
+            self.row_quantum,
         )
         T = batching.bucket_len(max(max(s) for s in seqlens))
         pbs = [
@@ -324,7 +335,8 @@ class TrainEngine:
             )
         packed = np.concatenate(packed_parts, axis=0)
         expected = [
-            [l[0] - output_shift] for l in sample.seqlens[token_key]
+            [l - output_shift for l in ls]
+            for ls in sample.seqlens[token_key]
         ]
         return SequenceSample.reorder_output(
             packed, expected, fwd_idx, bwd_idx

@@ -64,6 +64,10 @@ class TransformerConfig:
     # "ulysses" pays two all-to-alls and runs full attention on a head
     # subset (fewer collectives; needs per-device q heads % cp degree == 0)
     cp_impl: str = "ring"
+    # pipeline micro-batches per forward when the mesh has a ``pipe`` axis
+    # (row groups rotated stage-to-stage; areal_tpu/parallel/pipeline.py).
+    # 0 = auto (2 x pipe stages, capped by the row count).
+    pipe_microbatches: int = 0
 
     def __post_init__(self):
         assert self.n_q_heads % self.n_kv_heads == 0

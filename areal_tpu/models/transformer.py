@@ -134,15 +134,19 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def param_pspecs(cfg: TransformerConfig, params: Params) -> Params:
+def param_pspecs(
+    cfg: TransformerConfig, params: Params, pipe: bool = False
+) -> Params:
     """PartitionSpec pytree derived from the actual param tree by path.
 
     Megatron-style TP over the ``model`` axis (reference:
     realhf/impl/model/parallelism/tensor_parallel/modules.py — column/row
-    parallel linears), ZeRO-sharding over ``fsdp``; the stacked layer axis is
-    reserved for the ``pipe`` axis when pipeline parallelism is enabled.
+    parallel linears), ZeRO-sharding over ``fsdp``; with ``pipe=True`` the
+    stacked layer axis shards over the ``pipe`` mesh axis and the forward
+    runs the shard_map pipeline (areal_tpu/parallel/pipeline.py) instead of
+    the plain layer scan.
     """
-    lp = None  # layer axis: unsharded under SPMD (pipe uses shard_map)
+    lp = "pipe" if pipe else None  # stacked layer axis
 
     def spec_for(path: Tuple, leaf) -> P:
         keys = tuple(
@@ -319,6 +323,13 @@ def set_ambient_mesh(mesh):
 def _seq_parallel_mesh():
     m = _AMBIENT_MESH
     if m is not None and m.shape.get("seq", 1) > 1:
+        return m
+    return None
+
+
+def _pipe_mesh():
+    m = _AMBIENT_MESH
+    if m is not None and m.shape.get("pipe", 1) > 1:
         return m
     return None
 
@@ -549,26 +560,11 @@ def _layer(
     return x, (k_full, v_full), aux
 
 
-def _run_layers(
-    params,
-    cfg: TransformerConfig,
-    x,
-    positions,
-    mask,
-    seg_ids,
-    with_aux: bool = False,
-):
-    """Scan over stacked layers (self-attention path, no cache).
-
-    ``with_aux=True`` also returns the MoE router losses summed over layers
-    (zeros for dense models) — the round-1 review found these computed then
-    dropped inside the scan (VERDICT weak #7)."""
-
-    rope_cs = (
-        None
-        if cfg.abs_position_embedding
-        else rope_tables(positions, cfg.rotary_base, cfg.head_dim)
-    )
+def _scan_layers(cfg: TransformerConfig, stacked_lp, x, positions, mask,
+                 seg_ids, rope_cs):
+    """``lax.scan`` of :func:`_layer` over stacked layer params (with the
+    configured rematerialisation).  Returns ``(y, aux_layers)`` where
+    aux_layers is the per-layer MoE loss stack (None for dense)."""
 
     def body(carry, lp):
         y, _, aux = _layer(
@@ -589,7 +585,110 @@ def _run_layers(
             )
         else:
             body = jax.checkpoint(body)
-    x, aux_layers = jax.lax.scan(body, x, params["layers"])
+    return jax.lax.scan(body, x, stacked_lp)
+
+
+def _run_layers_pipelined(
+    params, cfg: TransformerConfig, x, positions, mask, seg_ids, rope_cs, mesh
+):
+    """Pipeline-parallel layer run: stages = ``pipe``-axis slices of the
+    stacked layers, micro-batches = row groups (see
+    areal_tpu/parallel/pipeline.py; replaces the reference's 1F1B pipe VM,
+    reference: realhf/impl/model/backend/pipe_runner.py:989)."""
+    from jax.sharding import NamedSharding
+    from areal_tpu.parallel import pipeline
+
+    B = x.shape[0]
+    p = mesh.shape["pipe"]
+    assert cfg.n_layers % p == 0, (
+        f"n_layers {cfg.n_layers} not divisible by pipe {p}"
+    )
+    m = pipeline.pick_microbatches(B, p, cfg.pipe_microbatches)
+    pad = (-B) % m
+    if pad:
+        # zero rows (seg 0) contribute nothing; dropped after the pipeline
+        def padr(a, one=False):
+            width = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+            return jnp.pad(a, width, constant_values=1 if one else 0)
+
+        x, positions, seg_ids, mask = (
+            padr(x), padr(positions), padr(seg_ids), padr(mask)
+        )
+        if rope_cs is not None:
+            rope_cs = (padr(rope_cs[0], one=True), padr(rope_cs[1]))
+
+    sides = {"positions": positions, "seg_ids": seg_ids, "mask": mask}
+    if rope_cs is not None:
+        sides["cos"], sides["sin"] = rope_cs
+    zero = jnp.zeros((), jnp.float32)
+    aux_zero = {"moe_aux_loss": zero, "moe_z_loss": zero}
+
+    def stage_fn(local_layers, mb):
+        cs = (mb["cos"], mb["sin"]) if "cos" in mb else None
+        y, aux_layers = _scan_layers(
+            cfg, local_layers, mb["x"], mb["positions"], mb["mask"],
+            mb["seg_ids"], cs,
+        )
+        if aux_layers is None:
+            aux = aux_zero
+        else:
+            # per-micro-batch router means, weighted by the micro-batch's
+            # valid-token count; the division below turns the pipeline sum
+            # into the token-weighted mean over micro-batches — the same
+            # grad-accum semantics as per-micro-batch aux in the engine's
+            # accumulation loop (a full-batch router statistic is not
+            # computable per stage)
+            w = jnp.sum((mb["seg_ids"] != 0).astype(jnp.float32))
+            aux = jax.tree.map(lambda a: jnp.sum(a) * w, aux_layers)
+        return y, aux
+
+    y, aux_total = pipeline.pipeline_apply(
+        mesh, params["layers"], stage_fn, x, sides, m, aux_zero=aux_zero
+    )
+    if cfg.is_moe:
+        W = jnp.maximum(jnp.sum((seg_ids != 0).astype(jnp.float32)), 1.0)
+        aux_total = jax.tree.map(lambda a: a / W, aux_total)
+    if pad:
+        y = y[:-pad]
+    # head/loss work shards over the pipe axis too (otherwise every stage
+    # group would redundantly compute the [B,T,V] logits matmul)
+    y = jax.lax.with_sharding_constraint(
+        y, NamedSharding(mesh, P(("data", "fsdp", "pipe"), None, None))
+    )
+    return y, aux_total
+
+
+def _run_layers(
+    params,
+    cfg: TransformerConfig,
+    x,
+    positions,
+    mask,
+    seg_ids,
+    with_aux: bool = False,
+):
+    """Run the stacked layers (self-attention path, no cache): a plain layer
+    scan, or the shard_map pipeline when the ambient mesh has a ``pipe``
+    axis of size > 1.
+
+    ``with_aux=True`` also returns the MoE router losses summed over layers
+    (zeros for dense models) — the round-1 review found these computed then
+    dropped inside the scan (VERDICT weak #7)."""
+
+    rope_cs = (
+        None
+        if cfg.abs_position_embedding
+        else rope_tables(positions, cfg.rotary_base, cfg.head_dim)
+    )
+    pmesh = _pipe_mesh()
+    if pmesh is not None:
+        x, aux_total = _run_layers_pipelined(
+            params, cfg, x, positions, mask, seg_ids, rope_cs, pmesh
+        )
+        return (x, aux_total) if with_aux else x
+    x, aux_layers = _scan_layers(
+        cfg, params["layers"], x, positions, mask, seg_ids, rope_cs
+    )
     if not with_aux:
         return x
     if aux_layers is None:

@@ -31,3 +31,6 @@ def _fresh_globals():
     yield
     name_resolve.reset()
     constants.reset()
+    from areal_tpu.models import transformer
+
+    transformer.set_ambient_mesh(None)
