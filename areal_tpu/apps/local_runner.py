@@ -35,9 +35,11 @@ def register_impls():
     import areal_tpu.engine.backend  # noqa: F401
     import areal_tpu.envs.math_code_single_step_env  # noqa: F401
     import areal_tpu.experiments.async_ppo_exp  # noqa: F401
+    import areal_tpu.experiments.dpo_exp  # noqa: F401
     import areal_tpu.experiments.null_exp  # noqa: F401
     import areal_tpu.experiments.ppo_math_exp  # noqa: F401
     import areal_tpu.experiments.sft_exp  # noqa: F401
+    import areal_tpu.interfaces.dpo_interface  # noqa: F401
     import areal_tpu.interfaces.fused_interface  # noqa: F401
     import areal_tpu.interfaces.ppo_interface  # noqa: F401
     import areal_tpu.interfaces.rw_interface  # noqa: F401

@@ -71,8 +71,12 @@ def pad_batch(
     """One sequence per row, right padding; extras aligned per class.
 
     ``fixed_rows``/``fixed_len`` force the output shape (so several
-    micro-batches can share one compiled step / be stacked for a scan)."""
-    seqlens = [l[0] for l in sample.seqlens[token_key]]
+    micro-batches can share one compiled step / be stacked for a scan).
+
+    Ids holding SEQUENCE GROUPS (e.g. the paired preference dataset packs
+    [chosen, rejected, ...] under one id) flatten to one row per member
+    sequence, in packed order."""
+    seqlens = [l for ls in sample.seqlens[token_key] for l in ls]
     B = max(pad_rows(max(len(seqlens), min_rows), row_multiple), min_rows)
     T = bucket_len(max(seqlens), buckets)
     if fixed_rows:
@@ -99,7 +103,16 @@ def pad_batch(
     for key in sample.keys:
         if key == token_key or sample.data.get(key) is None:
             continue
-        lens = [sum(l) for l in sample.seqlens[key]]
+        lens = [l for ls in sample.seqlens[key] for l in ls]
+        if len(lens) != len(seqlens):
+            # a key not aligned per member sequence (e.g. one scalar per
+            # GROUP id alongside multi-sequence groups) would land on the
+            # wrong rows after flattening — refuse rather than guess
+            raise ValueError(
+                f"key {key!r} has {len(lens)} sequences but {token_key!r} "
+                f"has {len(seqlens)}; per-group keys cannot align with "
+                "multi-sequence ids"
+            )
         arr = sample.data[key]
         offs = np.concatenate([[0], np.cumsum(lens)])
         if all(l == 1 for l in lens):  # scalar per sequence

@@ -1,0 +1,202 @@
+"""DPO algorithm interface.
+
+Trains an actor on (chosen, rejected) preference pairs from the paired
+dataset (areal_tpu/data/rw_paired_dataset.py packs each prompt's answers
+as [pos1, neg1, pos2, neg2, ...]).  The reference ships the DPO math
+(reference: realhf/impl/model/utils/dpo_functional.py) but no longer
+wires an interface around it; this one follows its ReaLHF-era shape —
+a frozen reference model's per-token logps arrive as a data key (produced
+by the ref-inference MFC via ``model_logprobs_fwd``), the actor recomputes
+its own inside the loss, and both reduce to per-pair logratios.
+
+Pairing inside the jitted loss uses per-token ``dpo_sign`` (+1 chosen /
+-1 rejected) and ``dpo_pair`` (global pair index) keys amended on the
+host.  ``SequenceSample.split`` keeps a sample id's sequences together,
+so a pair can never straddle micro-batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api import model_api
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base import logging_, stats_tracker
+from areal_tpu.interfaces.ppo_interface import (
+    _response_mask,
+    model_logprobs_fwd,
+)
+from areal_tpu.interfaces.sft_interface import head_weight, hidden_states
+from areal_tpu.ops.dpo import dpo_pair_loss, pairwise_logratios
+from areal_tpu.ops.loss import per_token_logprobs_entropy
+
+logger = logging_.getLogger("dpo_interface")
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def dpo_loss_fn(beta: float, n_pairs: int):
+    """Engine LossFn for DPO.  ``n_pairs`` is the (bucketed) static pair
+    capacity; the cache key makes equal-capacity batches share a compile."""
+
+    def fn(params, cfg, batch):
+        hidden, moe_aux = hidden_states(
+            params,
+            cfg,
+            batch["tokens"],
+            batch["positions"],
+            batch["seg_ids"],
+            with_aux=True,
+        )
+        B, T, D = hidden.shape
+        w = head_weight(params, cfg).astype(hidden.dtype)
+        logp, _ = per_token_logprobs_entropy(
+            hidden[:, :-1].reshape(-1, D),
+            w,
+            batch["tokens"][:, 1:].reshape(-1),
+            with_entropy=False,
+        )
+        logp = jnp.pad(logp.reshape(B, T - 1), ((0, 0), (0, 1)))
+
+        mask = _response_mask(batch)
+        # sign/pair are per-token constants of their segment; align to the
+        # TARGET token of each transition (same shift as the labels)
+        def tgt(a):
+            return jnp.pad(a[:, 1:], ((0, 0), (0, 1)))
+
+        sign = tgt(batch["dpo_sign"]).astype(jnp.float32)
+        pair = tgt(batch["dpo_pair"]).astype(jnp.int32)
+        ref_logp = batch["packed_ref_logprobs"].astype(jnp.float32)
+
+        pi_lr = pairwise_logratios(
+            logp.astype(jnp.float32), sign, pair, mask, n_pairs
+        )
+        ref_lr = pairwise_logratios(ref_logp, sign, pair, mask, n_pairs)
+        # a pair is live iff any of its response transitions are in-batch
+        tokens_per_pair = pairwise_logratios(
+            jnp.ones_like(mask), jnp.abs(sign), pair, mask, n_pairs
+        )
+        valid = tokens_per_pair > 0
+
+        loss_sum, n_valid, stats = dpo_pair_loss(pi_lr, ref_lr, valid, beta)
+        stats = dict(stats)
+        if cfg.is_moe:
+            aux_total = moe_aux["moe_aux_loss"] + moe_aux["moe_z_loss"]
+            loss_sum = loss_sum + aux_total * n_valid
+            stats["moe_aux_loss_sum"] = moe_aux["moe_aux_loss"] * n_valid
+        return loss_sum, n_valid, stats
+
+    fn._cache_key = ("dpo_loss_fn", float(beta), int(n_pairs))
+    return fn
+
+
+@dataclasses.dataclass
+class DPOInterface(model_api.ModelInterface):
+    beta: float = 0.1
+    token_key: str = "packed_input_ids"
+
+    def _amend_pairing(self, data: SequenceSample) -> SequenceSample:
+        """Attach per-token chosen/rejected sign and global pair index.
+        Sequences alternate [chosen, rejected, ...] within each sample id
+        (rw_paired_dataset packing order)."""
+        groups = data.seqlens[self.token_key]
+        sign_parts, pair_parts = [], []
+        seq_idx = 0
+        for ls in groups:
+            assert len(ls) % 2 == 0, (
+                f"DPO id holds an odd sequence count: {ls}"
+            )
+            for L in ls:
+                sign_parts.append(
+                    np.full(L, 1 if seq_idx % 2 == 0 else -1, np.int32)
+                )
+                pair_parts.append(np.full(L, seq_idx // 2, np.int32))
+                seq_idx += 1
+        amend = SequenceSample(
+            keys={"dpo_sign", "dpo_pair"},
+            trailing_shapes={"dpo_sign": (), "dpo_pair": ()},
+            dtypes={
+                "dpo_sign": np.dtype(np.int32),
+                "dpo_pair": np.dtype(np.int32),
+            },
+            ids=data.ids,
+            seqlens={"dpo_sign": groups, "dpo_pair": groups},
+            data={
+                "dpo_sign": np.concatenate(sign_parts),
+                "dpo_pair": np.concatenate(pair_parts),
+            },
+        )
+        data.update_(amend)
+        return data
+
+    def inference(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> SequenceSample:
+        """Frozen-reference pass: per-token logps of the packed batch
+        (the ref model's MFC output feeding the actor train step)."""
+        engine = model.engine
+        lps = engine.forward_batch(
+            data,
+            model_logprobs_fwd(1.0),
+            mb_spec,
+            token_key=self.token_key,
+            output_shift=1,
+        )
+        lr_groups = [
+            [l - 1 for l in ls] for ls in data.seqlens[self.token_key]
+        ]
+        return SequenceSample(
+            keys={"packed_ref_logprobs"},
+            trailing_shapes={"packed_ref_logprobs": ()},
+            dtypes={"packed_ref_logprobs": np.dtype(np.float32)},
+            ids=data.ids,
+            seqlens={"packed_ref_logprobs": lr_groups},
+            data={"packed_ref_logprobs": np.asarray(lps, np.float32)},
+        )
+
+    def train_step(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> Dict:
+        engine = model.engine
+        data = self._amend_pairing(data)
+        n_seqs = sum(len(ls) for ls in data.seqlens[self.token_key])
+        cap = _next_pow2(max(1, n_seqs // 2))
+        stats = engine.train_batch(
+            data,
+            dpo_loss_fn(self.beta, cap),
+            mb_spec,
+            token_key=self.token_key,
+        )
+        model.version.advance(
+            model.ft_spec.steps_per_epoch if model.ft_spec else int(1e9)
+        )
+        n_pairs = max(stats.get("n_tokens", 1.0), 1.0)  # denom = pair count
+        with stats_tracker.scope("dpo"):
+            stats_tracker.scalar(
+                loss=stats["loss"],
+                margin=stats.get("margin_sum", 0.0) / n_pairs,
+                reward_acc=stats.get("reward_acc_sum", 0.0) / n_pairs,
+                grad_norm=stats["grad_norm"],
+                n_pairs=n_pairs,
+            )
+        return stats
+
+    def save(self, model: model_api.Model, save_dir: str):
+        model.engine.save_hf(
+            save_dir, model.backend_name or "llama", model.tokenizer
+        )
+
+
+model_api.register_interface("dpo", DPOInterface)

@@ -1,0 +1,56 @@
+"""Direct Preference Optimization math.
+
+JAX rebuild of the reference's DPO functional (reference:
+realhf/impl/model/utils/dpo_functional.py:11-34 ``dpo_loss`` — sigmoid
+preference loss over (chosen, rejected) sequence-logprob pairs, plus
+pos/neg score and KL diagnostics).  The reference operates on a dense
+``[2k]`` logp vector with chosen/rejected interleaved; here the pairing
+is expressed per-pair (the packed-batch interface reduces per-token
+logps into per-pair logratios with a segment sum, so variable batch
+composition never reshapes a dense vector).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def dpo_pair_loss(
+    pi_logratios: jax.Array,  # [P] sum(logp chosen) - sum(logp rejected)
+    ref_logratios: jax.Array,  # [P] same under the frozen reference policy
+    valid: jax.Array,  # [P] bool; False for padding pairs
+    beta: float,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Returns ``(loss_sum, n_pairs, stats)``.
+
+    loss per pair = -logsigmoid(beta * (pi_logratio - ref_logratio));
+    stats carry raw sums so grad-accum can add across micro-batches:
+    ``reward_acc_sum`` counts pairs where the implicit reward margin is
+    positive (the standard DPO training accuracy).
+    """
+    validf = valid.astype(jnp.float32)
+    delta = beta * (pi_logratios - ref_logratios)
+    losses = -jax.nn.log_sigmoid(delta) * validf
+    n_pairs = jnp.sum(validf)
+    stats = {
+        "margin_sum": jnp.sum(jnp.where(valid, delta, 0.0)),
+        "reward_acc_sum": jnp.sum((delta > 0) & valid),
+    }
+    return jnp.sum(losses), n_pairs, stats
+
+
+def pairwise_logratios(
+    per_token: jax.Array,  # [B, T] transition-aligned per-token values
+    sign: jax.Array,  # [B, T] +1 chosen / -1 rejected (target-aligned)
+    pair_ids: jax.Array,  # [B, T] int32 global pair index (target-aligned)
+    mask: jax.Array,  # [B, T] response-transition mask
+    n_pairs: int,  # static capacity (bucketed)
+) -> jax.Array:
+    """Reduce per-token values to per-pair (chosen - rejected) sums."""
+    contrib = (per_token * mask * sign).reshape(-1)
+    return jax.ops.segment_sum(
+        contrib, pair_ids.reshape(-1), num_segments=n_pairs
+    )

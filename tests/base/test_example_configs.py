@@ -16,12 +16,15 @@ EXAMPLES = sorted(
 def test_config_parses(path):
     from areal_tpu.api.cli_args import parse_cli
     from areal_tpu.experiments.async_ppo_exp import AsyncPPOMathExperiment
+    from areal_tpu.experiments.dpo_exp import DPOExperiment
     from areal_tpu.experiments.ppo_math_exp import PPOMathExperiment
     from areal_tpu.experiments.sft_exp import SFTExperiment
 
     name = os.path.basename(path)
     if "sft" in name:
         cls = SFTExperiment
+    elif "dpo" in name:
+        cls = DPOExperiment
     elif "async" in name:
         cls = AsyncPPOMathExperiment
     else:
