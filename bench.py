@@ -383,6 +383,21 @@ def main():
     finally:
         shutil.rmtree(pub_dir, ignore_errors=True)
 
+    # device->host SINGLE-STREAM link bandwidth: the commit time above is
+    # fetch-bound, not disk-bound, when the chip sits behind a slow
+    # tunnel (a remote v5e fetches ~1 GB of bf16 params at link speed;
+    # a local TPU host does this over PCIe/DMA at GB/s).  Orbax fetches
+    # leaves concurrently, so commit throughput ~ n_streams x this.
+    big = jax.device_put(np.zeros((32, 1024, 1024), np.float16))  # 64 MiB
+    scale = jax.jit(lambda x, c: x * c)
+    np.asarray(scale(big, jnp.float16(2)))  # compile + warm the path
+    t0 = time.perf_counter()
+    # same compiled fn, FRESH output buffer: the timed fetch pays only
+    # exec + transfer (a repeat fetch of one buffer can hit a host-side
+    # cache; a fresh expression re-pays compile under the lazy tunnel)
+    np.asarray(scale(big, jnp.float16(3)))
+    d2h_gbps = (64 / 1024) / max(time.perf_counter() - t0, 1e-9)
+
     # effective RL step on one chip: generate a batch, then train on the
     # generated sequences (sync pipeline; gen and train share the chip)
     B_eff, new_eff = (32, 512) if on_tpu else (2, 16)
@@ -472,6 +487,7 @@ def main():
                     "n_params": n_params,
                     "weight_publish_block_s": round(publish_block_s, 4),
                     "weight_publish_commit_s": round(publish_commit_s, 3),
+                    "d2h_stream_gb_per_s": round(d2h_gbps, 3),
                     "generation_0p5b": gen,
                     "generation_qwen25_1p5b_arch": gen_15b,
                     "interruption": interruption,
