@@ -93,6 +93,29 @@ def main():
     )
     assert np.isfinite(np.asarray(lps, np.float32)).all()
 
+    # cross-host pipeline phase: ``pipe`` is the SLOWEST mesh axis, so with
+    # pipe == process_count each stage lives entirely on one host and only
+    # the thin [B, T, D] activation rotations cross the host boundary —
+    # the cross-slice/DCN pattern docs/parallelism.md reserves PP for.
+    # Fresh params from the same seed: the pre-update first-step loss must
+    # reproduce the unpipelined engine's.
+    if num_procs % 2 == 0 and cfg.n_layers % 2 == 0:
+        pp_engine = TrainEngine(
+            cfg,
+            MeshSpec(pipe=2, data=n_total // 2).make_mesh(jax.devices()),
+            transformer.init_params(cfg, jax.random.PRNGKey(0)),
+            optimizer_cfg=OptimizerConfig(lr=1e-3),
+            total_train_steps=4,
+        )
+        pp_stats = pp_engine.train_batch(
+            sample, sft_loss_fn, MicroBatchSpec(n_mbs=2)
+        )
+        assert abs(pp_stats["loss"] - losses[0]) < 5e-3, (
+            pp_stats["loss"], losses[0],
+        )
+        losses.append(pp_stats["loss"])  # cross-process identity check
+        transformer.set_ambient_mesh(None)
+
     host = engine.get_host_params()
     n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(host))
     print(json.dumps({"proc": proc_id, "losses": losses, "n_params": n}))

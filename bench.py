@@ -388,7 +388,12 @@ def main():
     # tunnel (a remote v5e fetches ~1 GB of bf16 params at link speed;
     # a local TPU host does this over PCIe/DMA at GB/s).  Orbax fetches
     # leaves concurrently, so commit throughput ~ n_streams x this.
-    big = jax.device_put(np.zeros((32, 1024, 1024), np.float16))  # 64 MiB
+    big = jax.device_put(  # 64 MiB of incompressible bytes: an all-zeros
+        # payload would let transport compression serve the fetch for free
+        np.random.default_rng(7)
+        .standard_normal((32, 1024, 1024))
+        .astype(np.float16)
+    )
     scale = jax.jit(lambda x, c: x * c)
     np.asarray(scale(big, jnp.float16(2)))  # compile + warm the path
     t0 = time.perf_counter()
