@@ -44,6 +44,9 @@ class TransformerConfig:
     moe_intermediate_dim: Optional[int] = None
     moe_aux_loss_coef: float = 0.001
     moe_z_loss_coef: float = 0.0
+    # renormalize the top-k routing probs to sum to 1 (mixtral: yes;
+    # qwen3-moe: per-config ``norm_topk_prob``)
+    moe_norm_topk_prob: bool = True
 
     # head
     is_critic: bool = False  # value head (dim 1) instead of lm head

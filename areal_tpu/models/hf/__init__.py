@@ -1,6 +1,6 @@
 """HF family adapters.  Importing registers all families."""
 
-from areal_tpu.models.hf import gpt2, llama_like, mixtral  # noqa: F401
+from areal_tpu.models.hf import gpt2, llama_like, mixtral, qwen3_moe  # noqa: F401
 from areal_tpu.models.hf.registry import (  # noqa: F401
     get_hf_family,
     load_hf_config,

@@ -9,17 +9,9 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-import jax.numpy as jnp
-import numpy as np
-
 from areal_tpu.models.config import TransformerConfig
-from areal_tpu.models.hf.registry import (
-    HFFamily,
-    StateDict,
-    register_hf_family,
-    stack_layers,
-    to_np,
-)
+from areal_tpu.models.hf.moe_common import moe_params_from_hf, moe_params_to_hf
+from areal_tpu.models.hf.registry import HFFamily, StateDict, register_hf_family
 
 
 def _config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
@@ -64,99 +56,23 @@ def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
 
 
 def _params_from_hf(state: StateDict, cfg: TransformerConfig) -> Dict[str, Any]:
-    L, E = cfg.n_layers, cfg.n_experts
-    g = lambda n: to_np(state[n])
-
-    def layer_stack(fmt, transpose=True):
-        mats = [g(fmt.format(i=i)) for i in range(L)]
-        if transpose:
-            mats = [m.T for m in mats]
-        return jnp.asarray(stack_layers(mats))
-
-    def expert_stack(w_name):  # -> [L, E, in, out]
-        per_layer = []
-        for i in range(L):
-            per_exp = [
-                g(
-                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"
-                ).T
-                for e in range(E)
-            ]
-            per_layer.append(np.stack(per_exp, axis=0))
-        return jnp.asarray(np.stack(per_layer, axis=0))
-
-    params: Dict[str, Any] = {
-        "embed": {"weight": jnp.asarray(g("model.embed_tokens.weight"))},
-        "layers": {
-            "attn_norm": {
-                "scale": layer_stack(
-                    "model.layers.{i}.input_layernorm.weight", transpose=False
-                )
-            },
-            "attn": {
-                "q": {"w": layer_stack("model.layers.{i}.self_attn.q_proj.weight")},
-                "k": {"w": layer_stack("model.layers.{i}.self_attn.k_proj.weight")},
-                "v": {"w": layer_stack("model.layers.{i}.self_attn.v_proj.weight")},
-                "o": {"w": layer_stack("model.layers.{i}.self_attn.o_proj.weight")},
-            },
-            "mlp_norm": {
-                "scale": layer_stack(
-                    "model.layers.{i}.post_attention_layernorm.weight",
-                    transpose=False,
-                )
-            },
-            "mlp": {
-                "router": {
-                    "w": layer_stack(
-                        "model.layers.{i}.block_sparse_moe.gate.weight"
-                    )
-                },
-                "experts": {
-                    "gate": expert_stack("w1"),
-                    "down": expert_stack("w2"),
-                    "up": expert_stack("w3"),
-                },
-            },
-        },
-        "final_norm": {"scale": jnp.asarray(g("model.norm.weight"))},
-    }
-    if not cfg.is_critic:
-        params["lm_head"] = {"w": jnp.asarray(g("lm_head.weight").T)}
-    return params
+    return moe_params_from_hf(
+        state,
+        cfg,
+        router_fmt="model.layers.{i}.block_sparse_moe.gate.weight",
+        expert_fmt="model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight",
+        expert_names=("w1", "w2", "w3"),  # (gate, down, up)
+    )
 
 
 def _params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> StateDict:
-    out: StateDict = {}
-    np_ = lambda x: np.asarray(x, np.float32)
-    lay = params["layers"]
-    out["model.embed_tokens.weight"] = np_(params["embed"]["weight"])
-    for i in range(cfg.n_layers):
-        pre = f"model.layers.{i}."
-        out[pre + "input_layernorm.weight"] = np_(lay["attn_norm"]["scale"][i])
-        out[pre + "post_attention_layernorm.weight"] = np_(
-            lay["mlp_norm"]["scale"][i]
-        )
-        for ours, theirs in (
-            ("q", "q_proj"),
-            ("k", "k_proj"),
-            ("v", "v_proj"),
-            ("o", "o_proj"),
-        ):
-            out[pre + f"self_attn.{theirs}.weight"] = np_(
-                lay["attn"][ours]["w"][i]
-            ).T
-        out[pre + "block_sparse_moe.gate.weight"] = np_(
-            lay["mlp"]["router"]["w"][i]
-        ).T
-        for e in range(cfg.n_experts):
-            base = pre + f"block_sparse_moe.experts.{e}."
-            out[base + "w1.weight"] = np_(lay["mlp"]["experts"]["gate"][i, e]).T
-            out[base + "w2.weight"] = np_(lay["mlp"]["experts"]["down"][i, e]).T
-            out[base + "w3.weight"] = np_(lay["mlp"]["experts"]["up"][i, e]).T
-    out["model.norm.weight"] = np_(params["final_norm"]["scale"])
-    if "lm_head" in params:
-        out["lm_head.weight"] = np_(params["lm_head"]["w"]).T
-    return out
+    return moe_params_to_hf(
+        params,
+        cfg,
+        router_key="block_sparse_moe.gate.weight",
+        expert_base="block_sparse_moe.experts.{e}.",
+        expert_names=("w1", "w2", "w3"),
+    )
 
 
 register_hf_family(

@@ -1,0 +1,151 @@
+"""Shared converter core for sparse-MLP (MoE) HF families.
+
+mixtral and qwen3_moe differ only in weight-key naming and qk-norm; one
+parameterized pair of converters keeps them in lockstep (the same shape
+llama_like.py uses for its five dense families).
+
+``expert_names`` maps our (gate, down, up) order to the family's
+per-expert Linear names; expert weights stack to [L, E, in, out] for the
+ragged-dot MoE path (areal_tpu/models/moe.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf.registry import StateDict, stack_layers, to_np
+
+
+def moe_params_from_hf(
+    state: StateDict,
+    cfg: TransformerConfig,
+    *,
+    router_fmt: str,
+    expert_fmt: str,
+    expert_names: Tuple[str, str, str],  # (gate, down, up)
+    qk_norm: bool = False,
+) -> Dict[str, Any]:
+    L, E = cfg.n_layers, cfg.n_experts
+    g = lambda n: to_np(state[n])
+
+    def layer_stack(fmt, transpose=True):
+        mats = [g(fmt.format(i=i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(stack_layers(mats))
+
+    def expert_stack(w_name):  # -> [L, E, in, out]
+        per_layer = []
+        for i in range(L):
+            per_exp = [
+                g(expert_fmt.format(i=i, e=e, w=w_name)).T for e in range(E)
+            ]
+            per_layer.append(np.stack(per_exp, axis=0))
+        return jnp.asarray(np.stack(per_layer, axis=0))
+
+    attn: Dict[str, Any] = {
+        "q": {"w": layer_stack("model.layers.{i}.self_attn.q_proj.weight")},
+        "k": {"w": layer_stack("model.layers.{i}.self_attn.k_proj.weight")},
+        "v": {"w": layer_stack("model.layers.{i}.self_attn.v_proj.weight")},
+        "o": {"w": layer_stack("model.layers.{i}.self_attn.o_proj.weight")},
+    }
+    if qk_norm:
+        attn["q_norm"] = {
+            "scale": layer_stack(
+                "model.layers.{i}.self_attn.q_norm.weight", transpose=False
+            )
+        }
+        attn["k_norm"] = {
+            "scale": layer_stack(
+                "model.layers.{i}.self_attn.k_norm.weight", transpose=False
+            )
+        }
+
+    gate_n, down_n, up_n = expert_names
+    params: Dict[str, Any] = {
+        "embed": {"weight": jnp.asarray(g("model.embed_tokens.weight"))},
+        "layers": {
+            "attn_norm": {
+                "scale": layer_stack(
+                    "model.layers.{i}.input_layernorm.weight", transpose=False
+                )
+            },
+            "attn": attn,
+            "mlp_norm": {
+                "scale": layer_stack(
+                    "model.layers.{i}.post_attention_layernorm.weight",
+                    transpose=False,
+                )
+            },
+            "mlp": {
+                "router": {"w": layer_stack(router_fmt)},
+                "experts": {
+                    "gate": expert_stack(gate_n),
+                    "down": expert_stack(down_n),
+                    "up": expert_stack(up_n),
+                },
+            },
+        },
+        "final_norm": {"scale": jnp.asarray(g("model.norm.weight"))},
+    }
+    if not cfg.is_critic and not cfg.tied_embedding:
+        params["lm_head"] = {"w": jnp.asarray(g("lm_head.weight").T)}
+    return params
+
+
+def moe_params_to_hf(
+    params: Dict[str, Any],
+    cfg: TransformerConfig,
+    *,
+    router_key: str,  # relative to "model.layers.{i}."
+    expert_base: str,  # e.g. "block_sparse_moe.experts.{e}."
+    expert_names: Tuple[str, str, str],  # (gate, down, up)
+    qk_norm: bool = False,
+) -> StateDict:
+    out: StateDict = {}
+    np_ = lambda x: np.asarray(x, np.float32)
+    lay = params["layers"]
+    gate_n, down_n, up_n = expert_names
+    out["model.embed_tokens.weight"] = np_(params["embed"]["weight"])
+    for i in range(cfg.n_layers):
+        pre = f"model.layers.{i}."
+        out[pre + "input_layernorm.weight"] = np_(lay["attn_norm"]["scale"][i])
+        out[pre + "post_attention_layernorm.weight"] = np_(
+            lay["mlp_norm"]["scale"][i]
+        )
+        for ours, theirs in (
+            ("q", "q_proj"),
+            ("k", "k_proj"),
+            ("v", "v_proj"),
+            ("o", "o_proj"),
+        ):
+            out[pre + f"self_attn.{theirs}.weight"] = np_(
+                lay["attn"][ours]["w"][i]
+            ).T
+        if qk_norm:
+            out[pre + "self_attn.q_norm.weight"] = np_(
+                lay["attn"]["q_norm"]["scale"][i]
+            )
+            out[pre + "self_attn.k_norm.weight"] = np_(
+                lay["attn"]["k_norm"]["scale"][i]
+            )
+        out[pre + router_key] = np_(lay["mlp"]["router"]["w"][i]).T
+        for e in range(cfg.n_experts):
+            base = pre + expert_base.format(e=e)
+            out[base + f"{gate_n}.weight"] = np_(
+                lay["mlp"]["experts"]["gate"][i, e]
+            ).T
+            out[base + f"{down_n}.weight"] = np_(
+                lay["mlp"]["experts"]["down"][i, e]
+            ).T
+            out[base + f"{up_n}.weight"] = np_(
+                lay["mlp"]["experts"]["up"][i, e]
+            ).T
+    out["model.norm.weight"] = np_(params["final_norm"]["scale"])
+    if "lm_head" in params:
+        out["lm_head.weight"] = np_(params["lm_head"]["w"]).T
+    return out

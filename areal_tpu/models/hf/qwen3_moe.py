@@ -1,0 +1,117 @@
+"""Qwen3-MoE HF adapter — a family BEYOND the reference's seven
+(reference: realhf/api/from_hf/ has no qwen3moe converter).
+
+Qwen3 attention (per-head q/k RMSNorm, explicit ``head_dim``, no qkv bias)
+plus mixtral-style sparse MLP with qwen naming: router at
+``model.layers.{i}.mlp.gate``, experts at
+``model.layers.{i}.mlp.experts.{e}.gate_proj/up_proj/down_proj``.
+Expert weights stack to [L, E, in, out] for the ragged-dot MoE path
+(areal_tpu/models/moe.py); ``norm_topk_prob`` maps to
+``TransformerConfig.moe_norm_topk_prob``.
+
+Dense-interleaved variants (``decoder_sparse_step != 1`` or non-empty
+``mlp_only_layers``) are rejected: the stacked-layer scan assumes a
+homogeneous per-layer structure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf.moe_common import moe_params_from_hf, moe_params_to_hf
+from areal_tpu.models.hf.registry import HFFamily, StateDict, register_hf_family
+
+
+def _config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
+    if hf.get("decoder_sparse_step", 1) != 1 or hf.get("mlp_only_layers"):
+        raise NotImplementedError(
+            "qwen3_moe with dense-interleaved layers (decoder_sparse_step "
+            "!= 1 or mlp_only_layers) is not supported: the layer scan "
+            "requires homogeneous layers"
+        )
+    if hf.get("attention_bias", False):
+        raise NotImplementedError(
+            "qwen3_moe with attention_bias=True is not supported: the "
+            "adapter would silently drop the q/k/v/o bias tensors"
+        )
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+    return TransformerConfig(
+        n_layers=hf["num_hidden_layers"],
+        hidden_dim=hf["hidden_size"],
+        n_q_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        intermediate_dim=hf["intermediate_size"],
+        moe_intermediate_dim=hf["moe_intermediate_size"],
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("max_position_embeddings", 32768),
+        norm_eps=hf.get("rms_norm_eps", 1e-6),
+        rotary_base=hf.get("rope_theta", 10000.0),
+        tied_embedding=hf.get("tie_word_embeddings", False),
+        use_qk_norm=True,
+        n_experts=hf["num_experts"],
+        n_experts_per_tok=hf["num_experts_per_tok"],
+        moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.001),
+        moe_norm_topk_prob=hf.get("norm_topk_prob", False),
+    )
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    return dict(
+        architectures=["Qwen3MoeForCausalLM"],
+        model_type="qwen3_moe",
+        hidden_size=cfg.hidden_dim,
+        intermediate_size=cfg.intermediate_dim,
+        moe_intermediate_size=cfg.moe_intermediate_dim or cfg.intermediate_dim,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_q_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim,
+        vocab_size=cfg.vocab_size,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rotary_base,
+        tie_word_embeddings=cfg.tied_embedding,
+        num_experts=cfg.n_experts,
+        num_experts_per_tok=cfg.n_experts_per_tok,
+        router_aux_loss_coef=cfg.moe_aux_loss_coef,
+        norm_topk_prob=cfg.moe_norm_topk_prob,
+        decoder_sparse_step=1,
+        mlp_only_layers=[],
+        torch_dtype="bfloat16",
+    )
+
+
+def _params_from_hf(state: StateDict, cfg: TransformerConfig) -> Dict[str, Any]:
+    return moe_params_from_hf(
+        state,
+        cfg,
+        router_fmt="model.layers.{i}.mlp.gate.weight",
+        expert_fmt="model.layers.{i}.mlp.experts.{e}.{w}.weight",
+        expert_names=("gate_proj", "down_proj", "up_proj"),
+        qk_norm=True,
+    )
+
+
+def _params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> StateDict:
+    return moe_params_to_hf(
+        params,
+        cfg,
+        router_key="mlp.gate.weight",
+        expert_base="mlp.experts.{e}.",
+        expert_names=("gate_proj", "down_proj", "up_proj"),
+        qk_norm=True,
+    )
+
+
+register_hf_family(
+    HFFamily(
+        name="qwen3_moe",
+        hf_architecture="Qwen3MoeForCausalLM",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=_params_from_hf,
+        params_to_hf=_params_to_hf,
+    )
+)

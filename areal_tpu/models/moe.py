@@ -65,7 +65,8 @@ def moe_mlp(
     )  # [N, E]
     probs = jax.nn.softmax(router_logits, axis=-1)
     topk_probs, topk_idx = jax.lax.top_k(probs, K)  # [N, K]
-    topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    if cfg.moe_norm_topk_prob:
+        topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
 
     # aux losses over VALID tokens only
     if valid is None:

@@ -62,6 +62,30 @@ def _tiny_hf_model(family, tmp_path):
             sliding_window=None,
         )
         model = transformers.MixtralForCausalLM(cfg)
+    elif family == "qwen3_moe":
+        cfg = transformers.Qwen3MoeConfig(
+            **common,
+            head_dim=8,
+            moe_intermediate_size=48,
+            num_experts=4,
+            num_experts_per_tok=2,
+            norm_topk_prob=True,
+            tie_word_embeddings=False,
+        )
+        model = transformers.Qwen3MoeForCausalLM(cfg)
+    elif family == "qwen3_moe_nonorm":
+        # real Qwen3-MoE checkpoints set norm_topk_prob per-config; the
+        # False path must round-trip too (router skips renormalization)
+        cfg = transformers.Qwen3MoeConfig(
+            **common,
+            head_dim=8,
+            moe_intermediate_size=48,
+            num_experts=4,
+            num_experts_per_tok=2,
+            norm_topk_prob=False,
+            tie_word_embeddings=False,
+        )
+        model = transformers.Qwen3MoeForCausalLM(cfg)
     else:
         raise ValueError(family)
     model = model.eval().float()
@@ -70,7 +94,11 @@ def _tiny_hf_model(family, tmp_path):
 
 
 @pytest.mark.parametrize(
-    "family", ["llama", "qwen2", "qwen3", "mistral", "gemma", "gpt2", "mixtral"]
+    "family",
+    [
+        "llama", "qwen2", "qwen3", "mistral", "gemma", "gpt2", "mixtral",
+        "qwen3_moe", "qwen3_moe_nonorm",
+    ],
 )
 def test_logit_parity(family, tmp_path):
     torch.manual_seed(0)
