@@ -37,6 +37,9 @@ def _config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
         )
     head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
     return TransformerConfig(
+        sliding_window=(
+            hf.get("sliding_window") if hf.get("use_sliding_window") else None
+        ),
         n_layers=hf["num_hidden_layers"],
         hidden_dim=hf["hidden_size"],
         n_q_heads=hf["num_attention_heads"],
@@ -79,6 +82,8 @@ def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
         norm_topk_prob=cfg.moe_norm_topk_prob,
         decoder_sparse_step=1,
         mlp_only_layers=[],
+        sliding_window=cfg.sliding_window,
+        use_sliding_window=cfg.sliding_window is not None,
         torch_dtype="bfloat16",
     )
 
