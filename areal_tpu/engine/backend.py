@@ -36,7 +36,9 @@ def make_model(
     """Build an uninitialized Model bundle.
 
     Abstraction types:
-      - ``hf``: args {path, is_critic?, dtype?, remat?} — HF checkpoint dir
+      - ``hf``: args {path, is_critic?, dtype?, plus any TransformerConfig
+        field (remat, remat_policy, pipe_microbatches, cp_impl, ...) as a
+        post-load override} — HF checkpoint dir; unknown keys raise
       - ``random``: args {config: dict | TransformerConfig kwargs, seed?} —
         random init (tests / from-scratch)
     """
@@ -51,12 +53,27 @@ def make_model(
     if cfg.type_ == "hf":
         from areal_tpu.models.hf.registry import load_hf_config, load_hf_model
 
-        overrides = {
+        # every TransformerConfig field is a post-load override (remat,
+        # remat_policy, pipe_microbatches, cp_impl, ...); unknown keys are
+        # typos and must fail BEFORE the multi-GB checkpoint read
+        cfg_fields = {f.name for f in dataclasses.fields(TransformerConfig)}
+        unknown = set(cfg.args) - cfg_fields - {"path", "is_critic", "dtype"}
+        if unknown:
+            raise ValueError(
+                f"unknown hf model args {sorted(unknown)}; valid: path, "
+                f"is_critic, dtype, or any TransformerConfig field"
+            )
+        load_overrides = {
             k: v for k, v in cfg.args.items() if k in ("is_critic", "dtype")
         }
-        model_cfg, params = load_hf_model(cfg.args["path"], **overrides)
-        if cfg.args.get("remat"):
-            model_cfg = dataclasses.replace(model_cfg, remat=True)
+        model_cfg, params = load_hf_model(cfg.args["path"], **load_overrides)
+        post = {
+            k: v
+            for k, v in cfg.args.items()
+            if k in cfg_fields and k not in load_overrides
+        }
+        if post:
+            model_cfg = dataclasses.replace(model_cfg, **post)
         family, _, _ = load_hf_config(cfg.args["path"])
         backend_name = family.name
     elif cfg.type_ == "random":

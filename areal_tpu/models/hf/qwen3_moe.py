@@ -35,6 +35,17 @@ def _config_from_hf(hf: Dict[str, Any]) -> TransformerConfig:
             "qwen3_moe with attention_bias=True is not supported: the "
             "adapter would silently drop the q/k/v/o bias tensors"
         )
+    if hf.get("use_sliding_window") and hf.get(
+        "max_window_layers", 0
+    ) not in (0, hf["num_hidden_layers"]):
+        # HF applies SWA only to layers >= max_window_layers; our stacked
+        # scan applies one window to EVERY layer — heterogeneous configs
+        # would silently diverge
+        raise NotImplementedError(
+            "qwen3_moe with per-layer sliding-window gating "
+            "(max_window_layers) is not supported: the layer scan applies "
+            "a uniform window"
+        )
     head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
     return TransformerConfig(
         sliding_window=(

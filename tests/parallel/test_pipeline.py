@@ -102,9 +102,16 @@ def test_pipelined_forward_rows_not_divisible():
     assert err < 2e-4, err
 
 
-def test_pipelined_train_step_matches_plain():
-    """One optimizer step on a p2 mesh == the same step unpipelined."""
-    cfg = tiny_config(vocab_size=64)
+@pytest.mark.parametrize(
+    "remat,remat_policy", [(False, "none"), (True, "qkv_attn")]
+)
+def test_pipelined_train_step_matches_plain(remat, remat_policy):
+    """One optimizer step on a p2 mesh == the same step unpipelined —
+    with and without per-layer remat (jax.checkpoint must survive AD
+    through the shard_map pipeline)."""
+    cfg = dataclasses.replace(
+        tiny_config(vocab_size=64), remat=remat, remat_policy=remat_policy
+    )
     opt = OptimizerConfig(lr=1e-2, lr_scheduler_type="constant",
                           warmup_steps_proportion=0.0)
     sample = make_sample(8, 64, seed=3)
@@ -129,6 +136,9 @@ def test_pipelined_train_step_matches_plain():
 
     assert np.isclose(ref_stats["loss"], pp_stats["loss"], atol=2e-4)
     assert np.isclose(ref_stats["n_tokens"], pp_stats["n_tokens"])
+    assert np.isclose(
+        ref_stats["grad_norm"], pp_stats["grad_norm"], rtol=1e-3
+    )
     for pr, pp in zip(
         jax.tree.leaves(e_ref.params), jax.tree.leaves(e_pp.params)
     ):
