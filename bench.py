@@ -190,7 +190,10 @@ def bench_prefix_reuse(cfg, params, n_reqs=32, group_size=8, prompt_len=512):
             )
 
     def admit_time(n_unique, tag):
-        eng = make_engine(cfg, params, n_reqs, prompt_len, 4, chunk=4)
+        # engine shapes match bench_generation's b32 run (same cache bucket
+        # and chunk), so every decode/prefill jit EXCEPT the m-unique
+        # admission bucket is already compiled — keeps bench wall time flat
+        eng = make_engine(cfg, params, n_reqs, prompt_len, 512, chunk=128)
         submit(eng, n_unique, f"w{tag}")  # warmup: compile this m-bucket
         drain(eng)
         base_toks = eng.prefill_tokens_total
