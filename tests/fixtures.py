@@ -99,6 +99,13 @@ def mixed_dataset_path(save_path):
 
 
 @pytest.fixture
+def tokenizer_path(tokenizer, save_path):
+    p = str(save_path / "tokenizer")
+    tokenizer.save_pretrained(p)
+    return p
+
+
+@pytest.fixture
 def tokenizer(dataset, save_path):
     from tokenizers import Tokenizer
     from tokenizers.models import WordPiece

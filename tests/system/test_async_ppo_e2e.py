@@ -4,7 +4,6 @@ post-train weight publication hot-swapping the generation servers
 (the reference's boba asynchronous pipeline, SURVEY.md §3.1/3.2)."""
 
 import numpy as np
-import pytest
 
 from tests.fixtures import (  # noqa: F401
     dataset,
@@ -12,14 +11,8 @@ from tests.fixtures import (  # noqa: F401
     mixed_dataset_path,
     save_path,
     tokenizer,
+    tokenizer_path,
 )
-
-
-@pytest.fixture
-def tokenizer_path(tokenizer, save_path):
-    p = str(save_path / "tokenizer")
-    tokenizer.save_pretrained(p)
-    return p
 
 
 def test_async_ppo_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch):

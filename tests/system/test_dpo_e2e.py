@@ -1,6 +1,6 @@
-"""End-to-end SFT experiment on the threaded local runner
-(mirrors the reference's CPU e2e test tests/experiments/test_sft.py via
-run_test_exp, tests/experiments/utils.py:52)."""
+"""End-to-end DPO experiment on the threaded local runner: rw_pair dataset
+-> ref_inf MFC (frozen reference logps) -> dpo_train MFC, through the full
+master/model-worker machinery (same harness as test_sft_e2e)."""
 
 import numpy as np
 
@@ -13,7 +13,7 @@ from tests.fixtures import (  # noqa: F401
 )
 
 
-def test_sft_experiment_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch):
+def test_dpo_experiment_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch):
     monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
     monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
 
@@ -22,10 +22,10 @@ def test_sft_experiment_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch)
     from areal_tpu.apps.local_runner import run_experiment_local
     from areal_tpu.base.topology import MeshSpec
     from areal_tpu.engine.optimizer import OptimizerConfig
-    from areal_tpu.experiments.sft_exp import SFTExperiment
+    from areal_tpu.experiments.dpo_exp import DPOExperiment
 
-    exp = SFTExperiment(
-        experiment_name="test-sft",
+    exp = DPOExperiment(
+        experiment_name="test-dpo",
         trial_name="e2e",
         n_model_workers=2,
         mesh_spec=MeshSpec(data=2, model=2),
@@ -33,28 +33,32 @@ def test_sft_experiment_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch)
             total_train_epochs=2, benchmark_steps=4
         ),
         tokenizer_path=tokenizer_path,
-        model=ModelAbstraction(
+        actor=ModelAbstraction(
             "random", {"vocab_size": 256, "max_position_embeddings": 512}
         ),
         dataset=DatasetAbstraction(
-            "prompt_answer",
+            "rw_pair",
             {"dataset_path": dataset_path, "max_length": 128},
         ),
         train_bs_n_seqs=8,
+        beta=0.5,
         optimizer=OptimizerConfig(lr=1e-3),
     )
     cfg = exp.initial_setup()
     assert len(cfg.model_workers) == 2
+    assert {r.name for r in cfg.master.model_rpcs} == {
+        "ref_inf", "dpo_train",
+    }
     master = run_experiment_local(cfg, timeout=300)
 
-    assert len(master.stats_history) >= 4
     losses = [
-        s["trainDefault/loss"]
+        s["dpo_train/loss"]
         for s in master.stats_history
-        if "trainDefault/loss" in s
+        if "dpo_train/loss" in s
     ]
     assert len(losses) >= 4
     assert all(np.isfinite(l) for l in losses)
-    # training on random tiny data should still reduce loss from step 1 to
-    # the last step (lr is high and the dataset is tiny/repetitive)
-    assert losses[-1] < losses[0]
+    # actor and ref start identical, so step-1 loss is exactly log(2)
+    assert abs(losses[0] - np.log(2.0)) < 5e-2, losses[0]
+    # preference training must separate chosen from rejected
+    assert losses[-1] < losses[0], losses

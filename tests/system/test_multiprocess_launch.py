@@ -9,16 +9,15 @@ import os
 
 import pytest
 
-from tests.fixtures import dataset, dataset_path, save_path, tokenizer  # noqa: F401
+from tests.fixtures import (  # noqa: F401
+    dataset,
+    dataset_path,
+    save_path,
+    tokenizer,
+    tokenizer_path,
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
-
-
-@pytest.fixture
-def tokenizer_path(tokenizer, save_path):
-    p = str(save_path / "tokenizer")
-    tokenizer.save_pretrained(p)
-    return p
 
 
 @pytest.fixture

@@ -2,16 +2,14 @@
 (mirrors the reference's CPU e2e test tests/experiments/test_math_ppo.py)."""
 
 import numpy as np
-import pytest
 
-from tests.fixtures import dataset, dataset_path, save_path, tokenizer  # noqa: F401
-
-
-@pytest.fixture
-def tokenizer_path(tokenizer, save_path):
-    p = str(save_path / "tokenizer")
-    tokenizer.save_pretrained(p)
-    return p
+from tests.fixtures import (  # noqa: F401
+    dataset,
+    dataset_path,
+    save_path,
+    tokenizer,
+    tokenizer_path,
+)
 
 
 def _make_exp(dataset_path, tokenizer_path, **ppo_kwargs):

@@ -9,16 +9,14 @@ import glob
 import os
 
 import numpy as np
-import pytest
 
-from tests.fixtures import dataset, dataset_path, save_path, tokenizer  # noqa: F401
-
-
-@pytest.fixture
-def tokenizer_path(tokenizer, save_path):
-    p = str(save_path / "tokenizer")
-    tokenizer.save_pretrained(p)
-    return p
+from tests.fixtures import (  # noqa: F401
+    dataset,
+    dataset_path,
+    save_path,
+    tokenizer,
+    tokenizer_path,
+)
 
 
 def _make(dataset_path, tokenizer_path, benchmark_steps):
