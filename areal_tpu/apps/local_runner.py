@@ -38,6 +38,7 @@ def register_impls():
     import areal_tpu.experiments.dpo_exp  # noqa: F401
     import areal_tpu.experiments.null_exp  # noqa: F401
     import areal_tpu.experiments.ppo_math_exp  # noqa: F401
+    import areal_tpu.experiments.rm_exp  # noqa: F401
     import areal_tpu.experiments.sft_exp  # noqa: F401
     import areal_tpu.interfaces.dpo_interface  # noqa: F401
     import areal_tpu.interfaces.fused_interface  # noqa: F401

@@ -1,0 +1,144 @@
+"""Reward-model TRAINING interface: pairwise Bradley-Terry on the critic
+head.
+
+Completes the classic RLHF triple (SFT -> RM -> PPO) next to DPO: the
+paired dataset (areal_tpu/data/rw_paired_dataset.py packs each prompt's
+answers as [chosen, rejected, ...]) trains a scalar scorer, and
+``inference`` emits per-sequence ``rewards`` — the trained-RM drop-in for
+the rule-based verifier in the PPO graph (reference role:
+realhf/impl/dataset/rw_paired_dataset.py feeding ReaLHF-era RM training;
+the surveyed revision keeps the dataset but ships only the rule-based
+MultiTaskRewardInterface, realhf/impl/model/interface/math_rw_interface.py).
+
+A sequence's score is the critic value at its LAST valid token; the loss
+is ``-logsigmoid(score_chosen - score_rejected)`` per pair.  Pairing
+reuses the DPO machinery: per-token sign/pair-id keys plus a segment sum,
+with a bucketed static pair capacity (pairs never straddle micro-batches
+because SequenceSample.split keeps ids whole).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api import model_api
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base import logging_, stats_tracker
+from areal_tpu.interfaces.dpo_interface import DPOInterface, _next_pow2
+from areal_tpu.interfaces.ppo_interface import critic_values_fwd
+from areal_tpu.models.transformer import forward
+from areal_tpu.ops.dpo import dpo_pair_loss
+
+logger = logging_.getLogger("rm_interface")
+
+
+def rm_pairwise_loss_fn(n_pairs: int):
+    """Engine LossFn: Bradley-Terry over (chosen, rejected) last-token
+    scores.  ``n_pairs`` is the bucketed static pair capacity."""
+
+    def fn(params, cfg, batch):
+        assert cfg.is_critic, "RM training needs a critic-head model"
+        values = forward(
+            params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
+        ).astype(jnp.float32)  # [B, T]
+        seq_lens = batch["seq_lens"]
+        last_idx = jnp.maximum(seq_lens - 1, 0)
+        score = jnp.take_along_axis(values, last_idx[:, None], axis=1)[:, 0]
+        real = seq_lens > 0  # padding rows score 0 into pair 0, masked below
+
+        sign = batch["dpo_sign"][:, 0].astype(jnp.float32) * real
+        pair = batch["dpo_pair"][:, 0].astype(jnp.int32)
+        pair_margin = jax.ops.segment_sum(
+            score * sign, pair, num_segments=n_pairs
+        )
+        members = jax.ops.segment_sum(
+            real.astype(jnp.float32), pair, num_segments=n_pairs
+        )
+        valid = members >= 2  # both pair members present
+        # beta=1, ref_logratios=0: plain -logsigmoid(margin)
+        loss_sum, n_valid, stats = dpo_pair_loss(
+            pair_margin, jnp.zeros_like(pair_margin), valid, 1.0
+        )
+        stats = dict(stats)
+        stats["score_abs_sum"] = jnp.sum(jnp.abs(score) * real)
+        stats["n_seqs"] = jnp.sum(real.astype(jnp.float32))
+        return loss_sum, n_valid, stats
+
+    fn._cache_key = ("rm_pairwise_loss_fn", int(n_pairs))
+    return fn
+
+
+@dataclasses.dataclass
+class RewardModelInterface(model_api.ModelInterface):
+    token_key: str = "packed_input_ids"
+
+    def train_step(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> Dict:
+        engine = model.engine
+        # reuse DPO's pairing amendment (same [chosen, rejected, ...] order)
+        data = DPOInterface(token_key=self.token_key)._amend_pairing(data)
+        n_seqs = sum(len(ls) for ls in data.seqlens[self.token_key])
+        cap = _next_pow2(max(1, n_seqs // 2))
+        stats = engine.train_batch(
+            data, rm_pairwise_loss_fn(cap), mb_spec, token_key=self.token_key
+        )
+        model.version.advance(
+            model.ft_spec.steps_per_epoch if model.ft_spec else int(1e9)
+        )
+        n_pairs = max(stats.get("n_tokens", 1.0), 1.0)
+        with stats_tracker.scope("rm"):
+            stats_tracker.scalar(
+                loss=stats["loss"],
+                margin=stats.get("margin_sum", 0.0) / n_pairs,
+                pair_acc=stats.get("reward_acc_sum", 0.0) / n_pairs,
+                grad_norm=stats["grad_norm"],
+                n_pairs=n_pairs,
+            )
+        return stats
+
+    def inference(
+        self,
+        model: model_api.Model,
+        data: SequenceSample,
+        mb_spec: MicroBatchSpec,
+    ) -> SequenceSample:
+        """Per-sequence scalar rewards from the trained scorer (the
+        trained-RM replacement for the rule-based verifier's ``rewards``
+        output in the PPO graph)."""
+        engine = model.engine
+        values = engine.forward_batch(
+            data, critic_values_fwd, mb_spec, token_key=self.token_key
+        )
+        # packed per-token values, original order -> last-token per sequence
+        lens = [l for ls in data.seqlens[self.token_key] for l in ls]
+        offsets = np.concatenate([[0], np.cumsum(lens)])
+        scores = np.asarray(
+            [values[offsets[i + 1] - 1] for i in range(len(lens))],
+            np.float32,
+        )
+        group_sizes = [len(ls) for ls in data.seqlens[self.token_key]]
+        return SequenceSample(
+            keys={"rewards"},
+            trailing_shapes={"rewards": ()},
+            dtypes={"rewards": np.dtype(np.float32)},
+            ids=data.ids,
+            seqlens={"rewards": [[1] * g for g in group_sizes]},
+            data={"rewards": scores},
+        )
+
+    def save(self, model: model_api.Model, save_dir: str):
+        model.engine.save_hf(
+            save_dir, model.backend_name or "llama", model.tokenizer
+        )
+
+
+model_api.register_interface("rw_train", RewardModelInterface)
