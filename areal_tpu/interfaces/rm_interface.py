@@ -135,6 +135,25 @@ class RewardModelInterface(model_api.ModelInterface):
             data={"rewards": scores},
         )
 
+    def evaluate(self, model: model_api.Model, eval_dataloader) -> Dict:
+        """Held-out pair accuracy: fraction of (chosen, rejected) pairs the
+        scorer orders correctly (sequences alternate chosen/rejected in
+        packed order)."""
+        if eval_dataloader is None:  # evaluate MFC without an eval dataset
+            return {}
+        correct = total = 0
+        for sample in eval_dataloader:
+            rewards = self.inference(
+                model, sample, MicroBatchSpec()
+            ).data["rewards"]
+            chosen, rejected = rewards[0::2], rewards[1::2]
+            correct += int((chosen > rejected).sum())
+            total += len(chosen)
+        return {
+            "eval_pair_acc": correct / max(total, 1),
+            "eval_pairs": float(total),
+        }
+
     def save(self, model: model_api.Model, save_dir: str):
         model.engine.save_hf(
             save_dir, model.backend_name or "llama", model.tokenizer

@@ -26,10 +26,10 @@ from areal_tpu.models.transformer import init_params
 VOCAB = 64
 
 
-def make_model(is_critic=False, seed=0):
+def make_model(is_critic=False, seed=0, mesh_spec=None, devices=None):
     cfg = tiny_config(vocab_size=VOCAB, is_critic=is_critic)
     params = init_params(cfg, jax.random.PRNGKey(seed))
-    mesh = MeshSpec(data=2, fsdp=2, model=2).make_mesh()
+    mesh = (mesh_spec or MeshSpec(data=2, fsdp=2, model=2)).make_mesh(devices)
     engine = TrainEngine(
         cfg,
         mesh,
@@ -45,6 +45,26 @@ def make_model(is_critic=False, seed=0):
         ft_spec=FinetuneSpec(1, 100, 10),
     )
     return model
+
+
+def make_rollout(actor, seed=0):
+    """Generate a small PPO rollout with random rewards attached."""
+    prompts = make_prompts(seed=seed)
+    g = GenerationHyperparameters(n=2, max_new_tokens=6, temperature=1.0)
+    sample = generate_for_sample(actor, prompts, g)
+    rng = np.random.RandomState(seed)
+    sample.update_(
+        SequenceSample.from_default(
+            [l[0] for l in sample.seqlens["packed_input_ids"]],
+            sample.ids,
+            {
+                "rewards": rng.uniform(-1, 1, size=sample.bs).astype(
+                    np.float32
+                )
+            },
+        )
+    )
+    return sample
 
 
 def make_prompts(bs=4, seed=0):
@@ -63,17 +83,7 @@ def make_prompts(bs=4, seed=0):
 @pytest.fixture(scope="module")
 def rollout():
     actor = make_model()
-    prompts = make_prompts()
-    g = GenerationHyperparameters(n=2, max_new_tokens=6, temperature=1.0)
-    sample = generate_for_sample(actor, prompts, g)
-    rng = np.random.RandomState(0)
-    rewards = SequenceSample.from_default(
-        [l[0] for l in sample.seqlens["packed_input_ids"]],
-        sample.ids,
-        {"rewards": rng.uniform(-1, 1, size=sample.bs).astype(np.float32)},
-    )
-    sample.update_(rewards)
-    return actor, sample
+    return actor, make_rollout(actor)
 
 
 def test_generate_produces_ppo_keys(rollout):

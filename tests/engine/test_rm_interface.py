@@ -61,6 +61,10 @@ def test_rm_learns_pair_order_and_scores():
     chosen, rejected = rewards[0::2], rewards[1::2]
     assert (chosen > rejected).mean() >= 0.75, rewards
 
+    ev = iface.evaluate(model, [sample])
+    assert ev["eval_pairs"] == 4.0
+    assert ev["eval_pair_acc"] >= 0.75, ev
+
 
 def test_rm_microbatch_split_invariance():
     sample = make_paired_sample(n_prompts=4, seed=8)

@@ -196,6 +196,38 @@ def test_pipelined_moe_aux_losses_flow():
     assert np.isclose(ref_stats["loss"], pp_stats["loss"], atol=5e-3)
 
 
+def test_ppo_actor_train_under_pipeline():
+    """The RL path composes with PP: the PPO actor loss (per-token extras,
+    GAE prep, chunked logprob head) runs on a pipe mesh and reproduces the
+    unpipelined update's loss."""
+    from areal_tpu.api.data import SequenceSample
+    from areal_tpu.interfaces.ppo_interface import PPOActorInterface
+
+    from tests.engine.test_ppo_interface import make_model, make_rollout
+
+    # rollout from a plain-mesh actor (generation does not pipeline)
+    sample = make_rollout(
+        make_model(seed=42, mesh_spec=MeshSpec(data=1),
+                   devices=jax.devices()[:1])
+    )
+
+    losses = {}
+    for tag, spec, devs in (
+        ("plain", MeshSpec(data=1), jax.devices()[:1]),
+        ("pipe", MeshSpec(pipe=2, data=2, model=2), None),
+    ):
+        actor = make_model(seed=42, mesh_spec=spec, devices=devs)
+        iface = PPOActorInterface(
+            n_minibatches=2, adv_norm=True, disable_value=True, kl_ctl=0.1
+        )
+        s = SequenceSample.gather([sample])  # private copy
+        s.update_(iface.inference(actor, s, MicroBatchSpec()))
+        stats = iface.train_step(actor, s, MicroBatchSpec())
+        assert np.isfinite(stats["loss"]), (tag, stats)
+        losses[tag] = stats["loss"]
+    assert np.isclose(losses["plain"], losses["pipe"], atol=5e-4), losses
+
+
 def test_pipe_times_seq_rejected():
     cfg = tiny_config(vocab_size=64)
     params = init_params(cfg, jax.random.PRNGKey(0))
