@@ -138,17 +138,39 @@ class RewardModelInterface(model_api.ModelInterface):
     def evaluate(self, model: model_api.Model, eval_dataloader) -> Dict:
         """Held-out pair accuracy: fraction of (chosen, rejected) pairs the
         scorer orders correctly (sequences alternate chosen/rejected in
-        packed order)."""
+        packed order).  Rows are gathered into batches before inference —
+        the eval dataset yields one small sample per prompt, and a
+        dispatch per row would pay a jit round-trip for 2-4 sequences."""
         if eval_dataloader is None:  # evaluate MFC without an eval dataset
             return {}
         correct = total = 0
-        for sample in eval_dataloader:
+        buf = []
+
+        def flush():
+            nonlocal correct, total
+            if not buf:
+                return
+            batch = SequenceSample.gather(buf)
+            buf.clear()
+            groups = batch.seqlens[self.token_key]
+            # flat even/odd pairing below requires every group even-sized;
+            # an odd group would silently shift chosen/rejected for every
+            # later prompt
+            assert all(len(ls) % 2 == 0 for ls in groups), (
+                "RM eval data has an odd-sized answer group"
+            )
             rewards = self.inference(
-                model, sample, MicroBatchSpec()
+                model, batch, MicroBatchSpec()
             ).data["rewards"]
             chosen, rejected = rewards[0::2], rewards[1::2]
             correct += int((chosen > rejected).sum())
             total += len(chosen)
+
+        for sample in eval_dataloader:
+            buf.append(sample)
+            if len(buf) >= 64:
+                flush()
+        flush()
         return {
             "eval_pair_acc": correct / max(total, 1),
             "eval_pairs": float(total),

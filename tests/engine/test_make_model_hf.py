@@ -1,0 +1,58 @@
+"""make_model('hf'): TransformerConfig post-load overrides and the
+fail-before-checkpoint-read typo guard."""
+
+import jax
+import pytest
+
+from areal_tpu.api.config import ModelAbstraction, ModelName
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.engine.backend import make_model
+
+from tests.model.test_hf_parity import _tiny_hf_model
+
+
+@pytest.fixture(scope="module")
+def hf_path(tmp_path_factory):
+    _, path = _tiny_hf_model("llama", tmp_path_factory.mktemp("hf"))
+    return path
+
+
+def test_config_field_overrides_apply(hf_path):
+    mesh = MeshSpec(data=1).make_mesh(jax.devices()[:1])
+    model = make_model(
+        ModelAbstraction(
+            "hf",
+            {
+                "path": hf_path,
+                "remat": True,
+                "remat_policy": "qkv_attn",
+                "pipe_microbatches": 4,
+                "cp_impl": "ulysses",
+            },
+        ),
+        ModelName("m"),
+        mesh,
+    )
+    cfg = model.model_cfg
+    assert cfg.remat and cfg.remat_policy == "qkv_attn"
+    assert cfg.pipe_microbatches == 4
+    assert cfg.cp_impl == "ulysses"
+
+
+def test_unknown_arg_rejected_before_load(hf_path, monkeypatch):
+    # the guard must fire WITHOUT touching the checkpoint
+    import areal_tpu.models.hf.registry as registry
+
+    def boom(*a, **k):
+        raise AssertionError("checkpoint was read before the typo check")
+
+    monkeypatch.setattr(registry, "load_hf_model", boom)
+    mesh = MeshSpec(data=1).make_mesh(jax.devices()[:1])
+    with pytest.raises(ValueError, match="remat_polcy"):
+        make_model(
+            ModelAbstraction(
+                "hf", {"path": hf_path, "remat_polcy": "qkv_attn"}
+            ),
+            ModelName("m"),
+            mesh,
+        )
