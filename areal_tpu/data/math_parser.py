@@ -131,6 +131,16 @@ _UNIT_WORDS = [
     "acres", "acre", "hectares", "hectare", "ohm", "number", "ratio",
 ]
 
+# multi-letter unit abbreviations that may sit DIRECTLY against a digit
+# ("42km") without being mistakable for a variable product; single-letter
+# symbols (m, g, s) are never in this list
+_ADJ_UNITS = [
+    "kmph", "kmh", "mph", "lbs", "hrs", "deg", "gal", "sec", "min",
+    "km", "cm", "mm", "kg", "mg", "gm", "ml", "sq", "cu", "ft", "lb",
+    "oz", "cc", "hr",
+]
+_ADJ_UNIT_RE = "(?:" + "|".join(_ADJ_UNITS) + ")"
+
 
 def _strip_unit_words(s: str) -> str:
     """Drop measurement words ANCHORED TO A NUMBER ("42 sq miles" -> "42").
@@ -154,6 +164,16 @@ def _strip_unit_words(s: str) -> str:
             # a unit word that IS the whole answer survives
             if t.strip(" {}()[].,"):
                 s = t
+        # digit-ADJACENT multi-letter units ("42km", "3.5sq"): only
+        # unambiguous unit abbreviations — single letters stay
+        # separator-required so "2m" remains the monomial 2*m
+        # lookahead rejects letters AND digits/'(' so "2sec(x)" (secant),
+        # "3min(2,4)" and "42km2" (km^2) survive (code-review r5)
+        t = re.sub(
+            r"(\d)" + _ADJ_UNIT_RE + r"(?![a-zA-Z0-9(])", r"\1", s
+        )
+        if t.strip(" {}()[].,"):
+            s = t
     return s
 
 

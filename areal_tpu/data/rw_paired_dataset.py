@@ -35,11 +35,15 @@ class RewardModelingPairedDataset(torch.utils.data.Dataset):
         tok = util.tokenizer
         self.ids = [str(d["id"]) for d in data]
         self.token_groups: List[List[List[int]]] = []
+        self.prompt_lens: List[List[int]] = []  # per sequence, same order
         for d in data:
             pairs = list(zip(d["pos_answers"], d["neg_answers"]))[
                 :max_pairs_per_prompt
             ]
-            group = []
+            p_ids = tok(
+                d["prompt"], padding=False, return_attention_mask=False
+            )["input_ids"]
+            group, plens = [], []
             for pos, neg in pairs:
                 for ans in (pos, neg):
                     enc = tok(
@@ -49,8 +53,24 @@ class RewardModelingPairedDataset(torch.utils.data.Dataset):
                         padding=False,
                         return_attention_mask=False,
                     )
-                    group.append(enc["input_ids"])
+                    ids = enc["input_ids"]
+                    group.append(ids)
+                    # prompt span = longest common prefix with the bare
+                    # prompt encoding: a BPE merge across the prompt/answer
+                    # boundary shortens the prefix, and the merged token is
+                    # then counted as RESPONSE (trained, not masked) — so
+                    # downstream losses never depend on the two pair
+                    # members tokenizing the boundary identically
+                    n = 0
+                    while (
+                        n < len(p_ids)
+                        and n < len(ids)
+                        and ids[n] == p_ids[n]
+                    ):
+                        n += 1
+                    plens.append(n)
             self.token_groups.append(group)
+            self.prompt_lens.append(plens)
 
     def __len__(self):
         return len(self.ids)
@@ -59,13 +79,23 @@ class RewardModelingPairedDataset(torch.utils.data.Dataset):
         group = self.token_groups[idx]
         packed = np.concatenate([np.array(g, dtype=np.int32) for g in group])
         n_pairs = len(group) // 2
+        lens = [[len(g) for g in group]]
+        pmask = np.concatenate(
+            [
+                (np.arange(len(g)) < plen)
+                for g, plen in zip(group, self.prompt_lens[idx])
+            ]
+        ).astype(bool)
         return SequenceSample(
-            keys={"packed_input_ids"},
-            trailing_shapes={"packed_input_ids": ()},
-            dtypes={"packed_input_ids": np.dtype(np.int32)},
+            keys={"packed_input_ids", "prompt_mask"},
+            trailing_shapes={"packed_input_ids": (), "prompt_mask": ()},
+            dtypes={
+                "packed_input_ids": np.dtype(np.int32),
+                "prompt_mask": np.dtype(bool),
+            },
             ids=[self.ids[idx]],
-            seqlens={"packed_input_ids": [[len(g) for g in group]]},
-            data={"packed_input_ids": packed},
+            seqlens={"packed_input_ids": lens, "prompt_mask": lens},
+            data={"packed_input_ids": packed, "prompt_mask": pmask},
             metadata={"group_factor": [1 / n_pairs]},
         )
 

@@ -65,7 +65,9 @@ class DPOExperiment(CommonExperimentConfig):
             model_name=actor,
             interface_type=ModelInterfaceType.TRAIN_STEP,
             interface_impl=iface,
-            input_keys=("packed_input_ids", "packed_ref_logprobs"),
+            input_keys=(
+                "packed_input_ids", "prompt_mask", "packed_ref_logprobs"
+            ),
             n_seqs=n,
             mb_spec=self.mb_spec,
             log_return_value=True,

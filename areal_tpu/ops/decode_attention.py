@@ -37,6 +37,62 @@ DEFAULT_BLOCK = 256
 _NEG_INF = -1e30
 
 
+def softmax_scratch_init(s_acc, s_m, s_l):
+    """Reset the online-softmax VMEM scratch at the first grid block
+    (shared with ops/paged_attention.py)."""
+    s_acc[:] = jnp.zeros_like(s_acc)
+    s_m[:] = jnp.full_like(s_m, _NEG_INF)
+    s_l[:] = jnp.zeros_like(s_l)
+
+
+def softmax_block_update(
+    q_ref, k_ref, v_ref, s_acc, s_m, s_l, *, base, length, scale
+):
+    """One KV block's online-softmax update over (rows, hd) queries —
+    the SINGLE definition of the decode-attention numerics, used by both
+    the contiguous (flash_decode) and paged kernels.
+
+    HIGHEST precision on both dots: f32 MXU dots default to single-pass
+    bf16 rounding (measured 0.1 abs output error at 4k lengths vs 6e-5
+    with 3-pass) and decode is HBM-bound, so the extra passes are free.
+    """
+    q = q_ref[0, 0].astype(jnp.float32)  # (rows, hd)
+    k = k_ref[0, 0].astype(jnp.float32)  # (BS, hd)
+    s = (
+        jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # (rows, BS)
+    pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < length, s, _NEG_INF)
+
+    m_prev = s_m[:, 0]  # (rows,)
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.exp(s - m_cur[:, None])  # (rows, BS)
+    v = v_ref[0, 0].astype(jnp.float32)  # (BS, hd)
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )  # (rows, hd)
+    s_acc[:] = s_acc[:] * alpha[:, None] + pv
+    s_l[:] = s_l[:] * alpha[:, None] + jnp.sum(p, axis=1)[:, None]
+    s_m[:] = jnp.broadcast_to(m_cur[:, None], s_m.shape)
+
+
+def softmax_emit(acc_ref, m_ref, l_ref, s_acc, s_m, s_l):
+    """Write the scratch state out at the last grid block."""
+    acc_ref[0, 0] = s_acc[:]
+    m_ref[0, 0] = s_m[:]
+    l_ref[0, 0] = s_l[:]
+
+
 def _kernel(
     lengths_ref,  # scalar prefetch [B]
     q_ref,  # (1, 1, r, hd)
@@ -58,47 +114,21 @@ def _kernel(
 
     @pl.when(j == 0)
     def _init():
-        s_acc[:] = jnp.zeros_like(s_acc)
-        s_m[:] = jnp.full_like(s_m, _NEG_INF)
-        s_l[:] = jnp.zeros_like(s_l)
+        softmax_scratch_init(s_acc, s_m, s_l)
 
     length = lengths_ref[b]
     base = j * block_size
 
     @pl.when(base < length)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)  # (r, hd)
-        k = k_ref[0, 0].astype(jnp.float32)  # (BS, hd)
-        s = (
-            jax.lax.dot_general(
-                q,
-                k,
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )  # (r, BS)
-        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < length, s, _NEG_INF)
-
-        m_prev = s_m[:, 0]  # (r,)
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))  # (r,)
-        alpha = jnp.exp(m_prev - m_cur)  # (r,)
-        p = jnp.exp(s - m_cur[:, None])  # (r, BS)
-        v = v_ref[0, 0].astype(jnp.float32)  # (BS, hd)
-        pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (r, hd)
-        s_acc[:] = s_acc[:] * alpha[:, None] + pv
-        s_l[:] = s_l[:] * alpha[:, None] + jnp.sum(p, axis=1)[:, None]
-        s_m[:] = jnp.broadcast_to(m_cur[:, None], s_m.shape)
+        softmax_block_update(
+            q_ref, k_ref, v_ref, s_acc, s_m, s_l,
+            base=base, length=length, scale=scale,
+        )
 
     @pl.when(j == nb - 1)
     def _emit():
-        acc_ref[0, 0] = s_acc[:]
-        m_ref[0, 0] = s_m[:]
-        l_ref[0, 0] = s_l[:]
+        softmax_emit(acc_ref, m_ref, l_ref, s_acc, s_m, s_l)
 
 
 def _clamped_kv_map(b, h, j, lengths_ref, *, block_size):

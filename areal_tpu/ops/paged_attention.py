@@ -1,0 +1,232 @@
+"""Pallas paged flash attention over a block-pool KV cache.
+
+In-house TPU kernel for the serving engine's paged KV cache (the role
+SGLang/vLLM paged decode kernels play behind the reference's generation
+server, reference: realhf/impl/model/backend/sglang.py:369 + SURVEY §2.8
+"splash/paged attention kernels").  KV lives in a shared pool of
+fixed-size blocks ``[Hkv, NB, BS, hd]``; each batch row owns an ordered
+list of pool block ids (its *block table*), so cache capacity is
+allocated in BS-token pages instead of dense ``max_len`` rows — the
+difference between a handful of 32k rows fitting one chip and dozens.
+
+Kernel shape:
+
+* grid ``(B, Hkv, MB)`` — MB is the static per-row block capacity; the
+  minor axis iterates sequentially on TPU so online-softmax state
+  (m/l/acc) lives in VMEM scratch across blocks;
+* the K/V index maps ride TWO scalar-prefetch operands: ``lengths``
+  clamps the block index to each row's last valid block (trailing grid
+  steps re-address the same tile and the pipeline skips their HBM->VMEM
+  copies — short rows stream only the KV they own), and ``tables``
+  translates the clamped logical block index into a pool block id;
+* queries are GQA-grouped AND chunk-grouped: ``q`` carries Q query
+  tokens per row (Q=1 for decode; Q=chunk for chunked prefill's
+  prefix attention) and all Q*r query rows of a (b, h) cell share one
+  streamed KV block — the pool is read once per KV head per block.
+
+Returns UN-normalized partials ``(acc, m, l)`` so the caller online-merges
+them with attention over KV not in the pool yet (the decode chunk's
+in-flight window, or a prefill chunk's causal self-attention).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from areal_tpu.ops.decode_attention import (
+    softmax_block_update,
+    softmax_emit,
+    softmax_scratch_init,
+)
+
+DEFAULT_BLOCK = 256
+_NEG_INF = -1e30
+
+
+def _kernel(
+    lengths_ref,  # scalar prefetch [B]
+    tables_ref,  # scalar prefetch [B, MB]
+    q_ref,  # (1, 1, QR, hd)
+    k_ref,  # (1, 1, BS, hd) — pool block selected by the index map
+    v_ref,  # (1, 1, BS, hd)
+    acc_ref,  # out (1, 1, QR, hd) f32
+    m_ref,  # out (1, 1, QR, 128) f32 (value replicated along lanes)
+    l_ref,  # out (1, 1, QR, 128) f32
+    s_acc,  # scratch (QR, hd) f32
+    s_m,  # scratch (QR, 128) f32
+    s_l,  # scratch (QR, 128) f32
+    *,
+    block_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        softmax_scratch_init(s_acc, s_m, s_l)
+
+    length = lengths_ref[b]
+    base = j * block_size
+
+    @pl.when(base < length)
+    def _block():
+        softmax_block_update(
+            q_ref, k_ref, v_ref, s_acc, s_m, s_l,
+            base=base, length=length, scale=scale,
+        )
+
+    @pl.when(j == nb - 1)
+    def _emit():
+        softmax_emit(acc_ref, m_ref, l_ref, s_acc, s_m, s_l)
+
+
+def _paged_kv_map(b, h, j, lengths_ref, tables_ref, *, block_size):
+    # clamp to the last LOGICAL block holding valid KV for row b, then
+    # translate through the row's block table into a pool block id
+    last = jnp.maximum(
+        (lengths_ref[b] + block_size - 1) // block_size - 1, 0
+    )
+    return (h, tables_ref[b, jnp.minimum(j, last)], 0, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_flash_attention(
+    q: jax.Array,  # [B, Q, Hq, hd]
+    k_pool: jax.Array,  # [Hkv, NB, BS, hd]
+    v_pool: jax.Array,  # [Hkv, NB, BS, hd]
+    tables: jax.Array,  # [B, MB] int32 — pool block id per logical block
+    lengths: jax.Array,  # [B] int32 — valid cache prefix per row
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Un-normalized online-softmax attention partials over paged KV.
+
+    Every query token attends the FULL prefix ``[0, length)`` of its row
+    (decode queries by definition; prefill-chunk queries because the
+    prefix precedes the whole chunk — in-chunk causality is the caller's
+    self-attention term).  Returns ``(acc [B,Q,Hq,hd] f32, m [B,Q,Hq],
+    l [B,Q,Hq])``; rows with ``length == 0`` return ``acc=0, l=0, m=-inf``.
+    """
+    B, Q, Hq, hd = q.shape
+    Hkv, NB, BS, _ = k_pool.shape
+    MB = tables.shape[1]
+    assert Hq % Hkv == 0, (Hq, Hkv)
+    r = Hq // Hkv
+    qg = (
+        q.reshape(B, Q, Hkv, r, hd)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, Hkv, Q * r, hd)
+    )
+
+    grid = (B, Hkv, MB)
+    kv_map = functools.partial(_paged_kv_map, block_size=BS)
+    acc, m, l = pl.pallas_call(
+        functools.partial(_kernel, block_size=BS, scale=1.0 / np.sqrt(hd)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, Q * r, hd), lambda b, h, j, L, T: (b, h, 0, 0)
+                ),
+                pl.BlockSpec((1, 1, BS, hd), kv_map),
+                pl.BlockSpec((1, 1, BS, hd), kv_map),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, 1, Q * r, hd), lambda b, h, j, L, T: (b, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, Q * r, 128), lambda b, h, j, L, T: (b, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, Q * r, 128), lambda b, h, j, L, T: (b, h, 0, 0)
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((Q * r, hd), jnp.float32),
+                pltpu.VMEM((Q * r, 128), jnp.float32),
+                pltpu.VMEM((Q * r, 128), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, Q * r, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, Q * r, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, Q * r, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        tables.astype(jnp.int32),
+        qg,
+        k_pool,
+        v_pool,
+    )
+
+    def unravel(x, lanes):
+        return (
+            x.reshape(B, Hkv, Q, r, lanes)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(B, Q, Hq, lanes)
+        )
+
+    return (
+        unravel(acc, hd),
+        unravel(m, 128)[..., 0],
+        unravel(l, 128)[..., 0],
+    )
+
+
+def gather_paged_kv(
+    k_pool: jax.Array,  # [Hkv, NB, BS, hd] (or [L, Hkv, NB, BS, hd])
+    v_pool: jax.Array,
+    tables: jax.Array,  # [B, MB]
+) -> Tuple[jax.Array, jax.Array]:
+    """Materialize per-row dense KV ``[..., B, Hkv, MB*BS, hd]`` from the
+    pool (jnp reference/CPU path; the kernel never does this)."""
+
+    def g(pool):
+        gathered = jnp.take(pool, tables, axis=-3)  # [..,Hkv,B,MB,BS,hd]
+        gathered = jnp.moveaxis(gathered, -4, -5)  # [..,B,Hkv,MB,BS,hd]
+        s = gathered.shape
+        return gathered.reshape(*s[:-3], s[-3] * s[-2], s[-1])
+
+    return g(k_pool), g(v_pool)
+
+
+def reference_paged_partials(q, k_pool, v_pool, tables, lengths):
+    """jnp reference for :func:`paged_flash_attention` (same contract)."""
+    B, Q, Hq, hd = q.shape
+    Hkv, NB, BS, _ = k_pool.shape
+    r = Hq // Hkv
+    k, v = gather_paged_kv(k_pool, v_pool, tables)  # [B,Hkv,S,hd]
+    S = k.shape[2]
+    qg = q.reshape(B, Q, Hkv, r, hd).astype(jnp.float32)
+    s = jnp.einsum(
+        "bqkrd,bksd->bqkrs", qg, k.astype(jnp.float32)
+    ) / np.sqrt(hd)
+    mask = (
+        jnp.arange(S)[None, None, None, None, :]
+        < lengths[:, None, None, None, None]
+    )
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(mask, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bqkrs,bksd->bqkrd", p, v.astype(jnp.float32))
+    return (
+        acc.reshape(B, Q, Hq, hd),
+        m.reshape(B, Q, Hq),
+        l.reshape(B, Q, Hq),
+    )

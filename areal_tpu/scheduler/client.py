@@ -53,6 +53,30 @@ class JobException(Exception):
         self.reason = reason
 
 
+# resolved at IMPORT time: preexec_fn runs between fork and exec, where an
+# import could deadlock on the interpreter's import lock if another thread
+# held it at fork (code-review r5)
+try:
+    import ctypes as _ctypes
+
+    _libc_prctl = _ctypes.CDLL("libc.so.6", use_errno=True).prctl
+except OSError:  # non-Linux
+    _libc_prctl = None
+
+_PR_SET_PDEATHSIG = 1
+
+
+def _child_setup():
+    """Worker-process pre-exec: own session (so ``killpg`` reaps the whole
+    worker tree) PLUS Linux parent-death signal — if the launcher process is
+    SIGKILLed (a timed-out pytest run, an OOM-killed controller), every
+    worker gets SIGTERM instead of orphaning and burning CPU for hours
+    (advisor r4: timed-out e2e runs left ``apps.remote`` orphans)."""
+    os.setsid()
+    if _libc_prctl is not None:
+        _libc_prctl(_PR_SET_PDEATHSIG, signal.SIGTERM, 0, 0, 0)
+
+
 class SchedulerClient:
     """Submit/stop/wait worker arrays (reference client.py:52)."""
 
@@ -131,7 +155,7 @@ class LocalSchedulerClient(SchedulerClient):
                 env=penv,
                 stdout=stdout,
                 stderr=subprocess.STDOUT if stdout else None,
-                start_new_session=True,
+                preexec_fn=_child_setup,
             )
         finally:
             if stdout is not None:

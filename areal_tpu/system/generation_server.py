@@ -25,6 +25,11 @@ from areal_tpu.system import worker_base
 
 logger = logging_.getLogger("generation_server")
 
+# ctrl-stream high-water mark (messages, each ~100s of bytes): bounds the
+# leader's buffer at ~10s of MB if a follower wedges, yet is ~100x deeper
+# than any observed leader/follower skew, so in practice nothing is dropped
+_CTRL_HWM = 1 << 17
+
 
 class GenerationServerWorker(worker_base.Worker):
     def _configure(self, config: system_api.GenServerConfig):
@@ -108,13 +113,16 @@ class GenerationServerWorker(worker_base.Worker):
             name_resolve.add(base_key, self.addr, replace=True)
             if self._n_procs > 1:
                 # command-stream broadcast to follower controllers.
-                # HWM must be unbounded: the default (1000) silently DROPS
-                # messages under a sustained leader/follower rate mismatch,
-                # and the follower's seq-gap check then kills the server —
-                # lockstep correctness requires every message delivered
-                # (code-review r4 finding)
+                # HWM: the default (1000) silently DROPS messages under a
+                # sustained leader/follower rate mismatch; unbounded (0)
+                # instead buffers without limit and can OOM the leader when
+                # a follower stalls (code-review r4+r5 findings).  A large
+                # FINITE HWM bounds memory while making drops so rare that
+                # one only happens when a follower is truly wedged — and a
+                # drop is LOUD: the follower's seq-gap check kills the
+                # server rather than desyncing the lockstep stream.
                 self._ctrl_pub = self._ctx.socket(zmq.PUB)
-                self._ctrl_pub.setsockopt(zmq.SNDHWM, 0)
+                self._ctrl_pub.setsockopt(zmq.SNDHWM, _CTRL_HWM)
                 cport = self._ctrl_pub.bind_to_random_port("tcp://*")
                 name_resolve.add(
                     ctrl_key,
@@ -134,7 +142,7 @@ class GenerationServerWorker(worker_base.Worker):
         else:
             ctrl_addr = name_resolve.wait(ctrl_key, timeout=120)
             self._ctrl_sub = self._ctx.socket(zmq.SUB)
-            self._ctrl_sub.setsockopt(zmq.RCVHWM, 0)  # never drop (see PUB)
+            self._ctrl_sub.setsockopt(zmq.RCVHWM, _CTRL_HWM)  # see PUB note
             self._ctrl_sub.connect(f"tcp://{ctrl_addr}")
             self._ctrl_sub.setsockopt(zmq.SUBSCRIBE, b"")
             name_resolve.add(

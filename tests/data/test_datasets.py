@@ -61,6 +61,16 @@ def test_rw_paired_dataset(tokenizer, dataset_path):
     lens = s.seqlens["packed_input_ids"][0]
     assert len(lens) % 2 == 0
     assert s.data["packed_input_ids"].shape[0] == sum(lens)
+    # prompt_mask rides with identical seqlens (advisor r4: DPO must not
+    # rely on prompt-logp cancellation); every sequence starts masked
+    # (prompt) and ends unmasked (answer/eos)
+    assert s.seqlens["prompt_mask"] == s.seqlens["packed_input_ids"]
+    pmask = s.data["prompt_mask"]
+    off = 0
+    for L in lens:
+        assert pmask[off]
+        assert not pmask[off + L - 1]
+        off += L
 
 
 def test_dp_sharding(tokenizer, dataset_path, dataset):

@@ -210,6 +210,19 @@ class TestUnitStrippingSafety:
         # with a separator the unit still strips
         assert strip_answer_string("2 m") == "2"
 
+    def test_digit_adjacent_multiletter_units_strip(self):
+        # unambiguous multi-letter abbreviations need no separator
+        # (advisor r4 low: the r4 separator rule stopped stripping these)
+        assert strip_answer_string("42km") == "42"
+        assert strip_answer_string("3.5sq") == "3.5"
+        assert strip_answer_string("10kg") == "10"
+        # ...but single letters still require one
+        assert strip_answer_string("42k") == "42k"
+        # and math-function / exponent forms survive (code-review r5)
+        assert strip_answer_string("2sec(x)") == "2sec(x)"
+        assert strip_answer_string("3min(2,4)") == "3min(2,4)"
+        assert strip_answer_string("42km2") == "42km2"
+
     def test_lowercase_article_not_choice_letter(self):
         # the English article "a" must not grade as choice A (advisor r3)
         assert not math_equal("so the answer is not B but a smaller value", "A")
