@@ -125,6 +125,17 @@ class GenServerConfig:
     # granularity
     chunk_size: int = 64
     temperature: float = 1.0
+    # KV layout: "auto" uses the paged block pool at kv_cache_len >= 2k
+    # (global-attention models), dense per-row cache below; see
+    # engine/inference_server.py.  kv_pool_tokens sizes the paged pool
+    # (None = dense-equivalent max_batch * kv_cache_len — set smaller to
+    # serve 32k contexts a dense cache could never reserve);
+    # prefill_chunk_tokens bounds the per-step admission prefill so long
+    # prompts never stall decode for a whole wave (chunked prefill)
+    cache_mode: str = "auto"
+    page_size: int = 1024
+    kv_pool_tokens: Optional[int] = None
+    prefill_chunk_tokens: int = 1024
     # which local device hosts this server's engine (trainer/generation
     # device split on one host; None = default device)
     device_idx: Optional[int] = None
@@ -162,14 +173,14 @@ class EvaluatorConfig:
     max_prompts: int = 64
     max_new_tokens: int = 256
     interval: float = 5.0
-    # JAX platform for the eval subprocess. Default "cpu" because the
-    # in-repo launchers co-locate training workers on every local chip and
-    # an eval job sharing the host must not contend for them.  Set "" to
-    # inherit the host platform (i.e. run ON-CHIP) when the evaluator has a
-    # dedicated chip/host — the reference's dedicated eval partition
-    # (realhf/scheduler/evaluator.py:34); exercised on-chip via
-    # `python -m areal_tpu.apps.eval` directly.
-    device: str = "cpu"
+    # JAX platform policy for the eval subprocess (scheduler/evaluator.py
+    # resolve_eval_env).  "auto" (default): run ON a spare local
+    # accelerator whenever the experiment's workers leave one free
+    # (pinned via TPU_VISIBLE_DEVICES — the reference's dedicated eval
+    # partition, realhf/scheduler/evaluator.py:34), falling back to CPU
+    # only when every chip is claimed.  A platform string forces it;
+    # "" inherits the host platform unconditionally.
+    device: str = "auto"
 
 
 @dataclasses.dataclass

@@ -20,6 +20,16 @@ Design:
   pause -> load -> resume semantics).  ``version_start``/``version_end``
   record the weight versions a request sampled under (decoupled PPO's
   staleness bookkeeping).
+* ``cache_mode="paged"`` (auto at >= 2k context) replaces the dense rows
+  with a shared BLOCK POOL + per-row block tables
+  (areal_tpu/models/paged.py — the paged/radix-cache role of the
+  reference's SGLang server): capacity is allocated in pages as rows
+  actually grow, a sampling group's prompt is shared by block REFERENCE
+  (one fill, refcounted full pages, per-member tail-page copy), pool
+  pressure evicts parked rows then preempts the youngest active rows
+  (recompute-on-readmit), and long prompts prefill in
+  ``prefill_chunk_tokens`` chunks interleaved with decode so admission
+  never stalls decoding for a whole wave (chunked prefill).
 """
 
 from __future__ import annotations
@@ -39,8 +49,29 @@ from areal_tpu.api import model_api
 from areal_tpu.base import logging_
 from areal_tpu.engine.batching import bucket_len
 from areal_tpu.engine.sampling import SamplingParams, sample_logits
+from areal_tpu.models import paged
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import KVCache, decode_step, prefill
+
+#: auto cache mode picks the paged pool at/above this cache length — below
+#: it the dense bucketed path wins (short prefixes amortize no block
+#: machinery; measured crossover on v5e, see bench.py decode rows)
+PAGED_MIN_CACHE_LEN = 2048
+
+
+@partial(jax.jit, static_argnames=("sampling",))
+def _sample_rows(
+    logits: jax.Array,  # [F, V]
+    src: jax.Array,  # [n] which logits row each target samples from
+    rng: jax.Array,
+    sampling: SamplingParams,
+):
+    """First-token sampling for fill targets (each group member draws its
+    own independent token from the shared prompt's final logits)."""
+    tok, logp = sample_logits(
+        logits[src].astype(jnp.float32), rng, sampling
+    )
+    return tok, logp
 
 logger = logging_.getLogger("inference_server")
 
@@ -65,6 +96,9 @@ class _Row:
     no_eos: bool = False
     cur_token: int = -1  # pending token (KV not yet in cache)
     budget_left: int = 0  # host-side view of remaining new-token budget
+    # paged mode: row reserved while its prompt prefills chunk-by-chunk
+    # (chunked prefill); not decoding yet
+    filling: bool = False
     # a PARKED row finished a chunk without EOS and keeps its KV resident so
     # the sticky-routed continuation resumes decoding instead of re-prefilling
     # the whole prefix (the radix-cache role of the reference's SGLang server,
@@ -80,6 +114,36 @@ class _Row:
     # freed-and-reused between dispatch and harvest (park->resume, or
     # finish->new admission) carries a different epoch and is skipped
     epoch: int = 0
+
+
+@dataclasses.dataclass
+class _FillTarget:
+    """One cache consumer of an in-progress prompt fill: a fresh request
+    (sample its first token on completion) or a preempted row resuming
+    after its re-prefill (``resume`` carries the full host state)."""
+
+    row_id: int
+    req: Optional[model_api.APIGenerateInput]
+    max_new: int
+    resume: Optional[_Row] = None
+
+
+@dataclasses.dataclass
+class _Fill:
+    """An in-progress chunked prefill of ONE unique token sequence.
+
+    ``blocks`` are the canonical pool blocks receiving the KV; requests
+    arriving with an identical prompt while the fill is in flight are
+    appended as extra ``targets`` and share the blocks on completion
+    (group-prompt dedup as block-reference sharing — the radix-cache role
+    of the reference's SGLang server, reference:
+    realhf/impl/model/backend/sglang.py:369)."""
+
+    key: Tuple[int, ...]
+    tokens: List[int]
+    blocks: List[int]
+    targets: List[_FillTarget]
+    fill_pos: int = 0
 
 
 @partial(jax.jit, static_argnames=("cfg", "sampling"), donate_argnums=(2,))
@@ -215,17 +279,45 @@ class ContinuousBatchingEngine:
         seed: int = 0,
         device=None,
         mesh=None,
+        cache_mode: str = "auto",
+        page_size: int = 1024,
+        kv_pool_tokens: Optional[int] = None,
+        prefill_chunk_tokens: int = 1024,
     ):
         """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
         shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
         cache shards its kv-head axis, and the jitted admit/decode paths run
         SPMD (the role TP SGLang servers play for big models in the
-        reference's decoupled mode).  Mutually exclusive with ``device``."""
+        reference's decoupled mode).  Mutually exclusive with ``device``.
+
+        ``cache_mode``: "dense" keeps per-row ``[max_batch, kv_cache_len]``
+        KV; "paged" uses a shared block pool + block tables (capacity in
+        ``page_size``-token pages, chunked prefill, block-shared group
+        prompts); "auto" picks paged at ``kv_cache_len >=
+        PAGED_MIN_CACHE_LEN`` for global-attention models.
+        ``kv_pool_tokens`` sizes the paged pool (default: dense-equivalent
+        ``max_batch * kv_cache_len``; set smaller to serve long contexts a
+        dense cache could never reserve).  ``prefill_chunk_tokens`` bounds
+        the prompt tokens prefetched per engine step — the decode stall
+        during a long-prompt admission is one chunk, not the whole wave.
+        """
         self.cfg = cfg
         self.device = device
         self.mesh = mesh
+        assert cache_mode in ("auto", "dense", "paged"), cache_mode
+        self.paged = cache_mode == "paged" or (
+            cache_mode == "auto"
+            and kv_cache_len >= PAGED_MIN_CACHE_LEN
+            and cfg.sliding_window is None
+        )
+        if self.paged and cfg.sliding_window is not None:
+            raise ValueError(
+                "paged cache serves global-attention models; sliding-window "
+                "models use the dense window-gather path"
+            )
         self._param_shardings = None
         self._cache_sharding = None
+        self._pool_sharding = None
         if mesh is not None:
             assert device is None, "pass mesh OR device, not both"
             from jax.sharding import NamedSharding
@@ -240,10 +332,15 @@ class ContinuousBatchingEngine:
             params = jax.device_put(params, self._param_shardings)
             tp = mesh.shape.get("model", 1)
             kv_axis = "model" if cfg.n_kv_heads % max(tp, 1) == 0 else None
+            self._kv_axis = kv_axis
             self._cache_sharding = KVCache(
                 k=NamedSharding(mesh, P(None, None, kv_axis, None, None)),
                 v=NamedSharding(mesh, P(None, None, kv_axis, None, None)),
                 lengths=NamedSharding(mesh, P(None)),
+            )
+            # paged pool [L, NB, Hkv, BS, hd]: shard the kv-head axis too
+            self._pool_sharding = NamedSharding(
+                mesh, P(None, None, kv_axis, None, None)
             )
         elif device is not None:
             params = jax.device_put(params, device)
@@ -260,7 +357,11 @@ class ContinuousBatchingEngine:
         self.version = 0
 
         with jax.default_device(device) if device is not None else _nullctx():
-            if self._cache_sharding is not None:
+            if self.paged:
+                self._init_paged_state(
+                    page_size, kv_pool_tokens, prefill_chunk_tokens
+                )
+            elif self._cache_sharding is not None and mesh is not None:
                 # allocate directly sharded: a transient full-size cache on
                 # one chip would OOM exactly the models TP serving exists for
                 self.cache = jax.jit(
@@ -293,6 +394,108 @@ class ContinuousBatchingEngine:
         # the dispatched-but-unharvested decode chunk (pipelined stepping):
         # (out_t, out_l, emitted, active, cur, snapshot_row_ids)
         self._pending_chunk = None
+
+    # -- paged-cache state --------------------------------------------------
+
+    def _init_paged_state(
+        self,
+        page_size: int,
+        kv_pool_tokens: Optional[int],
+        prefill_chunk_tokens: int,
+    ):
+        cfg, max_batch = self.cfg, self.max_batch
+        BS = page_size
+        self.page_size = BS
+        self.blocks_per_row = -(-self.kv_cache_len // BS)  # MB
+        pool_tokens = kv_pool_tokens or max_batch * self.kv_cache_len
+        self.n_blocks = max(
+            -(-pool_tokens // BS), self.blocks_per_row
+        )  # NB; one full-length row always fits
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        # TPU: the Pallas kernel (shard_mapped over the kv-head axis under
+        # a TP mesh); elsewhere: the vectorized jnp reference (the kernel
+        # would only run in slow interpret mode).  Tests force the kernel
+        # path in interpret mode explicitly (tests/engine/test_paged_pool).
+        # head_dim must be lane-aligned (128) for Mosaic's scratch-slice
+        # tiling — misaligned (tiny/test) models take the reference path
+        self._use_paged_kernel = (
+            jax.default_backend() == "tpu" and cfg.head_dim % 128 == 0
+        )
+        if self._pool_sharding is not None:
+            self.k_pool, self.v_pool = jax.jit(
+                lambda: paged.pool_zeros(cfg, self.n_blocks, BS),
+                out_shardings=(self._pool_sharding, self._pool_sharding),
+            )()
+        else:
+            self.k_pool, self.v_pool = paged.pool_zeros(
+                cfg, self.n_blocks, BS
+            )
+        self.kv_lengths = jnp.zeros((max_batch,), jnp.int32)
+        self._tables_np = np.zeros(
+            (max_batch, self.blocks_per_row), np.int32
+        )
+        self._tables = jnp.asarray(self._tables_np)
+        self._tables_dirty = False
+        # host allocator: LIFO free stack + refcounts (shared prompt
+        # blocks); all decisions host-deterministic for SPMD lockstep
+        self._free_blocks = list(range(self.n_blocks - 1, -1, -1))
+        self._block_ref = np.zeros((self.n_blocks,), np.int32)
+        self._row_blocks: List[List[int]] = [[] for _ in range(max_batch)]
+        self._filling: List[_Fill] = []
+        self._preempted: List[_Row] = []
+        self.preempted_total = 0
+        # stable closures: paged_decode_chunk caches its jit on their ids
+        sampling_ref = self.sampling
+        stop_ref = self.stop_tokens
+
+        def _sample(logits, sub):
+            return sample_logits(logits, sub, sampling_ref)
+
+        def _stop(tok):
+            stop = jnp.zeros_like(tok, dtype=bool)
+            for s in stop_ref:
+                stop |= tok == s
+            return stop
+
+        self._paged_sample_fn = _sample
+        self._paged_stop_fn = _stop
+
+    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
+        if len(self._free_blocks) < n:
+            return None
+        out = [self._free_blocks.pop() for _ in range(n)]
+        for b in out:
+            self._block_ref[b] = 1
+        return out
+
+    def _incref_blocks(self, blocks: List[int]):
+        for b in blocks:
+            self._block_ref[b] += 1
+
+    def _free_block_list(self, blocks: List[int]):
+        for b in blocks:
+            self._block_ref[b] -= 1
+            assert self._block_ref[b] >= 0, f"double free of block {b}"
+            if self._block_ref[b] == 0:
+                self._free_blocks.append(b)
+
+    def _set_row_blocks(self, row_id: int, blocks: List[int]):
+        self._row_blocks[row_id] = blocks
+        t = self._tables_np[row_id]
+        t[:] = 0
+        t[: len(blocks)] = blocks
+        self._tables_dirty = True
+
+    def _release_row(self, row_id: int):
+        """Single exit point for a row slot: frees its pool blocks."""
+        self.rows[row_id] = None
+        if self.paged and self._row_blocks[row_id]:
+            self._free_block_list(self._row_blocks[row_id])
+            self._set_row_blocks(row_id, [])
+
+    @property
+    def free_pool_blocks(self) -> int:
+        return len(self._free_blocks)
 
     # -- client API (any thread) -------------------------------------------
 
@@ -353,8 +556,17 @@ class ContinuousBatchingEngine:
 
     @property
     def n_inflight(self) -> int:
-        """Actively decoding rows (parked rows are idle KV residents)."""
+        """In-flight rows: decoding or chunk-filling (parked rows are
+        idle KV residents)."""
         return sum(r is not None and not r.parked for r in self.rows)
+
+    @property
+    def n_decoding(self) -> int:
+        """Rows with a pending token to decode (excludes filling rows)."""
+        return sum(
+            r is not None and not r.parked and not r.filling
+            for r in self.rows
+        )
 
     @property
     def n_parked(self) -> int:
@@ -372,6 +584,7 @@ class ContinuousBatchingEngine:
             self.n_pending > 0
             or self.n_inflight > 0
             or self._pending_chunk is not None
+            or (self.paged and bool(self._filling or self._preempted))
         )
 
     # -- engine loop (owner thread) ----------------------------------------
@@ -402,7 +615,7 @@ class ContinuousBatchingEngine:
         n_evicted = 0
         for row_id, row in enumerate(self.rows):
             if row is not None and row.parked:
-                self.rows[row_id] = None
+                self._release_row(row_id)
                 n_evicted += 1
         if n_evicted:
             logger.info("weight update evicted %d parked rows", n_evicted)
@@ -411,19 +624,36 @@ class ContinuousBatchingEngine:
         # The pending cur_token (last generated) must stay OUT of the cache —
         # the next decode_step writes its KV; re-prefill the rest, in ONE
         # batched call for all in-flight rows.
-        entries = [
-            (row_id, (row.prompt + row.generated)[:-1])
-            for row_id, row in enumerate(self.rows)
-            if row is not None
-        ]
-        if entries:
-            self._prefill_rows(entries)
-            # keep the already-sampled pending tokens, discard the resamples
-            ids = np.array([rid for rid, _ in entries], np.int32)
-            curs = np.array(
-                [self.rows[rid].cur_token for rid, _ in entries], np.int32
-            )
-            self.cur_tokens = self.cur_tokens.at[ids].set(curs)
+        if self.paged:
+            # chunk-filling rows hold KV computed under the OLD weights:
+            # restart their fills from scratch (their rows/blocks stay)
+            for f in self._filling:
+                f.fill_pos = 0
+            entries = [
+                (row_id, (row.prompt + row.generated)[:-1])
+                for row_id, row in enumerate(self.rows)
+                if row is not None and not row.filling
+            ]
+            if entries:
+                # existing blocks are overwritten in place; the pending
+                # cur_tokens are untouched (no resampling to discard)
+                self._refill_rows_paged(entries)
+        else:
+            entries = [
+                (row_id, (row.prompt + row.generated)[:-1])
+                for row_id, row in enumerate(self.rows)
+                if row is not None
+            ]
+            if entries:
+                self._prefill_rows(entries)
+                # keep the already-sampled pending tokens, discard the
+                # resamples
+                ids = np.array([rid for rid, _ in entries], np.int32)
+                curs = np.array(
+                    [self.rows[rid].cur_token for rid, _ in entries],
+                    np.int32,
+                )
+                self.cur_tokens = self.cur_tokens.at[ids].set(curs)
         logger.info(
             "weights updated to v%d (%d in-flight recomputed)",
             self.version,
@@ -490,7 +720,7 @@ class ContinuousBatchingEngine:
                 continue
             if len(prompt) + 1 >= self.kv_cache_len:
                 # no room to continue: report empty so the client stops
-                self.rows[row_id] = None
+                self._release_row(row_id)
                 done = _Row(
                     req=req, prompt=prompt, generated=[], logprobs=[],
                     version_start=self.version, no_eos=True,
@@ -530,8 +760,435 @@ class ContinuousBatchingEngine:
                 if oldest is None or row.park_step < oldest:
                     oldest, oldest_id = row.park_step, row_id
         if oldest_id is not None:
-            self.rows[oldest_id] = None
+            self._release_row(oldest_id)
         return oldest_id
+
+    # -- paged-mode engine internals ---------------------------------------
+
+    def _run_fill_batch(self, fills: List[_Fill], budget: int):
+        """Run ONE batched prefill chunk over ``fills`` (FIFO, total
+        tokens <= budget).  Advances fill_pos; returns
+        (completed_fills, their_logits_indices, logits_device)."""
+        batch: List[Tuple[_Fill, int]] = []
+        left = budget
+        for f in fills:
+            rem = len(f.tokens) - f.fill_pos
+            if rem <= 0:
+                continue
+            take = min(rem, left)
+            if take <= 0:
+                break
+            batch.append((f, take))
+            left -= take
+            if left <= 0:
+                break
+        if not batch:
+            return [], [], None
+        C = bucket_len(max(take for _, take in batch))
+        F_pad = 1 << (len(batch) - 1).bit_length()
+        toks = np.zeros((F_pad, C), np.int32)
+        starts = np.zeros((F_pad,), np.int32)
+        cls = np.zeros((F_pad,), np.int32)
+        tables = np.zeros((F_pad, self.blocks_per_row), np.int32)
+        for i, (f, take) in enumerate(batch):
+            toks[i, :take] = f.tokens[f.fill_pos : f.fill_pos + take]
+            starts[i] = f.fill_pos
+            cls[i] = take
+            tables[i, : len(f.blocks)] = f.blocks
+        logits, self.k_pool, self.v_pool = paged.paged_fill_chunk(
+            self.params,
+            self.k_pool,
+            self.v_pool,
+            self.cfg,
+            jnp.asarray(toks),
+            jnp.asarray(starts),
+            jnp.asarray(cls),
+            jnp.asarray(tables),
+            use_kernel=self._use_paged_kernel,
+            mesh=self.mesh,
+            kv_axis=getattr(self, "_kv_axis", None),
+        )
+        self.prefill_calls += 1
+        self.prefill_tokens_total += int(cls.sum())
+        completed, idxs = [], []
+        for i, (f, take) in enumerate(batch):
+            f.fill_pos += take
+            if f.fill_pos == len(f.tokens):
+                completed.append(f)
+                idxs.append(i)
+        return completed, idxs, logits
+
+    def _refill_rows_paged(self, entries: List[Tuple[int, List[int]]]):
+        """Synchronously recompute rows' cached KV into their EXISTING
+        blocks (weight update re-prefill; no sampling — the pending
+        cur_token is preserved).  Shared group-prompt blocks are written
+        once per sharer with identical values (same tokens, same new
+        weights), which is scatter-deterministic."""
+        fills = [
+            _Fill(
+                key=(), tokens=seq, blocks=self._row_blocks[rid], targets=[]
+            )
+            for rid, seq in entries
+            if len(seq) > 0
+        ]
+        pending = [f for f in fills if f.fill_pos < len(f.tokens)]
+        while pending:
+            self._run_fill_batch(pending, self.prefill_chunk_tokens)
+            pending = [f for f in pending if f.fill_pos < len(f.tokens)]
+
+    def _advance_fill(self):
+        """One chunked-prefill step: advance in-flight fills by at most
+        ``prefill_chunk_tokens`` total, then activate rows whose prompt
+        completed (sample first tokens / restore preempted state)."""
+        if not self._filling:
+            return
+        completed, idxs, logits = self._run_fill_batch(
+            self._filling, self.prefill_chunk_tokens
+        )
+        if not completed:
+            return
+        for f in completed:
+            self._filling.remove(f)
+        self._distribute_fills(completed, idxs, logits)
+
+    def _distribute_fills(self, fills: List[_Fill], idxs, logits):
+        """Hand a completed fill's blocks to its targets: target 0 owns
+        the canonical blocks; later targets share the FULL blocks
+        (refcount) and receive a COPY of the partial tail block (their
+        generated tokens diverge inside it).  Fresh targets sample their
+        first token from the shared final logits; preempted targets
+        restore their saved decode state with zero sampling."""
+        copy_src, copy_dst = [], []
+        sample_targets: List[Tuple[_Fill, _FillTarget, int]] = []
+        activation: List[Tuple[int, int, int, int]] = []  # rid,cur,budget,len
+        for f, li in zip(fills, idxs):
+            plen = len(f.tokens)
+            n_full = plen // self.page_size
+            has_tail = plen % self.page_size != 0
+            for t_i, tgt in enumerate(f.targets):
+                if t_i == 0:
+                    self._set_row_blocks(tgt.row_id, list(f.blocks))
+                else:
+                    shared = f.blocks[:n_full]
+                    self._incref_blocks(shared)
+                    own = list(shared)
+                    if has_tail:
+                        tail = self._alloc_blocks(1)
+                        while tail is None:
+                            if self._evict_parked() is None:
+                                victim = self._pick_preemption_victim(
+                                    exclude=-1
+                                )
+                                if victim is None:
+                                    raise RuntimeError(
+                                        "pool exhausted distributing a "
+                                        "group fill"
+                                    )
+                                self._preempt_row(victim)
+                            tail = self._alloc_blocks(1)
+                        copy_src.append(f.blocks[n_full])
+                        copy_dst.append(tail[0])
+                        own += tail
+                    self._set_row_blocks(tgt.row_id, own)
+                if tgt.resume is not None:
+                    row = tgt.resume
+                    self._epoch_counter += 1
+                    row.epoch = self._epoch_counter
+                    row.filling = False
+                    self.rows[tgt.row_id] = row
+                    activation.append(
+                        (tgt.row_id, row.cur_token, row.budget_left, plen,
+                         row)
+                    )
+                else:
+                    sample_targets.append((f, tgt, li))
+        if copy_src:
+            n_pad = 1 << (len(copy_src) - 1).bit_length()
+            src = np.zeros((n_pad,), np.int32)
+            dst = np.full((n_pad,), self.n_blocks, np.int32)  # pad -> drop
+            src[: len(copy_src)] = copy_src
+            dst[: len(copy_dst)] = copy_dst
+            self.k_pool, self.v_pool = paged.copy_blocks(
+                self.k_pool, self.v_pool, jnp.asarray(src), jnp.asarray(dst)
+            )
+        if sample_targets:
+            n = len(sample_targets)
+            n_pad = 1 << (n - 1).bit_length()
+            src_idx = np.zeros((n_pad,), np.int32)
+            for i, (_, _, li) in enumerate(sample_targets):
+                src_idx[i] = li
+            self.rng, sub = jax.random.split(self.rng)
+            toks, logps = _sample_rows(
+                logits, jnp.asarray(src_idx), sub, self.sampling
+            )
+            toks = np.asarray(toks)[:n]
+            logps = np.asarray(logps)[:n]
+            for (f, tgt, _), tok_i, logp in zip(
+                sample_targets, toks.tolist(), logps.tolist()
+            ):
+                row = self.rows[tgt.row_id]
+                assert row is not None and row.filling
+                row.generated = [int(tok_i)]
+                row.logprobs = [float(logp)]
+                row.filling = False
+                plen = len(f.tokens)
+                if tok_i in self.stop_tokens or tgt.max_new <= 1:
+                    row.no_eos = tok_i not in self.stop_tokens
+                    self._finish(tgt.row_id, row, started=False)
+                    self._release_row(tgt.row_id)
+                    continue
+                row.cur_token = int(tok_i)
+                row.budget_left = tgt.max_new - 1
+                self._epoch_counter += 1
+                row.epoch = self._epoch_counter
+                activation.append(
+                    (tgt.row_id, int(tok_i), tgt.max_new - 1, plen, row)
+                )
+        # a resume target activated EARLIER in this loop is the youngest
+        # active row, so a LATER target's tail-block allocation may have
+        # preempted it (rows[rid] is None again, its table zeroed):
+        # activating its slot anyway would scatter KV into pool block 0
+        # and corrupt another row (code-review r5 #1) — apply only entries
+        # whose row object still occupies its slot
+        activation = [
+            a for a in activation if self.rows[a[0]] is a[4]
+        ]
+        if activation:
+            ids = np.array([a[0] for a in activation], np.int32)
+            curs = np.array([a[1] for a in activation], np.int32)
+            buds = np.array([a[2] for a in activation], np.int32)
+            lens = np.array([a[3] for a in activation], np.int32)
+            self.cur_tokens = self.cur_tokens.at[ids].set(curs)
+            self.active = self.active.at[ids].set(True)
+            self.budgets = self.budgets.at[ids].set(buds)
+            self.kv_lengths = self.kv_lengths.at[ids].set(lens)
+
+    def _admit_paged(self):
+        if self.hold_admissions:
+            return
+        for row_id, row in enumerate(self.rows):
+            if row is not None and row.parked and (
+                self._step_seq - row.park_step > self.park_ttl_steps
+            ):
+                self._release_row(row_id)
+        free = [i for i, r in enumerate(self.rows) if r is None]
+
+        def take_row():
+            if free:
+                return free.pop(0)
+            with self._lock:
+                queued = {r.qid for r in self._pending}
+            evicted = self._evict_parked(keep_qids=queued)
+            return evicted
+
+        # preempted rows first (their pool reservation was stolen mid-
+        # decode; FIFO so none starves)
+        while self._preempted:
+            row = self._preempted[0]
+            seq = (row.prompt + row.generated)[:-1]
+            n_blocks = max(1, -(-len(seq) // self.page_size))
+            rid = take_row()
+            if rid is None:
+                break
+            blocks = self._alloc_blocks(n_blocks)
+            if blocks is None and self._evict_parked() is not None:
+                blocks = self._alloc_blocks(n_blocks)
+            if blocks is None:
+                free.insert(0, rid)
+                break
+            self._preempted.pop(0)
+            self._set_row_blocks(rid, blocks)
+            row.filling = True
+            self.rows[rid] = row
+            self._filling.append(
+                _Fill(
+                    key=tuple(seq),
+                    tokens=list(seq),
+                    blocks=blocks,
+                    targets=[
+                        _FillTarget(
+                            row_id=rid, req=row.req,
+                            max_new=row.budget_left, resume=row,
+                        )
+                    ],
+                )
+            )
+        while True:
+            with self._lock:
+                if not self._pending:
+                    break
+                req = self._pending.pop(0)
+            if self._try_resume(req):
+                continue
+            prompt = list(req.input_ids or req.prompt_ids)
+            if len(prompt) + 1 >= self.kv_cache_len:
+                row = _Row(
+                    req=req, prompt=prompt, generated=[], logprobs=[],
+                    version_start=self.version, no_eos=True,
+                )
+                self._finish(-1, row, started=False)
+                continue
+            max_new = req.gconfig.max_new_tokens
+            if len(prompt) + max_new > self.kv_cache_len:
+                max_new = max(1, self.kv_cache_len - len(prompt))
+            key = tuple(prompt)
+            fill = next(
+                (f for f in self._filling if f.key == key), None
+            )
+            rid = take_row()
+            if rid is None:
+                with self._lock:
+                    self._pending.insert(0, req)
+                break
+            if fill is None:
+                n_blocks = -(-len(prompt) // self.page_size)
+                blocks = self._alloc_blocks(n_blocks)
+                if blocks is None and self._evict_parked() is not None:
+                    blocks = self._alloc_blocks(n_blocks)
+                if blocks is None:
+                    free.insert(0, rid)
+                    with self._lock:
+                        self._pending.insert(0, req)
+                    break
+                fill = _Fill(
+                    key=key, tokens=prompt, blocks=blocks, targets=[]
+                )
+                self._filling.append(fill)
+                self._set_row_blocks(rid, blocks)
+                # canonical blocks live in target 0's table; refcount
+                # stays 1 until extra targets share them
+            else:
+                # group member joins the in-flight fill: ZERO extra
+                # prefill work (block-reference prompt sharing)
+                pass
+            fill.targets.append(
+                _FillTarget(row_id=rid, req=req, max_new=max_new)
+            )
+            self.rows[rid] = _Row(
+                req=req, prompt=prompt, generated=[], logprobs=[],
+                version_start=self.version, filling=True,
+            )
+
+    def _ensure_decode_blocks(self):
+        """Every ACTIVE row's table must cover ``length + chunk`` slots
+        before a decode dispatch (the chunk allocates nothing device-side).
+        Under pool pressure: evict parked rows, then PREEMPT the youngest
+        active rows (recompute-on-readmit, the deterministic analogue of
+        vLLM's recompute preemption)."""
+        W = self.chunk_size
+        for row_id in range(self.max_batch):
+            row = self.rows[row_id]
+            if row is None or row.parked or row.filling:
+                continue
+            pend = (
+                self._pending_chunk is not None
+                and any(rid == row_id for rid, _ in self._pending_chunk[5])
+            )
+            host_len = len(row.prompt) + len(row.generated) + 1
+            if pend:
+                # un-harvested chunk may advance this row by up to W more
+                host_len += W
+            need = -(-(host_len + W) // self.page_size)
+            need = min(need, self.blocks_per_row)
+            while need > len(self._row_blocks[row_id]):
+                deficit = need - len(self._row_blocks[row_id])
+                blocks = self._alloc_blocks(deficit)
+                if blocks is not None:
+                    self._set_row_blocks(
+                        row_id, self._row_blocks[row_id] + blocks
+                    )
+                    break
+                if self._evict_parked() is not None:
+                    continue
+                victim = self._pick_preemption_victim(exclude=row_id)
+                if victim is None:
+                    # only this row left: it must fit by construction
+                    raise RuntimeError(
+                        "KV pool exhausted with no evictable rows; "
+                        f"pool={self.n_blocks} blocks is too small for "
+                        f"kv_cache_len={self.kv_cache_len}"
+                    )
+                self._preempt_row(victim)
+                if self.rows[row_id] is None or self.rows[row_id] is not row:
+                    break  # this very row finished during the drain
+
+    def _pick_preemption_victim(self, exclude: int) -> Optional[int]:
+        """Youngest active row (highest epoch) — deterministic, and the
+        youngest has the least cached work to throw away."""
+        best, best_epoch = None, -1
+        for row_id, row in enumerate(self.rows):
+            if (
+                row is None or row.parked or row.filling
+                or row_id == exclude
+            ):
+                continue
+            if row.epoch > best_epoch:
+                best, best_epoch = row_id, row.epoch
+        return best
+
+    def _preempt_row(self, row_id: int):
+        """Stop decoding a row and reclaim its blocks; it re-admits
+        through the fill queue (prefix recompute) when space frees up."""
+        # the in-flight chunk must be folded in first: preemption rewrites
+        # the row set the harvest snapshot refers to
+        self._harvest(self._pending_chunk)
+        self._pending_chunk = None
+        row = self.rows[row_id]
+        if row is None or row.parked or row.filling:
+            return  # the drain finished or parked the victim: done
+        self.active = self.active.at[row_id].set(False)
+        self._release_row(row_id)
+        self._preempted.append(row)
+        self.preempted_total += 1
+        logger.info(
+            "preempted row %d (qid=%s, %d cached tokens) under pool "
+            "pressure",
+            row_id, row.req.qid, len(row.prompt) + len(row.generated),
+        )
+
+    def _dispatch_chunk_paged(self):
+        snapshot = [
+            (i, r.epoch) for i, r in enumerate(self.rows)
+            if r is not None and not r.parked and not r.filling
+        ]
+        if self._tables_dirty:
+            self._tables = jnp.asarray(self._tables_np)
+            self._tables_dirty = False
+        self.rng, sub = jax.random.split(self.rng)
+        (
+            self.k_pool,
+            self.v_pool,
+            self.kv_lengths,
+            out_t,
+            out_l,
+            emitted,
+            cur,
+            self.active,
+            self.budgets,
+            self.rng,
+        ) = paged.paged_decode_chunk(
+            self.params,
+            self.k_pool,
+            self.v_pool,
+            self.cfg,
+            self._tables,
+            self.kv_lengths,
+            self.cur_tokens,
+            self.active,
+            self.budgets,
+            sub,
+            self.chunk_size,
+            self._paged_sample_fn,
+            self._paged_stop_fn,
+            use_kernel=self._use_paged_kernel,
+            max_len=self.kv_cache_len,
+            mesh=self.mesh,
+            kv_axis=getattr(self, "_kv_axis", None),
+        )
+        self.cur_tokens = cur
+        self._pending_chunk = (
+            out_t, out_l, emitted, self.active, self.cur_tokens, snapshot
+        )
 
     def _admit(self):
         if self.hold_admissions:
@@ -542,7 +1199,7 @@ class ContinuousBatchingEngine:
             if row is not None and row.parked and (
                 self._step_seq - row.park_step > self.park_ttl_steps
             ):
-                self.rows[row_id] = None
+                self._release_row(row_id)
         free = [i for i, r in enumerate(self.rows) if r is None]
         to_admit: List[Tuple[int, model_api.APIGenerateInput, List[int], int]] = []
         while True:
@@ -640,7 +1297,7 @@ class ContinuousBatchingEngine:
             row.cur_token = row.generated[-1]
             self.active = self.active.at[row_id].set(False)
         elif started:
-            self.rows[row_id] = None
+            self._release_row(row_id)
             self.active = self.active.at[row_id].set(False)
         with self._lock:
             self._results[row.req.qid] = out
@@ -757,7 +1414,7 @@ class ContinuousBatchingEngine:
         remains)."""
         prev_rows = set(prev[5]) if prev is not None else set()
         for row_id, row in enumerate(self.rows):
-            if row is None or row.parked:
+            if row is None or row.parked or row.filling:
                 continue
             if prev is None or row.budget_left > self.chunk_size:
                 return True
@@ -784,10 +1441,19 @@ class ContinuousBatchingEngine:
                 time.sleep(0.01)
             return n
         self._apply_pending_weights()
+        if self.paged:
+            self._admit_paged()
+            self._advance_fill()
+            self._ensure_decode_blocks()
+            prev = self._pending_chunk
+            self._pending_chunk = None
+            if self.n_decoding > 0 and self._worth_dispatching(prev):
+                self._dispatch_chunk_paged()
+            return self._harvest(prev)
         self._admit()
         prev = self._pending_chunk
         self._pending_chunk = None
-        if self.n_inflight > 0 and self._worth_dispatching(prev):
+        if self.n_decoding > 0 and self._worth_dispatching(prev):
             self._dispatch_chunk(
                 extra_len=self.chunk_size if prev is not None else 0
             )

@@ -56,6 +56,13 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
     gen_kv_cache_len: int = 32768
     gen_max_concurrent_batch: int = 16
     gen_chunk_size: int = 64  # measured on v5e: 3.7k tok/s @64 vs 3.9k @128
+    # paged-KV serving knobs (engine/inference_server.py): auto picks the
+    # block pool at kv_cache_len >= 2k; pool tokens default to
+    # max_batch * kv_cache_len (set smaller for 32k-context serving)
+    gen_cache_mode: str = "auto"
+    gen_page_size: int = 1024
+    gen_kv_pool_tokens: Optional[int] = None
+    gen_prefill_chunk_tokens: int = 1024
     # device index hosting each gen server's engine (trainer/gen split)
     gen_device_start: Optional[int] = None
     success_rate_lb: float = 0.0
@@ -139,6 +146,10 @@ class AsyncPPOMathExperiment(PPOMathExperiment):
                 kv_cache_len=self.gen_kv_cache_len,
                 chunk_size=self.gen_chunk_size,
                 temperature=ppo.gen.temperature,
+                cache_mode=self.gen_cache_mode,
+                page_size=self.gen_page_size,
+                kv_pool_tokens=self.gen_kv_pool_tokens,
+                prefill_chunk_tokens=self.gen_prefill_chunk_tokens,
                 device_idx=(
                     self.gen_device_start + i * gen_tp
                     if self.gen_device_start is not None

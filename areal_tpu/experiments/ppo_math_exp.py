@@ -80,6 +80,16 @@ class PPOMathExperiment(CommonExperimentConfig):
     # (reference: fused_interface.py; saves a dispatch + overlaps the CPU
     # verifier with the ref forward). Only takes effect when use_ref.
     fuse_rew_ref: bool = False
+    # where PPO rewards come from (the reward-MFC slot, reference:
+    # realhf/experiments/common/ppo_math_exp.py:120-341):
+    #   "rule"  — the math/code verifier (rw_math interface)
+    #   "model" — a TRAINED reward model (rw_train.inference on a frozen
+    #             critic-head checkpoint; completes SFT -> RM -> PPO)
+    reward_source: str = "rule"
+    # the frozen RM for reward_source="model" (e.g. an "hf" abstraction
+    # pointing at an rw-experiment checkpoint, is_critic=True); defaults
+    # to a critic twin of the actor — useful only for tests
+    reward_model: ModelAbstraction = None
 
     def _main_model(self):
         return self.actor
@@ -148,9 +158,17 @@ class PPOMathExperiment(CommonExperimentConfig):
                 mask_no_eos_with_zero=ppo.mask_no_eos_with_zero,
             ),
         )
-        rw_iface = ModelInterfaceAbstraction(
-            "rw_math", {"group_size": self.group_size}
-        )
+        assert self.reward_source in ("rule", "model"), self.reward_source
+        if self.reward_source == "model":
+            from areal_tpu.interfaces.rm_interface import (  # noqa: F401
+                RewardModelInterface,
+            )
+
+            rw_iface = ModelInterfaceAbstraction("rw_train", {})
+        else:
+            rw_iface = ModelInterfaceAbstraction(
+                "rw_math", {"group_size": self.group_size}
+            )
 
         n = self.train_bs_n_seqs
         rpcs = []
@@ -173,7 +191,12 @@ class PPOMathExperiment(CommonExperimentConfig):
         rpcs.append(actor_gen)
         interfaces["actor_gen"] = actor_iface
 
-        fused = self.fuse_rew_ref and self.use_ref
+        # a model-based reward runs on ITS OWN weights, so it cannot fuse
+        # into the ref model's MFC
+        fused = (
+            self.fuse_rew_ref and self.use_ref
+            and self.reward_source == "rule"
+        )
         if fused:
             from areal_tpu.interfaces.fused_interface import (  # noqa: F401
                 FusedInferenceInterface,
@@ -317,14 +340,26 @@ class PPOMathExperiment(CommonExperimentConfig):
             ),
         ]
         if not fused:
-            shards.append(
-                ModelShard(
-                    model_name=reward,
-                    model=ModelAbstraction("null"),
-                    backend=ModelBackendAbstraction("null"),
-                    mesh_spec=self.mesh_spec,
+            if self.reward_source == "model":
+                # frozen critic-head scorer served by the inference backend
+                rm_model = self.reward_model or critic_model_from(self.actor)
+                shards.append(
+                    ModelShard(
+                        model_name=reward,
+                        model=rm_model,
+                        backend=ModelBackendAbstraction("inference"),
+                        mesh_spec=self.mesh_spec,
+                    )
                 )
-            )
+            else:
+                shards.append(
+                    ModelShard(
+                        model_name=reward,
+                        model=ModelAbstraction("null"),
+                        backend=ModelBackendAbstraction("null"),
+                        mesh_spec=self.mesh_spec,
+                    )
+                )
         if self.use_ref:
             shards.append(
                 ModelShard(

@@ -121,9 +121,19 @@ def load_hf_model(
     params = family.params_from_hf(state, cfg)
     if is_critic:
         params.pop("lm_head", None)
-        params["value_head"] = {
-            "w": jnp.zeros((cfg.hidden_dim, 1), jnp.float32)
-        }
+        if "value_head.weight" in state:
+            # an RM/critic checkpoint exported by save_hf_model carries its
+            # TRAINED scorer; zero-initing here would silently discard it
+            # (the SFT->RM->PPO chain reloads exactly this head)
+            params["value_head"] = {
+                "w": jnp.asarray(
+                    np.asarray(state["value_head.weight"], np.float32).T
+                )
+            }
+        else:
+            params["value_head"] = {
+                "w": jnp.zeros((cfg.hidden_dim, 1), jnp.float32)
+            }
     logger.info(
         "loaded %s (%d layers, %d hidden) from %s",
         family.name,

@@ -1,0 +1,424 @@
+"""Paged-KV forward paths: chunked prefill + chunked decode over a block
+pool.
+
+TPU-native replacement for the paged/radix KV machinery the reference gets
+from SGLang (reference: realhf/impl/model/backend/sglang.py:369 and the
+server patched by patch/sglang/v0.4.6.post2.patch; SURVEY §2.8 names
+"splash/paged attention kernels" as the TPU equivalent).  The serving
+engine (areal_tpu/engine/inference_server.py) owns the host-side block
+allocator; this module owns the device-side compute:
+
+* the KV pool is ``[L, NB, Hkv, BS, hd]`` (PAGE-major: one page is one
+  contiguous HBM extent) — NB fixed-size blocks shared by all rows; a
+  row's cache is the ordered block list in its table row ``[MB]`` (pool
+  block id per logical block);
+* :func:`paged_fill_chunk` runs ONE chunk of prompt prefill for a batch of
+  filling rows: in-chunk causal self-attention merged online with
+  paged-kernel partials over each row's already-cached prefix — so a 16k
+  prompt admits as 16 × 1k chunks interleaved with decode steps instead of
+  one decode-stalling wave (chunked prefill, the round-4 verdict's #1/#2);
+* :func:`paged_decode_chunk` mirrors ``transformer.decode_chunk``'s
+  window design (in-chunk KV in a small contiguous window, ONE pool
+  scatter per chunk) with the paged kernel streaming each row's valid
+  blocks — cost scales with the row's true length, not a padded bucket.
+
+Every function threads the pool through donated jit args; the layered
+kernel entry reads blocks straight from the stacked pool so no per-layer
+pool slice is ever materialized.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.transformer import (
+    Params,
+    _attn_qkv,
+    _embed,
+    _head,
+    _mlp_block,
+    _norm,
+    _proj,
+    rope_tables,
+)
+from areal_tpu.ops.paged_attention import (
+    paged_flash_attention,
+    reference_paged_partials,
+)
+
+_NEG_INF = -1e30
+
+
+def pool_zeros(
+    cfg: TransformerConfig, n_blocks: int, block_size: int, dtype=None
+) -> Tuple[jax.Array, jax.Array]:
+    """Allocate the (k, v) block pools ``[L, NB, Hkv, BS, hd]`` —
+    PAGE-major so one page is one contiguous HBM extent (the kernel reads
+    a page's every head in a single DMA)."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (
+        cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim
+    )
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _prefix_partials(
+    q, k_pool, v_pool, tables, lengths, layer, use_kernel,
+    mesh=None, kv_axis=None,
+):
+    """Paged-attention partials over each row's cached prefix.  ``q`` is
+    [B, Q, Hq, hd]; returns (acc, m, l) with Q query tokens per row.
+
+    On a TP serving mesh the Pallas kernel has no SPMD partitioning rule,
+    so it runs under an explicit ``shard_map``: the pool's kv-head axis
+    and q's head axis split over ``kv_axis`` (or fully replicated when
+    the head count doesn't divide), each shard streaming only its own
+    heads' pages (code-review r5 #2)."""
+    if use_kernel:
+        interp = jax.default_backend() != "tpu"
+        if mesh is not None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            layered = k_pool.ndim == 5
+            pool_spec = (
+                P(None, None, kv_axis, None, None)
+                if layered
+                else P(None, kv_axis, None, None)
+            )
+
+            def kern(qq, kk, vv, tb, ln, ly):
+                return paged_flash_attention(
+                    qq, kk, vv, tb, ln, layer=ly, interpret=interp
+                )
+
+            fn = shard_map(
+                kern,
+                mesh=mesh,
+                in_specs=(
+                    P(None, None, kv_axis, None),
+                    pool_spec,
+                    pool_spec,
+                    P(None, None),
+                    P(None),
+                    P(None),
+                ),
+                out_specs=(
+                    P(None, None, kv_axis, None),
+                    P(None, None, kv_axis),
+                    P(None, None, kv_axis),
+                ),
+                check_rep=False,
+            )
+            return fn(
+                q, k_pool, v_pool, tables, lengths,
+                jnp.asarray(layer, jnp.int32).reshape(1),
+            )
+        return paged_flash_attention(
+            q, k_pool, v_pool, tables, lengths, layer=layer,
+            interpret=interp,
+        )
+    kl = jax.lax.dynamic_index_in_dim(k_pool, layer, 0, keepdims=False)
+    vl = jax.lax.dynamic_index_in_dim(v_pool, layer, 0, keepdims=False)
+    return reference_paged_partials(q, kl, vl, tables, lengths)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "use_kernel", "mesh", "kv_axis"),
+    donate_argnums=(1, 2),
+)
+def paged_fill_chunk(
+    params: Params,
+    k_pool: jax.Array,  # [L, NB, Hkv, BS, hd]
+    v_pool: jax.Array,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [F, C] this chunk's tokens (right-padded)
+    starts: jax.Array,  # [F] tokens already cached per row (chunk offset)
+    chunk_lens: jax.Array,  # [F] valid tokens in this chunk
+    tables: jax.Array,  # [F, MB] pool block ids
+    use_kernel: bool,
+    mesh=None,
+    kv_axis=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One prefill chunk for F filling rows.
+
+    Each row's chunk tokens attend causally within the chunk AND over the
+    row's already-cached prefix ``[0, start)`` via paged partials — an
+    exact continuation of the row's prefill no matter how the prompt was
+    split into chunks.  Chunk KV is scattered into the rows' pool blocks
+    (the engine pre-allocated blocks covering ``start + chunk_len``).
+
+    Returns ``(last_logits [F, V], k_pool, v_pool)`` — logits at each
+    row's LAST valid chunk position (only meaningful on a row's final
+    chunk, where the engine samples the first generated token).
+    """
+    F, C = tokens.shape
+    L, NB, Hkv, BS, hd = k_pool.shape
+    r = cfg.n_q_heads // Hkv
+    positions = starts[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(C)[None, :] < chunk_lens[:, None]  # [F, C]
+    x = _embed(params, cfg, tokens, positions)
+    rope_cs = (
+        None
+        if cfg.abs_position_embedding
+        else rope_tables(positions, cfg.rotary_base, cfg.head_dim)
+    )
+    iot = jnp.arange(C)
+    mask_chunk = (
+        valid[:, None, :]
+        & valid[:, :, None]
+        & (iot[:, None] >= iot[None, :])
+    )  # [F, Cq, Ckv] causal
+    # pool write coordinates for every chunk token
+    pid_log = jnp.clip(positions // BS, 0, tables.shape[1] - 1)
+    pid = jnp.take_along_axis(tables, pid_log, axis=1)
+    pid = jnp.where(valid, pid, NB)  # invalid -> OOB -> dropped
+    off = positions % BS
+    seg_ids = valid.astype(jnp.int32)
+    scale = 1.0 / np.sqrt(hd)
+
+    def body(carry, xs):
+        x, k_pool, v_pool = carry
+        lp, l = xs
+        h = _norm(x, lp["attn_norm"], cfg)
+        q, k, v = _attn_qkv(cfg, lp, h, positions, rope_cs)
+        acc_p, m_p, l_p = _prefix_partials(
+            q, k_pool, v_pool, tables, starts, l, use_kernel,
+            mesh=mesh, kv_axis=kv_axis,
+        )
+        # in-chunk causal scores (C <= ~1k keeps [F,Hq,C,C] small)
+        qg = q.reshape(F, C, Hkv, r, hd)
+        s_c = (
+            jnp.einsum(
+                "fikrd,fjkd->fkrij",
+                qg.astype(jnp.float32),
+                k.astype(jnp.float32),
+            )
+            * scale
+        )  # [F, Hkv, r, Cq, Ckv]
+        s_c = jnp.where(mask_chunk[:, None, None, :, :], s_c, _NEG_INF)
+        accp = acc_p.reshape(F, C, Hkv, r, hd).transpose(0, 2, 3, 1, 4)
+        mp = m_p.reshape(F, C, Hkv, r).transpose(0, 2, 3, 1)
+        lpp = l_p.reshape(F, C, Hkv, r).transpose(0, 2, 3, 1)
+        # online merge of prefix partials with the in-chunk scores
+        m_tot = jnp.maximum(mp, jnp.max(s_c, axis=-1))
+        p_c = jnp.exp(s_c - m_tot[..., None])
+        alpha = jnp.exp(mp - m_tot)
+        num = accp * alpha[..., None] + jnp.einsum(
+            "fkrij,fjkd->fkrid", p_c, v.astype(jnp.float32)
+        )
+        den = lpp * alpha + jnp.sum(p_c, axis=-1)
+        attn = (num / jnp.maximum(den, 1e-30)[..., None]).astype(x.dtype)
+        attn = (
+            attn.transpose(0, 3, 1, 2, 4)
+            .reshape(F, C, cfg.n_q_heads * hd)
+        )
+        x = x + _proj(lp["attn"]["o"], attn)
+        h2 = _norm(x, lp["mlp_norm"], cfg)
+        mlp_out, _ = _mlp_block(cfg, lp, h2, seg_ids=seg_ids)
+        x = x + mlp_out
+        # scatter chunk KV into the pool (in-place on the donated carry);
+        # advanced indices split by the Hkv slice -> result [F, C, Hkv, hd]
+        k_pool = k_pool.at[l, pid, :, off].set(
+            k.astype(k_pool.dtype), mode="drop"
+        )
+        v_pool = v_pool.at[l, pid, :, off].set(
+            v.astype(v_pool.dtype), mode="drop"
+        )
+        return (x, k_pool, v_pool), None
+
+    (x, k_pool, v_pool), _ = jax.lax.scan(
+        body,
+        (x, k_pool, v_pool),
+        (params["layers"], jnp.arange(L)),
+    )
+    last_idx = jnp.maximum(chunk_lens - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)
+    logits = _head(params, cfg, x_last)[:, 0]  # [F, V]
+    return logits, k_pool, v_pool
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "chunk_size", "use_kernel", "max_len", "sample_fn",
+        "stop_fn", "mesh", "kv_axis",
+    ),
+    donate_argnums=(1, 2),
+)
+def paged_decode_chunk(
+    params: Params,
+    k_pool: jax.Array,  # [L, NB, Hkv, BS, hd]
+    v_pool: jax.Array,
+    cfg: TransformerConfig,
+    tables: jax.Array,  # [B, MB]
+    lengths: jax.Array,  # [B] valid cache prefix per row
+    cur_tokens: jax.Array,  # [B] pending token per row (KV not yet cached)
+    active: jax.Array,  # [B] bool
+    budgets: jax.Array,  # [B] remaining new tokens (incl. pending cur)
+    rng: jax.Array,
+    chunk_size: int,
+    sample_fn,  # (logits_f32 [B,V], rng) -> (tokens [B] i32, logps [B] f32)
+    stop_fn,  # (tokens [B]) -> [B] bool
+    use_kernel: bool,
+    max_len: int,
+    mesh=None,
+    kv_axis=None,
+):
+    """Generate up to ``chunk_size`` tokens for all active rows device-side
+    over the paged pool (the paged twin of ``transformer.decode_chunk``).
+
+    In-chunk KV goes to a [L, W, B, Hkv, hd] window written at scalar
+    offsets; prefix attention streams each row's valid blocks through the
+    paged kernel (inactive rows read ZERO blocks — their read length is
+    masked, unlike the dense path whose cost scaled with the padded
+    bucket); the window merges into pool blocks ONCE per chunk through
+    the block tables.  The engine guarantees every active row's table
+    covers ``length + chunk_size`` slots before dispatch.
+
+    Returns (k_pool, v_pool, lengths, out_t [B,W], out_l [B,W],
+    emitted [B,W], cur_tokens, active, budgets, rng).
+    """
+    assert cfg.sliding_window is None, (
+        "paged decode serves global-attention models; sliding-window "
+        "models use the dense window-gather path"
+    )
+    B = cur_tokens.shape[0]
+    W = chunk_size
+    L, NB, Hkv, BS, hd = k_pool.shape
+    r = cfg.n_q_heads // Hkv
+    base_lens = lengths  # frozen: pool-resident prefix per row
+    # dead rows stream nothing (parked/freed rows keep their lengths)
+    read_lens = jnp.where(active, base_lens, 0)
+    scale = 1.0 / np.sqrt(hd)
+
+    wk = jnp.zeros((L, W, B, Hkv, hd), k_pool.dtype)
+    wv = jnp.zeros((L, W, B, Hkv, hd), v_pool.dtype)
+    wvalid0 = jnp.zeros((W, B), bool)
+
+    def step(i, st):
+        (lengths_, cur, active, budgets, k_pool, v_pool, wk, wv, wvalid,
+         out_t, out_l, emitted, rng) = st
+        positions = lengths_[:, None]
+        x = _embed(params, cfg, cur[:, None], positions)
+        rope_cs = (
+            None
+            if cfg.abs_position_embedding
+            else rope_tables(positions, cfg.rotary_base, cfg.head_dim)
+        )
+        wvalid = wvalid.at[i].set(active)
+        mask_win = wvalid.T[:, None, None, None, :]  # [B,1,1,1,W]
+
+        def body(carry, xs):
+            x, wk, wv = carry
+            lp, l = xs
+            h = _norm(x, lp["attn_norm"], cfg)
+            q, k, v = _attn_qkv(cfg, lp, h, positions, rope_cs)
+            wk = jax.lax.dynamic_update_slice(
+                wk, k.swapaxes(0, 1)[None].astype(wk.dtype), (l, i, 0, 0, 0)
+            )
+            wv = jax.lax.dynamic_update_slice(
+                wv, v.swapaxes(0, 1)[None].astype(wv.dtype), (l, i, 0, 0, 0)
+            )
+            wk_l = jax.lax.dynamic_index_in_dim(wk, l, 0, keepdims=False)
+            wv_l = jax.lax.dynamic_index_in_dim(wv, l, 0, keepdims=False)
+            qg = q.reshape(B, 1, Hkv, r, hd)
+            s_win = (
+                jnp.einsum(
+                    "btkrd,wbkd->bkrtw", qg, wk_l.astype(qg.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )
+            s_win = jnp.where(mask_win, s_win, _NEG_INF)  # [B,Hkv,r,1,W]
+            acc, m_main, l_main = _prefix_partials(
+                q, k_pool, v_pool, tables, read_lens, l, use_kernel,
+                mesh=mesh, kv_axis=kv_axis,
+            )
+            acc = acc.reshape(B, Hkv, r, hd)
+            m_main = m_main.reshape(B, Hkv, r)
+            l_main = l_main.reshape(B, Hkv, r)
+            sw = s_win[:, :, :, 0, :]  # [B,Hkv,r,W]
+            m_tot = jnp.maximum(m_main, jnp.max(sw, axis=-1))
+            p_win = jnp.exp(sw - m_tot[..., None])
+            alpha = jnp.exp(m_main - m_tot)
+            num = acc * alpha[..., None] + jnp.einsum(
+                "bkrw,wbkd->bkrd", p_win, wv_l.astype(jnp.float32)
+            )
+            den = l_main * alpha + jnp.sum(p_win, axis=-1)
+            attn = (num / jnp.maximum(den, 1e-30)[..., None]).astype(
+                x.dtype
+            )
+            attn = attn.reshape(B, 1, cfg.n_q_heads * hd)
+            x = x + _proj(lp["attn"]["o"], attn)
+            h2 = _norm(x, lp["mlp_norm"], cfg)
+            mlp_out, _ = _mlp_block(cfg, lp, h2)
+            x = x + mlp_out
+            return (x, wk, wv), None
+
+        (x, wk, wv), _ = jax.lax.scan(
+            body, (x, wk, wv), (params["layers"], jnp.arange(L))
+        )
+        logits = _head(params, cfg, x)[:, 0]
+        rng, sub = jax.random.split(rng)
+        tok, logp = sample_fn(logits.astype(jnp.float32), sub)
+        tok = jnp.where(active, tok, 0)
+        out_t = out_t.at[:, i].set(tok)
+        out_l = out_l.at[:, i].set(jnp.where(active, logp, 0.0))
+        emitted = emitted.at[:, i].set(active)
+        new_lengths = lengths_ + active.astype(jnp.int32)
+        budgets = budgets - active.astype(jnp.int32)
+        active = (
+            active & ~stop_fn(tok) & (budgets > 0) & (new_lengths < max_len)
+        )
+        return (new_lengths, tok, active, budgets, k_pool, v_pool, wk, wv,
+                wvalid, out_t, out_l, emitted, rng)
+
+    out_t = jnp.zeros((B, W), jnp.int32)
+    out_l = jnp.zeros((B, W), jnp.float32)
+    emitted = jnp.zeros((B, W), bool)
+    st = (base_lens, cur_tokens, active, budgets, k_pool, v_pool, wk, wv,
+          wvalid0, out_t, out_l, emitted, rng)
+    (lengths_, cur, active, budgets, k_pool, v_pool, wk, wv, wvalid,
+     out_t, out_l, emitted, rng) = jax.lax.fori_loop(0, W, step, st)
+
+    # merge the window into pool blocks: ONE scatter per chunk
+    offs = base_lens[None, :] + jnp.cumsum(
+        wvalid.astype(jnp.int32), axis=0
+    ) - wvalid.astype(jnp.int32)  # [W, B] absolute slot per window entry
+    b_idx = jnp.broadcast_to(jnp.arange(B)[None, :], (W, B))
+    pid_log = jnp.clip(offs // BS, 0, tables.shape[1] - 1)
+    pid = tables[b_idx, pid_log]  # [W, B]
+    pid = jnp.where(wvalid, pid, NB)  # invalid -> OOB -> dropped
+    off = offs % BS
+    # advanced indices split by the Hkv slice -> result [W, B, L, Hkv, hd]
+    val_k = wk.transpose(1, 2, 0, 3, 4)
+    val_v = wv.transpose(1, 2, 0, 3, 4)
+    k_pool = k_pool.at[:, pid, :, off].set(val_k, mode="drop")
+    v_pool = v_pool.at[:, pid, :, off].set(val_v, mode="drop")
+    return (k_pool, v_pool, lengths_, out_t, out_l, emitted, cur, active,
+            budgets, rng)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def copy_blocks(
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    src: jax.Array,  # [n] pool block ids to copy from
+    dst: jax.Array,  # [n] pool block ids to copy into (NB entries drop)
+) -> Tuple[jax.Array, jax.Array]:
+    """Copy whole blocks inside the pool (group-prompt tail blocks: the
+    full blocks of a shared prompt are REFERENCED by every group member,
+    but the partially-filled last block must be copied per member since
+    their generated tokens diverge inside it)."""
+    src = jnp.clip(src, 0, k_pool.shape[1] - 1)  # pad entries gather blk 0
+    k_pool = k_pool.at[:, dst].set(k_pool[:, src], mode="drop")
+    v_pool = v_pool.at[:, dst].set(v_pool[:, src], mode="drop")
+    return k_pool, v_pool

@@ -865,25 +865,6 @@ def decode_step(
     return logits, KVCache(k=new_k, v=new_v, lengths=new_lengths)
 
 
-def _flash_decode_enabled() -> bool:
-    """Pallas flash-decode dispatch (AREAL_FLASH_DECODE=1 on TPU, =force
-    anywhere via interpret mode).  OPT-IN after three measurement rounds on
-    v5e (0.5B bench model): at ≤2k cache dense wins (3.6k vs 1.7k tok/s,
-    B=32); at 4k cache, uniform-full rows, it TIES dense (1889 vs 1911
-    tok/s, B=16); on its designed regime — mixed row lengths (12x500 +
-    4x3900, attn 4096) where per-row valid-block skipping should cut reads
-    ~60% — it still ties (1878 vs 1884).  The XLA-fused dense path with the
-    bucketed ``attn_len`` prefix (engine._attn_bucket) plus the
-    window-gather path for sliding-window models covers every measured
-    regime at parity or better, so the kernel stays opt-in."""
-    import os
-
-    v = os.environ.get("AREAL_FLASH_DECODE", "0")
-    if v == "force":
-        return True
-    return v == "1" and jax.default_backend() == "tpu"
-
-
 def decode_chunk(
     params: Params,
     cfg: TransformerConfig,
@@ -958,12 +939,14 @@ def decode_chunk(
         attn_k, attn_v = cache.k, cache.v
         Seff = Sa
     mask_base = (jnp.arange(Sa)[None, :] < base_lens[:, None])  # [B,Sa]
-    use_kernel = (
-        _flash_decode_enabled()
-        and Sa % 256 == 0
-        and hd % 128 == 0
-        and cfg.sliding_window is None
-    )
+    # NOTE on kernel dispatch: this dense path intentionally has NO Pallas
+    # kernel branch.  The measured crossover on v5e is structural, not a
+    # flag: below ~2k cache the XLA-fused einsum over the bucketed prefix
+    # wins every regime tested (round 2-4), and at >=2k the ENGINE switches
+    # to the paged pool + paged_flash_attention (cache_mode="auto",
+    # engine/inference_server.py) whose cost scales with each row's true
+    # length.  The former AREAL_FLASH_DECODE env opt-in is gone
+    # (round-4 verdict #7).
 
     wk = jnp.zeros((L, W, B, Hkv, hd), cache.k.dtype)
     wv = jnp.zeros((L, W, B, Hkv, hd), cache.v.dtype)
@@ -1025,47 +1008,22 @@ def decode_chunk(
                 preferred_element_type=jnp.float32,
             ) / np.sqrt(hd)
             s_win = jnp.where(mask_win, s_win, -1e30)  # [B,Hkv,r,1,W]
-            if use_kernel:
-                # Pallas flash-decode over the cache prefix (reads only each
-                # row's valid blocks), online-merged with the window scores
-                from areal_tpu.ops.decode_attention import flash_decode
-
-                acc, m_main, l_main = flash_decode(
-                    q[:, 0], kc, vc, base_lens,
-                    interpret=jax.default_backend() != "tpu",
-                )
-                acc = acc.reshape(B, Hkv, r, hd)
-                m_main = m_main.reshape(B, Hkv, r)
-                l_main = l_main.reshape(B, Hkv, r)
-                sw = s_win[:, :, :, 0, :]  # [B,Hkv,r,W]
-                m_tot = jnp.maximum(m_main, jnp.max(sw, axis=-1))
-                p_win = jnp.exp(sw - m_tot[..., None])
-                alpha = jnp.exp(m_main - m_tot)  # [B,Hkv,r]
-                num = acc * alpha[..., None] + jnp.einsum(
-                    "bkrw,wbkd->bkrd", p_win, wv_l.astype(jnp.float32)
-                )
-                den = l_main * alpha + jnp.sum(p_win, axis=-1)
-                attn = (num / jnp.maximum(den, 1e-30)[..., None]).astype(
-                    x.dtype
-                )
-                attn = attn.reshape(B, 1, cfg.n_q_heads * hd)
-            else:
-                s_main = jnp.einsum(
-                    "btkrd,bksd->bkrts", qg, kc.astype(qg.dtype),
-                    preferred_element_type=jnp.float32,
-                ) / np.sqrt(hd)
-                s_main = jnp.where(
-                    mask_main[:, None, None, None, :], s_main, -1e30
-                )
-                s = jnp.concatenate([s_main, s_win], axis=-1)
-                p = jax.nn.softmax(s, axis=-1)
-                p_main, p_win = p[..., :Seff], p[..., Seff:]
-                attn = jnp.einsum(
-                    "bkrts,bksd->btkrd", p_main.astype(vc.dtype), vc
-                ) + jnp.einsum(
-                    "bkrtw,wbkd->btkrd", p_win.astype(wv_l.dtype), wv_l
-                )
-                attn = attn.reshape(B, 1, cfg.n_q_heads * hd)
+            s_main = jnp.einsum(
+                "btkrd,bksd->bkrts", qg, kc.astype(qg.dtype),
+                preferred_element_type=jnp.float32,
+            ) / np.sqrt(hd)
+            s_main = jnp.where(
+                mask_main[:, None, None, None, :], s_main, -1e30
+            )
+            s = jnp.concatenate([s_main, s_win], axis=-1)
+            p = jax.nn.softmax(s, axis=-1)
+            p_main, p_win = p[..., :Seff], p[..., Seff:]
+            attn = jnp.einsum(
+                "bkrts,bksd->btkrd", p_main.astype(vc.dtype), vc
+            ) + jnp.einsum(
+                "bkrtw,wbkd->btkrd", p_win.astype(wv_l.dtype), wv_l
+            )
+            attn = attn.reshape(B, 1, cfg.n_q_heads * hd)
             x = x + _proj(lp["attn"]["o"], attn)
             h = _norm(x, lp["mlp_norm"], cfg)
             mlp_out, _ = _mlp_block(cfg, lp, h)

@@ -4,16 +4,19 @@ In-house TPU kernel for the serving engine's paged KV cache (the role
 SGLang/vLLM paged decode kernels play behind the reference's generation
 server, reference: realhf/impl/model/backend/sglang.py:369 + SURVEY §2.8
 "splash/paged attention kernels").  KV lives in a shared pool of
-fixed-size blocks ``[Hkv, NB, BS, hd]``; each batch row owns an ordered
-list of pool block ids (its *block table*), so cache capacity is
-allocated in BS-token pages instead of dense ``max_len`` rows — the
-difference between a handful of 32k rows fitting one chip and dozens.
+fixed-size blocks, PAGE-major ``[NB, Hkv, BS, hd]`` (one page = one
+contiguous HBM extent); each batch row owns an ordered list of pool
+block ids (its *block table*), so cache capacity is allocated in
+BS-token pages instead of dense ``max_len`` rows — the difference
+between a handful of 32k rows fitting one chip and dozens.
 
 Kernel shape:
 
-* grid ``(B, Hkv, MB)`` — MB is the static per-row block capacity; the
-  minor axis iterates sequentially on TPU so online-softmax state
-  (m/l/acc) lives in VMEM scratch across blocks;
+* grid ``(B, QB, ceil(MB/G))`` — MB is the static per-row block
+  capacity, G pages stream per step (PAGE_GROUP), QB tiles the query
+  axis so VMEM scratch stays bounded at prefill-chunk shapes; the minor
+  axis iterates sequentially on TPU so online-softmax state (m/l/acc)
+  lives in VMEM scratch across blocks;
 * the K/V index maps ride TWO scalar-prefetch operands: ``lengths``
   clamps the block index to each row's last valid block (trailing grid
   steps re-address the same tile and the pipeline skips their HBM->VMEM
@@ -21,8 +24,8 @@ Kernel shape:
   translates the clamped logical block index into a pool block id;
 * queries are GQA-grouped AND chunk-grouped: ``q`` carries Q query
   tokens per row (Q=1 for decode; Q=chunk for chunked prefill's
-  prefix attention) and all Q*r query rows of a (b, h) cell share one
-  streamed KV block — the pool is read once per KV head per block.
+  prefix attention) and every query row of a (b, qb) cell shares one
+  streamed KV page — all KV heads of a page ride one contiguous DMA.
 
 Returns UN-normalized partials ``(acc, m, l)`` so the caller online-merges
 them with attention over KV not in the pool yet (the decode chunk's
@@ -50,22 +53,38 @@ DEFAULT_BLOCK = 256
 _NEG_INF = -1e30
 
 
+#: logical pages streamed per grid step.  The kernel is DMA-LATENCY-bound
+#: at one small page per step (~1us fixed cost per HBM->VMEM copy caps it
+#: at ~200 GB/s on v5e); issuing G page copies per step overlaps their
+#: latencies.  Measured on v5e at 8k ctx (1.5B arch, B=16, 256-token
+#: pages): G=1 0.70x of the dense-einsum path, G=4 0.78x, and G=4 with
+#: 1024-token pages 0.93x — G=8 regresses (0.83x), so 4 it is.
+PAGE_GROUP = 4
+
+
+#: cap on query rows (Q*r) per grid cell: bounds the f32 scratch at
+#: ~Hkv * 512 * (hd + 256) * 4 bytes (~1.6 MB at Hkv=2, hd=128) so
+#: prefill-chunk shapes (Q up to prefill_chunk_tokens) tile the query
+#: axis instead of blowing VMEM (code-review r5 #3)
+MAX_Q_ROWS = 512
+
+
 def _kernel(
     lengths_ref,  # scalar prefetch [B]
     tables_ref,  # scalar prefetch [B, MB]
-    q_ref,  # (1, 1, QR, hd)
-    k_ref,  # (1, 1, BS, hd) — pool block selected by the index map
-    v_ref,  # (1, 1, BS, hd)
-    acc_ref,  # out (1, 1, QR, hd) f32
-    m_ref,  # out (1, 1, QR, 128) f32 (value replicated along lanes)
-    l_ref,  # out (1, 1, QR, 128) f32
-    s_acc,  # scratch (QR, hd) f32
-    s_m,  # scratch (QR, 128) f32
-    s_l,  # scratch (QR, 128) f32
-    *,
+    layer_ref,  # scalar prefetch [1] (0 when the pool is per-layer)
+    q_ref,  # (1, 1, Hkv, QR, hd)
+    *refs,  # G k-page refs, G v-page refs, 3 outs, 3 scratch
     block_size: int,
     scale: float,
+    n_kv_heads: int,
+    page_group: int,
 ):
+    G = page_group
+    k_refs = refs[:G]
+    v_refs = refs[G : 2 * G]
+    acc_ref, m_ref, l_ref = refs[2 * G : 2 * G + 3]
+    s_acc, s_m, s_l = refs[2 * G + 3 :]
     b = pl.program_id(0)
     j = pl.program_id(2)
     nb = pl.num_programs(2)
@@ -75,36 +94,53 @@ def _kernel(
         softmax_scratch_init(s_acc, s_m, s_l)
 
     length = lengths_ref[b]
-    base = j * block_size
+    hd = k_refs[0].shape[-1]
+    for g in range(G):
+        base = (j * G + g) * block_size
 
-    @pl.when(base < length)
-    def _block():
-        softmax_block_update(
-            q_ref, k_ref, v_ref, s_acc, s_m, s_l,
-            base=base, length=length, scale=scale,
-        )
+        @pl.when(base < length)
+        def _block(g=g, base=base):
+            # each page tile is one CONTIGUOUS (Hkv, BS, hd) copy; all
+            # KV heads ride it together
+            k_all = k_refs[g][...].reshape(n_kv_heads, block_size, hd)
+            v_all = v_refs[g][...].reshape(n_kv_heads, block_size, hd)
+            for h in range(n_kv_heads):
+                softmax_block_update(
+                    q_ref[0, 0, h], k_all[h], v_all[h],
+                    s_acc.at[h], s_m.at[h], s_l.at[h],
+                    base=base, length=length, scale=scale,
+                )
 
     @pl.when(j == nb - 1)
     def _emit():
-        softmax_emit(acc_ref, m_ref, l_ref, s_acc, s_m, s_l)
+        acc_ref[0, 0] = s_acc[...]
+        m_ref[0, 0] = s_m[...]
+        l_ref[0, 0] = s_l[...]
 
 
-def _paged_kv_map(b, h, j, lengths_ref, tables_ref, *, block_size):
-    # clamp to the last LOGICAL block holding valid KV for row b, then
-    # translate through the row's block table into a pool block id
+def _paged_kv_map(b, qb, j, lengths_ref, tables_ref, layer_ref, *,
+                  block_size, layered, group, offset):
+    # page ``j * group + offset``, clamped to the last LOGICAL block
+    # holding valid KV for row b (trailing steps re-address that tile and
+    # the pipeline skips their copies), then translated through the row's
+    # block table into a pool block id
     last = jnp.maximum(
         (lengths_ref[b] + block_size - 1) // block_size - 1, 0
     )
-    return (h, tables_ref[b, jnp.minimum(j, last)], 0, 0)
+    pid = tables_ref[b, jnp.minimum(j * group + offset, last)]
+    if layered:
+        return (layer_ref[0], pid, 0, 0, 0)
+    return (pid, 0, 0, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_flash_attention(
     q: jax.Array,  # [B, Q, Hq, hd]
-    k_pool: jax.Array,  # [Hkv, NB, BS, hd]
-    v_pool: jax.Array,  # [Hkv, NB, BS, hd]
+    k_pool: jax.Array,  # [NB, Hkv, BS, hd] or [L, NB, Hkv, BS, hd]
+    v_pool: jax.Array,
     tables: jax.Array,  # [B, MB] int32 — pool block id per logical block
     lengths: jax.Array,  # [B] int32 — valid cache prefix per row
+    layer: jax.Array | None = None,  # [] or [1] int32, for stacked pools
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Un-normalized online-softmax attention partials over paged KV.
@@ -114,53 +150,106 @@ def paged_flash_attention(
     prefix precedes the whole chunk — in-chunk causality is the caller's
     self-attention term).  Returns ``(acc [B,Q,Hq,hd] f32, m [B,Q,Hq],
     l [B,Q,Hq])``; rows with ``length == 0`` return ``acc=0, l=0, m=-inf``.
+
+    Pool layout is PAGE-major ``[NB, Hkv, BS, hd]`` so one page's tile is
+    one contiguous (Hkv, BS, hd) HBM read, and the grid streams
+    ``PAGE_GROUP`` pages per step (their DMAs overlap — see PAGE_GROUP).
+
+    A 5-D ``k_pool``/``v_pool`` is the FULL layer-stacked pool; ``layer``
+    (traced scalar) selects the layer inside the kernel's index map, so a
+    layer scan never materializes a per-layer pool slice (that slice is
+    pool_bytes/L of pure copy traffic per layer — the whole pool per
+    forward).
     """
     B, Q, Hq, hd = q.shape
-    Hkv, NB, BS, _ = k_pool.shape
+    layered = k_pool.ndim == 5
+    NB, Hkv, BS, _ = k_pool.shape[-4:]
     MB = tables.shape[1]
     assert Hq % Hkv == 0, (Hq, Hkv)
+    if layered:
+        assert layer is not None, "layer index required for a stacked pool"
     r = Hq // Hkv
+    # tile the query axis: QT tokens per grid cell, QT*r rows of scratch
+    QT = max(1, min(Q, MAX_Q_ROWS // r))
+    QB = -(-Q // QT)
+    Qp = QB * QT
+    q_pad = (
+        jnp.pad(q, ((0, 0), (0, Qp - Q), (0, 0), (0, 0)))
+        if Qp != Q
+        else q
+    )
     qg = (
-        q.reshape(B, Q, Hkv, r, hd)
-        .transpose(0, 2, 1, 3, 4)
-        .reshape(B, Hkv, Q * r, hd)
+        q_pad.reshape(B, QB, QT, Hkv, r, hd)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(B, QB, Hkv, QT * r, hd)
+    )
+    layer_arr = (
+        jnp.zeros((1,), jnp.int32)
+        if layer is None
+        else jnp.asarray(layer, jnp.int32).reshape(1)
     )
 
-    grid = (B, Hkv, MB)
-    kv_map = functools.partial(_paged_kv_map, block_size=BS)
+    G = min(PAGE_GROUP, MB)
+    grid = (B, QB, -(-MB // G))
+    kv_block = (1, 1, Hkv, BS, hd) if layered else (1, Hkv, BS, hd)
+    kv_specs = [
+        pl.BlockSpec(
+            kv_block,
+            functools.partial(
+                _paged_kv_map,
+                block_size=BS,
+                layered=layered,
+                group=G,
+                offset=g,
+            ),
+        )
+        for g in range(G)
+    ]
     acc, m, l = pl.pallas_call(
-        functools.partial(_kernel, block_size=BS, scale=1.0 / np.sqrt(hd)),
+        functools.partial(
+            _kernel,
+            block_size=BS,
+            scale=1.0 / np.sqrt(hd),
+            n_kv_heads=Hkv,
+            page_group=G,
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, Q * r, hd), lambda b, h, j, L, T: (b, h, 0, 0)
-                ),
-                pl.BlockSpec((1, 1, BS, hd), kv_map),
-                pl.BlockSpec((1, 1, BS, hd), kv_map),
-            ],
+            in_specs=(
+                [
+                    pl.BlockSpec(
+                        (1, 1, Hkv, QT * r, hd),
+                        lambda b, qb, j, L, T, Y: (b, qb, 0, 0, 0),
+                    )
+                ]
+                + kv_specs  # G k-page streams
+                + kv_specs  # G v-page streams (same maps, v operands)
+            ),
             out_specs=[
                 pl.BlockSpec(
-                    (1, 1, Q * r, hd), lambda b, h, j, L, T: (b, h, 0, 0)
+                    (1, 1, Hkv, QT * r, hd),
+                    lambda b, qb, j, L, T, Y: (b, qb, 0, 0, 0),
                 ),
                 pl.BlockSpec(
-                    (1, 1, Q * r, 128), lambda b, h, j, L, T: (b, h, 0, 0)
+                    (1, 1, Hkv, QT * r, 128),
+                    lambda b, qb, j, L, T, Y: (b, qb, 0, 0, 0),
                 ),
                 pl.BlockSpec(
-                    (1, 1, Q * r, 128), lambda b, h, j, L, T: (b, h, 0, 0)
+                    (1, 1, Hkv, QT * r, 128),
+                    lambda b, qb, j, L, T, Y: (b, qb, 0, 0, 0),
                 ),
             ],
             scratch_shapes=[
-                pltpu.VMEM((Q * r, hd), jnp.float32),
-                pltpu.VMEM((Q * r, 128), jnp.float32),
-                pltpu.VMEM((Q * r, 128), jnp.float32),
+                pltpu.VMEM((Hkv, QT * r, hd), jnp.float32),
+                pltpu.VMEM((Hkv, QT * r, 128), jnp.float32),
+                pltpu.VMEM((Hkv, QT * r, 128), jnp.float32),
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((B, Hkv, Q * r, hd), jnp.float32),
-            jax.ShapeDtypeStruct((B, Hkv, Q * r, 128), jnp.float32),
-            jax.ShapeDtypeStruct((B, Hkv, Q * r, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, 128), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -169,16 +258,17 @@ def paged_flash_attention(
     )(
         lengths.astype(jnp.int32),
         tables.astype(jnp.int32),
+        layer_arr,
         qg,
-        k_pool,
-        v_pool,
+        *([k_pool] * G),
+        *([v_pool] * G),
     )
 
     def unravel(x, lanes):
         return (
-            x.reshape(B, Hkv, Q, r, lanes)
-            .transpose(0, 2, 1, 3, 4)
-            .reshape(B, Q, Hq, lanes)
+            x.reshape(B, QB, Hkv, QT, r, lanes)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(B, Qp, Hq, lanes)[:, :Q]
         )
 
     return (
@@ -189,7 +279,7 @@ def paged_flash_attention(
 
 
 def gather_paged_kv(
-    k_pool: jax.Array,  # [Hkv, NB, BS, hd] (or [L, Hkv, NB, BS, hd])
+    k_pool: jax.Array,  # [NB, Hkv, BS, hd] (or [L, NB, Hkv, BS, hd])
     v_pool: jax.Array,
     tables: jax.Array,  # [B, MB]
 ) -> Tuple[jax.Array, jax.Array]:
@@ -197,8 +287,8 @@ def gather_paged_kv(
     pool (jnp reference/CPU path; the kernel never does this)."""
 
     def g(pool):
-        gathered = jnp.take(pool, tables, axis=-3)  # [..,Hkv,B,MB,BS,hd]
-        gathered = jnp.moveaxis(gathered, -4, -5)  # [..,B,Hkv,MB,BS,hd]
+        gathered = jnp.take(pool, tables, axis=-4)  # [..,B,MB,Hkv,BS,hd]
+        gathered = jnp.moveaxis(gathered, -3, -4)  # [..,B,Hkv,MB,BS,hd]
         s = gathered.shape
         return gathered.reshape(*s[:-3], s[-3] * s[-2], s[-1])
 
@@ -208,7 +298,7 @@ def gather_paged_kv(
 def reference_paged_partials(q, k_pool, v_pool, tables, lengths):
     """jnp reference for :func:`paged_flash_attention` (same contract)."""
     B, Q, Hq, hd = q.shape
-    Hkv, NB, BS, _ = k_pool.shape
+    NB, Hkv, BS, _ = k_pool.shape
     r = Hq // Hkv
     k, v = gather_paged_kv(k_pool, v_pool, tables)  # [B,Hkv,S,hd]
     S = k.shape[2]

@@ -202,13 +202,66 @@ class AutomaticEvaluator:
                 s.process.terminate()
 
 
+def _claimed_devices(cfg) -> int:
+    """Local devices the experiment's workers occupy (train meshes start
+    at device 0; gen servers sit at explicit ``device_idx`` offsets)."""
+    n = 0
+    for w in getattr(cfg, "model_workers", []) or []:
+        for s in w.shards:
+            n = max(n, s.mesh_spec.world_size)
+    for g in getattr(cfg, "gen_servers", []) or []:
+        if g.device_idx is not None:
+            n = max(n, g.device_idx + g.mesh_spec.world_size)
+        else:
+            n = max(n, g.mesh_spec.world_size)
+    return n
+
+
+def resolve_eval_env(cfg, device: str) -> Dict[str, str]:
+    """Subprocess env for ``EvaluatorConfig.device``:
+
+    * ``"auto"`` (default): evals run ON a spare local accelerator when
+      the experiment's workers leave one free — the reference's dedicated
+      eval partition (realhf/scheduler/evaluator.py:34) — pinned via
+      ``TPU_VISIBLE_DEVICES`` so the subprocess cannot grab the training
+      chips; with no spare device the eval falls back to CPU (an eval
+      contending for a training chip would OOM it).
+    * a platform string (``"cpu"``, ``"tpu"``): forced via JAX_PLATFORMS.
+    * ``""``: inherit the host platform unconditionally.
+    """
+    if device == "auto":
+        import jax
+
+        n_dev = len(jax.devices())
+        claimed = _claimed_devices(cfg)
+        if claimed < n_dev:
+            env = dict(os.environ)
+            # the subprocess targets THIS host's platform (not whatever a
+            # stale JAX_PLATFORMS in the launcher env says)
+            env["JAX_PLATFORMS"] = jax.default_backend()
+            if jax.default_backend() == "tpu":
+                env["TPU_VISIBLE_DEVICES"] = str(n_dev - 1)
+            logger.info(
+                "evaluator: %d/%d local devices claimed by workers; "
+                "eval jobs run on-device",
+                claimed, n_dev,
+            )
+            return env
+        logger.info(
+            "evaluator: all %d local devices claimed; eval jobs fall "
+            "back to CPU", n_dev,
+        )
+        return {**os.environ, "JAX_PLATFORMS": "cpu"}
+    if device:
+        return {**os.environ, "JAX_PLATFORMS": device}
+    return dict(os.environ)
+
+
 def make_evaluator(cfg) -> Optional[AutomaticEvaluator]:
     """Build the checkpoint-watching evaluator for an ExperimentConfig
     (None when the experiment configures none).  Shared by the process
     launcher's monitor loop and the threaded local runner; the eval
-    subprocess runs on ``EvaluatorConfig.device`` ("cpu" by default —
-    training workers own every local chip), or inherits the host platform
-    when set to "" (dedicated eval chip/host)."""
+    subprocess device policy is :func:`resolve_eval_env`."""
     if getattr(cfg, "evaluator", None) is None:
         return None
     from areal_tpu.base import constants
@@ -226,11 +279,7 @@ def make_evaluator(cfg) -> Optional[AutomaticEvaluator]:
         ),
         max_prompts=ecfg.max_prompts,
         max_new_tokens=ecfg.max_new_tokens,
-        env=(
-            {**os.environ, "JAX_PLATFORMS": ecfg.device}
-            if ecfg.device
-            else dict(os.environ)  # inherit: evals run on-chip by default
-        ),
+        env=resolve_eval_env(cfg, ecfg.device),
     )
 
 

@@ -347,6 +347,7 @@ class GserverManagerClient:
         self._local = threading.local()
         self.addr = addr
         self.timeout = timeout
+        self._abort = threading.Event()
 
     def _sock(self):
         import threading
@@ -358,15 +359,24 @@ class GserverManagerClient:
         return self._local.sock
 
     def call(self, cmd: str, payload: Dict):
+        from areal_tpu.system.generation_server import _poll_abortable
+
         sock = self._sock()
         sock.send(pickle.dumps((cmd, payload)))
-        if not sock.poll(timeout=int(self.timeout * 1000)):
+        if not _poll_abortable(sock, self.timeout, self._abort):
             # a REQ socket is stuck in recv state after a timeout: discard it
             # so the next call starts clean (the late reply is dropped)
             sock.close(linger=0)
             del self._local.sock
+            if self._abort.is_set():
+                raise TimeoutError(f"{cmd}: manager client closed")
             raise TimeoutError(f"{cmd} to gserver manager timed out")
         resp = pickle.loads(sock.recv())
         if isinstance(resp, dict) and "error" in resp:
             raise RuntimeError(resp["error"])
         return resp
+
+    def close(self):
+        self._abort.set()  # unblock in-flight executor threads promptly
+        if hasattr(self._local, "sock"):
+            self._local.sock.close(linger=0)

@@ -148,5 +148,9 @@ class RolloutWorker(worker_base.AsyncWorker):
     def _exit_hook(self):
         if hasattr(self, "prm"):
             self.prm.close()
+        if hasattr(self, "manager_client"):
+            # unblocks executor threads parked in manager calls; without
+            # this asyncio.run's shutdown joins them for up to 300s
+            self.manager_client.close()
         if hasattr(self, "pusher"):
             self.pusher.close()

@@ -18,7 +18,15 @@ from areal_tpu.models.config import tiny_config
 EOS = 5
 
 
-def make_engine(params=None, cfg=None, **kw):
+@pytest.fixture(params=["dense", "paged"])
+def mode(request):
+    """Every engine behavior must hold for BOTH cache layouts: the dense
+    per-row cache and the paged block pool (small pages + a small prefill
+    chunk so prompts span blocks and fills span chunks)."""
+    return request.param
+
+
+def make_engine(params=None, cfg=None, mode="dense", **kw):
     cfg = cfg or tiny_config(vocab_size=64, max_position_embeddings=256)
     if params is None:
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
@@ -29,6 +37,10 @@ def make_engine(params=None, cfg=None, **kw):
         sampling=SamplingParams(greedy=True),
         stop_tokens=(EOS,),
     )
+    if mode == "paged":
+        defaults.update(
+            cache_mode="paged", page_size=16, prefill_chunk_tokens=16
+        )
     defaults.update(kw)
     return ContinuousBatchingEngine(cfg, params, **defaults), cfg, params
 
@@ -41,12 +53,12 @@ def run_until_done(eng, max_steps=200):
     raise AssertionError("engine did not drain")
 
 
-def test_greedy_parity_with_batch_generator():
+def test_greedy_parity_with_batch_generator(mode):
     """The continuous engine must produce the same greedy tokens as the
     static generate_loop for the same prompts."""
     from areal_tpu.engine.generation import generate_tokens
 
-    eng, cfg, params = make_engine()
+    eng, cfg, params = make_engine(mode=mode, )
     gconfig = GenerationHyperparameters(
         max_new_tokens=12, greedy=True, n=1
     )
@@ -80,8 +92,8 @@ def test_greedy_parity_with_batch_generator():
         )
 
 
-def test_continuous_admission_more_requests_than_rows():
-    eng, cfg, params = make_engine(max_batch=2)
+def test_continuous_admission_more_requests_than_rows(mode):
+    eng, cfg, params = make_engine(mode=mode, max_batch=2)
     gconfig = GenerationHyperparameters(max_new_tokens=6, greedy=True)
     qids = [
         eng.submit(
@@ -101,10 +113,10 @@ def test_continuous_admission_more_requests_than_rows():
         assert len(out.output_logprobs) == len(out.output_ids)
 
 
-def test_weight_update_interrupts_and_recomputes():
+def test_weight_update_interrupts_and_recomputes(mode):
     """Swap weights mid-generation: in-flight rows continue under the new
     weights and version_start/version_end record the transition."""
-    eng, cfg, params = make_engine(chunk_size=2)
+    eng, cfg, params = make_engine(mode=mode, chunk_size=2)
     gconfig = GenerationHyperparameters(max_new_tokens=20, greedy=True)
     qid = eng.submit(
         APIGenerateInput(
@@ -144,8 +156,8 @@ def test_weight_update_interrupts_and_recomputes():
     assert out.output_ids[k:] == ref[0]["output_ids"]
 
 
-def test_version_stamps_without_update():
-    eng, cfg, params = make_engine()
+def test_version_stamps_without_update(mode):
+    eng, cfg, params = make_engine(mode=mode, )
     gconfig = GenerationHyperparameters(max_new_tokens=4, greedy=True)
     qid = eng.submit(
         APIGenerateInput(
@@ -157,11 +169,11 @@ def test_version_stamps_without_update():
     assert out.version_start == 0 and out.version_end == 0
 
 
-def test_group_prefill_dedup():
+def test_group_prefill_dedup(mode):
     """A sampling group's n requests over one prompt must pay ONE prefill
     (unique-prompt dedup in _prefill_rows), with every member still decoded
     independently."""
-    eng, cfg, params = make_engine(max_batch=4)
+    eng, cfg, params = make_engine(mode=mode, max_batch=4)
     gconfig = GenerationHyperparameters(max_new_tokens=6, greedy=True)
     prompt = [7, 8, 9, 10]
     qids = [
@@ -182,12 +194,12 @@ def test_group_prefill_dedup():
         assert o.output_ids == outs[0].output_ids
 
 
-def test_chunked_continuation_resumes_without_prefill():
+def test_chunked_continuation_resumes_without_prefill(mode):
     """The partial-rollout chunk pattern: a budget-exhausted row parks its
     KV; the continuation (same qid, token-exact context) resumes decoding
     with ZERO additional prefill and the concatenated output matches one
     unchunked run."""
-    eng, cfg, params = make_engine(max_batch=2, chunk_size=4)
+    eng, cfg, params = make_engine(mode=mode, max_batch=2, chunk_size=4)
     prompt = [11, 12, 13]
     full = GenerationHyperparameters(max_new_tokens=12, greedy=True)
     from areal_tpu.engine.generation import generate_tokens
@@ -225,10 +237,10 @@ def test_chunked_continuation_resumes_without_prefill():
     assert eng.resumed_total == n_chunks - 1 >= 1
 
 
-def test_parked_row_evicted_for_fresh_request():
+def test_parked_row_evicted_for_fresh_request(mode):
     """With every row parked, a new request evicts the oldest parked row
     instead of deadlocking."""
-    eng, cfg, params = make_engine(max_batch=1, chunk_size=4)
+    eng, cfg, params = make_engine(mode=mode, max_batch=1, chunk_size=4)
     q1 = eng.submit(
         APIGenerateInput(
             qid="a", prompt_ids=[3, 4], input_ids=[3, 4],
@@ -250,10 +262,10 @@ def test_parked_row_evicted_for_fresh_request():
     assert eng.n_parked == 1  # q2 is now the parked one
 
 
-def test_continuation_after_weight_update_reprefills():
+def test_continuation_after_weight_update_reprefills(mode):
     """A weight update evicts parked KV (computed under old weights); the
     continuation re-prefills and decodes under the NEW weights."""
-    eng, cfg, params = make_engine(max_batch=2, chunk_size=4)
+    eng, cfg, params = make_engine(mode=mode, max_batch=2, chunk_size=4)
     prompt = [7, 8, 9]
     q1 = eng.submit(
         APIGenerateInput(
@@ -291,12 +303,12 @@ def test_continuation_after_weight_update_reprefills():
     assert out2.output_ids == ref
 
 
-def test_resume_race_with_pipelined_harvest():
+def test_resume_race_with_pipelined_harvest(mode):
     """A parked row resumed between a chunk's dispatch and its harvest must
     NOT be touched by that harvest (the dispatch-time snapshot refers to the
     previous occupancy).  Regression: this raced in the async PPO e2e and
     crashed _finish on an empty generation (round-3 pipelining bug)."""
-    eng, cfg, params = make_engine(max_batch=2, chunk_size=4)
+    eng, cfg, params = make_engine(mode=mode, max_batch=2, chunk_size=4)
     long_g = GenerationHyperparameters(max_new_tokens=40, greedy=True)
     short_g = GenerationHyperparameters(max_new_tokens=4, greedy=True)
     prompt_a, prompt_b = [11, 12, 13], [7, 8]

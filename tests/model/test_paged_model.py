@@ -1,0 +1,183 @@
+"""Paged fill/decode chunks vs the proven dense prefill/decode paths.
+
+The paged pool + block tables must be a pure re-layout: identical logits
+and identical greedy decode to the dense per-row cache, regardless of how
+the prompt is split into fill chunks or how blocks are scattered in the
+pool."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.models import paged
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import (
+    KVCache,
+    decode_chunk,
+    init_params,
+    prefill,
+)
+
+BS = 16  # small block size so prompts span several blocks
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _dense_prefill_logits(cfg, params, prompts):
+    B = len(prompts)
+    T = max(len(p) for p in prompts)
+    toks = np.zeros((B, T), np.int32)
+    lens = np.zeros((B,), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, : len(p)] = p
+        lens[i] = len(p)
+    pos = np.tile(np.arange(T, dtype=np.int32)[None], (B, 1))
+    seg = (pos < lens[:, None]).astype(np.int32)
+    cache = KVCache.zeros(cfg, B, 64)
+    logits, cache = prefill(
+        params, cfg, jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(seg),
+        cache, last_pos=jnp.asarray(lens - 1),
+    )
+    return np.asarray(logits[:, 0]), cache, lens
+
+
+def _paged_fill(cfg, params, prompts, chunk, scramble_seed=0):
+    """Fill via paged chunks of size ``chunk``; returns (logits, pools,
+    tables, lengths)."""
+    B = len(prompts)
+    MB = 8
+    NB = B * MB + 4
+    kp, vp = paged.pool_zeros(cfg, NB, BS)
+    rng = np.random.RandomState(scramble_seed)
+    perm = rng.permutation(NB)[: B * MB]
+    tables = jnp.asarray(perm.reshape(B, MB), jnp.int32)
+    lens = np.array([len(p) for p in prompts], np.int32)
+    last = np.zeros((B, cfg.vocab_size), np.float32)
+    filled = np.zeros((B,), np.int32)
+    while (filled < lens).any():
+        cl = np.minimum(lens - filled, chunk)
+        toks = np.zeros((B, chunk), np.int32)
+        for i, p in enumerate(prompts):
+            got = p[filled[i] : filled[i] + cl[i]]
+            toks[i, : len(got)] = got
+        logits, kp, vp = paged.paged_fill_chunk(
+            params, kp, vp, cfg,
+            jnp.asarray(toks), jnp.asarray(filled), jnp.asarray(cl),
+            tables, use_kernel=False,
+        )
+        new_filled = filled + cl
+        # a row's last-logits are valid only on ITS final chunk
+        done_now = (cl > 0) & (new_filled == lens)
+        last[done_now] = np.asarray(logits)[done_now]
+        filled = new_filled
+    return last, kp, vp, tables, jnp.asarray(lens)
+
+
+@pytest.mark.parametrize("chunk", [64, 7, 16])
+def test_fill_chunks_match_dense_prefill(cfg, params, chunk):
+    rng = np.random.RandomState(1)
+    prompts = [
+        list(rng.randint(0, cfg.vocab_size, n)) for n in (5, 23, 40, 17)
+    ]
+    dense_logits, _, _ = _dense_prefill_logits(cfg, params, prompts)
+    paged_logits, *_ = _paged_fill(cfg, params, prompts, chunk)
+    np.testing.assert_allclose(
+        paged_logits, dense_logits, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_paged_decode_matches_dense_decode(cfg, params):
+    rng = np.random.RandomState(2)
+    prompts = [
+        list(rng.randint(0, cfg.vocab_size, n)) for n in (9, 30, 21)
+    ]
+    W = 8
+    dense_logits, dense_cache, lens = _dense_prefill_logits(
+        cfg, params, prompts
+    )
+    paged_logits, kp, vp, tables, plens = _paged_fill(
+        cfg, params, prompts, chunk=16
+    )
+    greedy = lambda logits, _rng: (
+        jnp.argmax(logits, -1).astype(jnp.int32),
+        jnp.max(jax.nn.log_softmax(logits), -1),
+    )
+    stop = lambda toks: jnp.zeros_like(toks, bool)
+    cur = jnp.argmax(jnp.asarray(dense_logits), -1).astype(jnp.int32)
+    B = cur.shape[0]
+    active = jnp.ones((B,), bool)
+    budgets = jnp.full((B,), W + 1, jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    (dc, d_t, d_l, d_em, d_cur, d_act, d_bud, _) = decode_chunk(
+        params, cfg, dense_cache, cur, active, budgets, key, W,
+        greedy, stop,
+    )
+    (kp, vp, p_lens, p_t, p_l, p_em, p_cur, p_act, p_bud, _) = (
+        paged.paged_decode_chunk(
+            params, kp, vp, cfg, tables, plens, cur, active, budgets,
+            key, W, greedy, stop, use_kernel=False, max_len=BS * 8,
+        )
+    )
+    np.testing.assert_array_equal(np.asarray(d_t), np.asarray(p_t))
+    np.testing.assert_allclose(
+        np.asarray(d_l), np.asarray(p_l), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_array_equal(np.asarray(d_em), np.asarray(p_em))
+    np.testing.assert_array_equal(
+        np.asarray(dc.lengths), np.asarray(p_lens)
+    )
+    # a SECOND chunk continues exactly (window was merged into the pool)
+    (dc, d_t2, *_rest) = decode_chunk(
+        params, cfg, dc, d_cur, d_act, d_bud, key, W, greedy, stop,
+    )
+    (kp, vp, p_lens, p_t2, *_rest2) = paged.paged_decode_chunk(
+        params, kp, vp, cfg, tables, p_lens, p_cur, p_act, p_bud,
+        key, W, greedy, stop, use_kernel=False, max_len=BS * 8,
+    )
+    np.testing.assert_array_equal(np.asarray(d_t2), np.asarray(p_t2))
+
+
+def test_copy_blocks_and_shared_prefix(cfg, params):
+    # simulate group sharing: row 1 references row 0's FULL blocks and a
+    # COPIED tail block; decode over both rows must match two full fills
+    rng = np.random.RandomState(3)
+    prompt = list(rng.randint(0, cfg.vocab_size, 21))  # 21 = 16 + 5 (tail)
+    _, kp, vp, tables, plens = _paged_fill(cfg, params, [prompt], chunk=64)
+    MB = tables.shape[1]
+    # build a 2-row view: row 1 shares block 0, owns a copy of block 1;
+    # the copy target must be a real UNUSED pool block (an OOB id would
+    # gather jnp's NaN fill in the reference path)
+    NB = kp.shape[1]
+    free_blk = min(set(range(NB)) - set(np.asarray(tables).ravel()))
+    kp, vp = paged.copy_blocks(
+        kp, vp, jnp.asarray([int(tables[0, 1])]), jnp.asarray([free_blk])
+    )
+    t2 = np.zeros((2, MB), np.int32)
+    t2[0] = np.asarray(tables[0])
+    t2[1] = np.asarray(tables[0])
+    t2[1, 1] = free_blk
+    tables2 = jnp.asarray(t2)
+    lens2 = jnp.asarray([21, 21], jnp.int32)
+    q = jax.random.normal(
+        jax.random.PRNGKey(5), (1, 1, cfg.n_q_heads, cfg.head_dim)
+    )
+    q = jnp.concatenate([q, q])  # identical query -> identical output
+    from areal_tpu.ops.paged_attention import reference_paged_partials
+
+    for l in range(cfg.n_layers):
+        acc, m, lden = reference_paged_partials(
+            q, kp[l], vp[l], tables2, lens2
+        )
+        np.testing.assert_allclose(
+            np.asarray(acc[0]), np.asarray(acc[1]), rtol=1e-6, atol=1e-6
+        )

@@ -79,12 +79,14 @@ def test_flash_decode_block_size_invariance():
     )
 
 
-def test_decode_chunk_kernel_path_matches_dense(monkeypatch):
-    """The kernel-integrated decode chunk (forced, interpret mode) emits the
-    same greedy tokens as the dense jnp path."""
-    import dataclasses
+def test_paged_decode_chunk_matches_dense_chunk():
+    """The PAGED decode chunk (the >=2k engine path; reference path on CPU)
+    emits the same greedy tokens as the dense decode chunk — the A/B the
+    old AREAL_FLASH_DECODE env flag used to gate, now structural
+    (cache_mode="auto" in the engine; round-4 verdict #7)."""
+    import numpy as _np
 
-    from areal_tpu.models import transformer
+    from areal_tpu.models import paged, transformer
     from areal_tpu.models.config import tiny_config
 
     cfg = tiny_config(
@@ -99,46 +101,50 @@ def test_decode_chunk_kernel_path_matches_dense(monkeypatch):
         dtype="float32",
     )
     params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-    B, S, W = 4, 256, 8
+    B, S, W, BS = 4, 256, 8, 32
     rng = jax.random.PRNGKey(1)
     prompt_lens = jnp.asarray([3, 17, 9, 1], jnp.int32)
     toks = jax.random.randint(jax.random.PRNGKey(2), (B, 64), 0, 128)
     positions = jnp.tile(jnp.arange(64)[None], (B, 1))
     seg = (positions < prompt_lens[:, None]).astype(jnp.int32)
+    cache = transformer.KVCache.zeros(cfg, B, S)
+    _, cache = transformer.prefill(params, cfg, toks, positions, seg, cache)
+    cur = jnp.asarray([5, 6, 7, 8], jnp.int32)
+    active = jnp.ones((B,), bool)
+    budgets = jnp.full((B,), W, jnp.int32)
 
-    def run(force):
-        monkeypatch.setenv(
-            "AREAL_FLASH_DECODE", "force" if force else "0"
-        )
-        cache = transformer.KVCache.zeros(cfg, B, S)
-        _, cache = transformer.prefill(
-            params, cfg, toks, positions, seg, cache
-        )
-        cur = jnp.asarray([5, 6, 7, 8], jnp.int32)
-        active = jnp.ones((B,), bool)
-        budgets = jnp.full((B,), W, jnp.int32)
+    def sample(logits, sub):
+        t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lp = jax.nn.log_softmax(logits)[jnp.arange(B), t]
+        return t, lp
 
-        def sample(logits, sub):
-            t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            lp = jax.nn.log_softmax(logits)[jnp.arange(B), t]
-            return t, lp
-
-        out = transformer.decode_chunk(
-            params, cfg, cache, cur, active, budgets, rng, W,
-            sample, lambda t: jnp.zeros_like(t, bool), attn_len=256,
-        )
-        return out
-
-    c_d, t_d, l_d, e_d, *_ = run(False)
-    c_k, t_k, l_k, e_k, *_ = run(True)
-    np.testing.assert_array_equal(np.asarray(t_d), np.asarray(t_k))
-    np.testing.assert_allclose(
-        np.asarray(l_d), np.asarray(l_k), rtol=2e-3, atol=2e-3
+    stop = lambda t: jnp.zeros_like(t, bool)
+    _, t_d, l_d, e_d, *_ = transformer.decode_chunk(
+        params, cfg, cache, cur, active, budgets, rng, W,
+        sample, stop, attn_len=256,
     )
-    np.testing.assert_array_equal(np.asarray(e_d), np.asarray(e_k))
-    np.testing.assert_allclose(
-        np.asarray(c_d.k), np.asarray(c_k.k), rtol=2e-2, atol=2e-2
+
+    # same prefilled KV re-laid out into a paged pool
+    MB = S // BS
+    kp, vp = paged.pool_zeros(cfg, B * MB + 2, BS)
+    tables = jnp.arange(B * MB, dtype=jnp.int32).reshape(B, MB)
+    # cache.k [L, B, Hkv, S, hd] -> pool [L, NB, Hkv, BS, hd]
+    ck = _np.asarray(cache.k).transpose(0, 1, 3, 2, 4)  # [L,B,S,Hkv,hd]
+    cv = _np.asarray(cache.v).transpose(0, 1, 3, 2, 4)
+    L, _, _, Hkv, hd = ck.shape
+    ck = ck.reshape(L, B * MB, BS, Hkv, hd).transpose(0, 1, 3, 2, 4)
+    cv = cv.reshape(L, B * MB, BS, Hkv, hd).transpose(0, 1, 3, 2, 4)
+    kp = kp.at[:, : B * MB].set(ck)
+    vp = vp.at[:, : B * MB].set(cv)
+    (_, _, _, t_p, l_p, e_p, *_rest) = paged.paged_decode_chunk(
+        params, kp, vp, cfg, tables, cache.lengths, cur, active,
+        budgets, rng, W, sample, stop, use_kernel=False, max_len=S,
     )
+    np.testing.assert_array_equal(np.asarray(t_d), np.asarray(t_p))
+    np.testing.assert_allclose(
+        np.asarray(l_d), np.asarray(l_p), rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_array_equal(np.asarray(e_d), np.asarray(e_p))
 
 
 def test_decode_chunk_sliding_window_matches_stepwise():

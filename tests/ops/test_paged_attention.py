@@ -20,10 +20,10 @@ def _setup(B=4, Q=1, Hq=8, Hkv=4, MB=4, NB=32, hd=128, seed=0,
     ks = jax.random.split(jax.random.PRNGKey(seed), 4)
     q = jax.random.normal(ks[0], (B, Q, Hq, hd), jnp.float32)
     k_pool = jax.random.normal(
-        ks[1], (Hkv, NB, BS, hd), jnp.float32
+        ks[1], (NB, Hkv, BS, hd), jnp.float32
     ).astype(dtype)
     v_pool = jax.random.normal(
-        ks[2], (Hkv, NB, BS, hd), jnp.float32
+        ks[2], (NB, Hkv, BS, hd), jnp.float32
     ).astype(dtype)
     # a scrambled table: logical order != pool order, no duplicates
     perm = jax.random.permutation(ks[3], NB)[: B * MB]
@@ -91,6 +91,30 @@ def test_paged_matches_dense_flash_decode():
     np.testing.assert_allclose(
         np.asarray(l_p[:, 0]), np.asarray(l_d), rtol=2e-3, atol=2e-3
     )
+
+
+def test_layered_pool_matches_per_layer_slice():
+    # the 5-D stacked-pool entry with a layer scalar must equal slicing
+    # the layer out and calling the 4-D form
+    q, kp, vp, tables, lens = _setup(B=2, Hq=4, Hkv=2, MB=2, NB=8,
+                                     lengths=[200, 77], seed=11)
+    L = 3
+    kps = jnp.stack([kp + i for i in range(L)])
+    vps = jnp.stack([vp - i for i in range(L)])
+    for layer in range(L):
+        acc_l, m_l, l_l = paged_flash_attention(
+            q, kps, vps, tables, lens,
+            layer=jnp.int32(layer), interpret=True,
+        )
+        acc_s, m_s, l_s = paged_flash_attention(
+            q, kps[layer], vps[layer], tables, lens, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(acc_l), np.asarray(acc_s), rtol=1e-6, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(l_l), np.asarray(l_s), rtol=1e-6, atol=1e-6
+        )
 
 
 def test_shared_blocks_between_rows():

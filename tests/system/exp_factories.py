@@ -18,12 +18,14 @@ def make_sync_ppo_exp(
     experiment_name="test-ppo",
     trial_name="e2e",
     exp_ctrl=None,
+    exp_kwargs=None,
     **ppo_kwargs,
 ):
     gen = GenerationHyperparameters(
         max_new_tokens=16, min_new_tokens=2, temperature=1.0
     )
     return PPOMathExperiment(
+        **(exp_kwargs or {}),
         experiment_name=experiment_name,
         trial_name=trial_name,
         n_model_workers=1,

@@ -57,3 +57,83 @@ def test_sync_ppo_grpo_style(dataset_path, tokenizer_path, tmp_path, monkeypatch
     master = _run(exp, tmp_path, monkeypatch)
     s = master.stats_history[-1]
     assert np.isfinite(s["actor_train/loss"])
+
+
+def test_sync_ppo_with_trained_reward_model(
+    dataset_path, tokenizer_path, tmp_path, monkeypatch
+):
+    """The SFT -> RM -> PPO chain's final link (round-4 verdict #6): train
+    a toy pairwise-BT reward model, export it as an HF critic checkpoint,
+    and run the PPO graph with ``reward_source="model"`` — the reward MFC
+    serves the FROZEN TRAINED scorer instead of the rule verifier, rewards
+    flow, and the actor step completes."""
+    import jax
+
+    from areal_tpu.api.config import ModelAbstraction, ModelName
+    from areal_tpu.api.data import MicroBatchSpec
+    from areal_tpu.api.model_api import FinetuneSpec, Model
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.engine.train_engine import TrainEngine
+    from areal_tpu.interfaces.rm_interface import RewardModelInterface
+    from areal_tpu.models.config import tiny_config
+    from areal_tpu.models.transformer import init_params
+    from tests.engine.test_dpo_interface import make_paired_sample
+
+    # 1) train a toy RM (same vocab as the PPO actor)
+    rm_cfg = tiny_config(
+        vocab_size=256, max_position_embeddings=512, is_critic=True
+    )
+    mesh = MeshSpec(data=2, model=2).make_mesh()
+    engine = TrainEngine(
+        rm_cfg,
+        mesh,
+        init_params(rm_cfg, jax.random.PRNGKey(3)),
+        optimizer_cfg=OptimizerConfig(lr=5e-3, warmup_steps_proportion=0.0),
+        total_train_steps=40,
+    )
+    rm = Model(
+        name=ModelName("reward"), engine=engine, tokenizer=None, mesh=mesh,
+        ft_spec=FinetuneSpec(1, 40, 10), backend_name="llama",
+    )
+    iface = RewardModelInterface()
+    sample = make_paired_sample(n_prompts=4, seed=11)
+    for _ in range(10):
+        stats = iface.train_step(rm, sample, MicroBatchSpec())
+    assert stats["reward_acc_sum"] >= 3.0, stats  # the toy RM learned
+    rm_dir = str(tmp_path / "rm_ckpt")
+    iface.save(rm, rm_dir)
+
+    # 2) the trained head survives the HF round-trip (the loader used to
+    # zero-init critic heads unconditionally)
+    from areal_tpu.models.hf.registry import load_hf_model
+
+    _, loaded = load_hf_model(rm_dir, is_critic=True)
+    assert float(jax.numpy.abs(loaded["value_head"]["w"]).sum()) > 0.0
+
+    # 3) PPO with the frozen RM in the reward-MFC slot
+    exp = _make_exp(
+        dataset_path,
+        tokenizer_path,
+        kl_ctl=0.0,
+        disable_value=True,
+        exp_kwargs=dict(
+            reward_source="model",
+            reward_model=ModelAbstraction(
+                "hf", {"path": rm_dir, "is_critic": True}
+            ),
+        ),
+    )
+    cfg = exp.initial_setup()
+    rw_shard = next(
+        s
+        for w in cfg.model_workers
+        for s in w.shards
+        if s.model_name.role == "reward"
+    )
+    assert rw_shard.model.type_ == "hf"
+    assert rw_shard.backend.type_ == "inference"
+    master = _run(exp, tmp_path, monkeypatch)
+    s = master.stats_history[-1]
+    assert np.isfinite(s["actor_train/loss"])
+    assert "rew_inf/elapsed" in s  # the RM inference MFC actually ran
