@@ -71,11 +71,21 @@ class TransformerConfig:
     # (row groups rotated stage-to-stage; areal_tpu/parallel/pipeline.py).
     # 0 = auto (2 x pipe stages, capped by the row count).
     pipe_microbatches: int = 0
+    # pipeline schedule: "gpipe" (differentiate through the forward scan;
+    # saves ~m micro-batch boundary activations) or "1f1b" (custom-VJP
+    # interleaved backward; live activations bound by ~2p micro-batches at
+    # the cost of one extra forward sweep — the memory-bounded schedule
+    # for large micro-batch counts).  MoE models require "gpipe" (router
+    # aux losses are not differentiated under 1f1b).
+    pipe_schedule: str = "gpipe"
 
     def __post_init__(self):
         assert self.n_q_heads % self.n_kv_heads == 0
         assert self.activation in ("silu", "gelu")
         assert self.norm_type in ("rms", "layer")
+        assert self.pipe_schedule in ("gpipe", "1f1b"), (
+            f"unknown pipe_schedule {self.pipe_schedule!r}"
+        )
         assert self.remat_policy in ("none", "qkv_attn", "dots"), (
             f"unknown remat_policy {self.remat_policy!r}"
         )

@@ -642,9 +642,20 @@ def _run_layers_pipelined(
             aux = jax.tree.map(lambda a: jnp.sum(a) * w, aux_layers)
         return y, aux
 
-    y, aux_total = pipeline.pipeline_apply(
-        mesh, params["layers"], stage_fn, x, sides, m, aux_zero=aux_zero
-    )
+    if cfg.pipe_schedule == "1f1b":
+        if cfg.is_moe:
+            raise ValueError(
+                "pipe_schedule='1f1b' does not differentiate MoE router "
+                "aux losses; use 'gpipe' for MoE models"
+            )
+        y = pipeline.pipeline_apply_1f1b(
+            mesh, params["layers"], stage_fn, x, sides, m
+        )
+        aux_total = aux_zero
+    else:
+        y, aux_total = pipeline.pipeline_apply(
+            mesh, params["layers"], stage_fn, x, sides, m, aux_zero=aux_zero
+        )
     if cfg.is_moe:
         W = jnp.maximum(jnp.sum((seg_ids != 0).astype(jnp.float32)), 1.0)
         aux_total = jax.tree.map(lambda a: a / W, aux_total)

@@ -51,6 +51,33 @@ def pick_microbatches(n_rows: int, pipe: int, requested: int = 0) -> int:
     return max(1, min(m, n_rows))
 
 
+def _wavefront(stage, t, m):
+    """Forward-wavefront indexing shared by every schedule: the
+    micro-batch at ``stage`` on step ``t`` entered the pipeline ``stage``
+    steps ago.  Returns (mb_idx clamped for bubble steps, valid)."""
+    mb_idx = jnp.clip(t - stage, 0, m - 1)
+    valid = (t - stage >= 0) & (t - stage < m)
+    return mb_idx, valid
+
+
+def _take_mb(xs, sides, mb_idx):
+    """Slice micro-batch ``mb_idx`` out of stacked inputs + side inputs."""
+    mb_x = jax.lax.dynamic_index_in_dim(xs, mb_idx, axis=0, keepdims=False)
+    mb_sides = {
+        k: jax.lax.dynamic_index_in_dim(v, mb_idx, axis=0, keepdims=False)
+        for k, v in sides.items()
+    }
+    return mb_x, mb_sides
+
+
+def _bank(outs, mb_idx, out, cond):
+    """Store ``out`` at ``outs[mb_idx]`` when ``cond`` (else keep)."""
+    prev = jax.lax.dynamic_index_in_dim(outs, mb_idx, axis=0, keepdims=False)
+    return jax.lax.dynamic_update_index_in_dim(
+        outs, jnp.where(cond, out, prev), mb_idx, 0
+    )
+
+
 def pipeline_apply(
     mesh,
     stacked_params: Any,
@@ -120,19 +147,8 @@ def pipeline_apply(
 
         def step(carry, t):
             recv, outs, aux_acc = carry
-            # the micro-batch currently AT this stage entered the pipeline
-            # ``stage`` steps ago (clamped for bubble steps)
-            mb_idx = jnp.clip(t - stage, 0, m - 1)
-            valid = (t - stage >= 0) & (t - stage < m)
-            mb_x = jax.lax.dynamic_index_in_dim(
-                xs, mb_idx, axis=0, keepdims=False
-            )
-            mb_sides = {
-                k: jax.lax.dynamic_index_in_dim(
-                    v, mb_idx, axis=0, keepdims=False
-                )
-                for k, v in sides.items()
-            }
+            mb_idx, valid = _wavefront(stage, t, m)
+            mb_x, mb_sides = _take_mb(xs, sides, mb_idx)
             inp = jnp.where(stage == 0, mb_x, recv)
             out, aux = stage_fn(local_params, {"x": inp, **mb_sides})
             if has_aux:
@@ -140,13 +156,7 @@ def pipeline_apply(
                     lambda acc, a: acc + jnp.where(valid, a, 0), aux_acc, aux
                 )
             # the last stage banks its finished micro-batch
-            bank = (stage == p - 1) & valid
-            prev = jax.lax.dynamic_index_in_dim(
-                outs, mb_idx, axis=0, keepdims=False
-            )
-            outs = jax.lax.dynamic_update_index_in_dim(
-                outs, jnp.where(bank, out, prev), mb_idx, 0
-            )
+            outs = _bank(outs, mb_idx, out, (stage == p - 1) & valid)
             recv = jax.lax.ppermute(out, "pipe", perm)
             return (recv, outs, aux_acc), None
 
@@ -168,3 +178,195 @@ def pipeline_apply(
     # only the last stage's block holds real outputs
     y = outs[(p - 1) * m :].reshape((B,) + x.shape[1:])
     return y, (aux_total if has_aux else None)
+
+
+def pipeline_apply_1f1b(
+    mesh,
+    stacked_params: Any,
+    stage_fn: StageFn,
+    x: jax.Array,
+    side_inputs: Dict[str, jax.Array],
+    n_mbs: int,
+):
+    """Memory-bounded pipeline schedule (the reference's 1F1B,
+    realhf/impl/model/backend/static_schedule.py:323, re-expressed as a
+    custom-VJP pair of shard_map scans instead of a p2p instruction VM).
+
+    Differentiating :func:`pipeline_apply`'s scan gives GPipe: every
+    step's stage input is saved for the backward, so per-device live
+    activations are ~(m+p-1) micro-batches.  Here the FORWARD saves
+    NOTHING (custom_vjp residuals = the pipeline's own inputs); the
+    BACKWARD re-runs the forward pipeline and consumes each recomputed
+    stage input as soon as its cotangent arrives — the 1F1B dependence
+    pattern — holding only a ``2p-1``-slot ring of micro-batch inputs.
+    Live activations therefore scale with ``p``, not ``m`` (verified by
+    compiled memory analysis in tests/parallel/test_pipeline.py).
+
+    Schedule (backward pass, step t, stage s, R = 2p-1):
+      * recompute-forward of micro-batch ``t - s`` (same wavefront as the
+        forward pass), stage input ring-buffered at slot ``mb mod R``;
+      * backward of micro-batch ``t - 2(p-1) + s`` via ``jax.vjp`` on the
+        ring-buffered input (one extra stage recompute — full-remat
+        semantics, the policy the engine already runs);
+      * activations rotate forward via ppermute, cotangents rotate
+        backward; stage 0 banks input cotangents, every stage
+        accumulates its local param grads.
+
+    Cost: one extra forward sweep vs GPipe-with-remat.  ``stage_fn``'s
+    aux output is NOT differentiated here (MoE router losses need grads
+    — MoE models keep the GPipe schedule; transformer._run_layers_pipelined
+    enforces this).
+
+    Returns ``y [B, T, D]`` (no aux).
+    """
+    p = mesh.shape["pipe"]
+    assert p > 1, "pipeline_apply_1f1b called without a pipe axis"
+    if mesh.shape.get("seq", 1) > 1:
+        raise NotImplementedError("pipe x seq is rejected (see module doc)")
+    B = x.shape[0]
+    m = n_mbs
+    assert B % m == 0, f"rows {B} not divisible by micro-batches {m}"
+    P = jax.sharding.PartitionSpec
+
+    def split(a):
+        return a.reshape((m, B // m) + a.shape[1:])
+
+    xs = split(x)
+    sides = {k: split(v) for k, v in side_inputs.items()}
+    perm_fwd = [(i, (i + 1) % p) for i in range(p)]
+    perm_bwd = [((i + 1) % p, i) for i in range(p)]
+
+    def stage_call(local_params, mb_x, mb_sides):
+        out, _aux = stage_fn(local_params, {"x": mb_x, **mb_sides})
+        return out
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("pipe"), P(), P()),
+        out_specs=P("pipe"),
+        axis_names={"pipe"},
+        check_vma=False,
+    )
+    def run_fwd(local_params, xs, sides):
+        stage = jax.lax.axis_index("pipe")
+        n_steps = m + p - 1
+
+        def step(carry, t):
+            recv, outs = carry
+            mb_idx, valid = _wavefront(stage, t, m)
+            mb_x, mb_sides = _take_mb(xs, sides, mb_idx)
+            inp = jnp.where(stage == 0, mb_x, recv)
+            out = stage_call(local_params, inp, mb_sides)
+            outs = _bank(outs, mb_idx, out, (stage == p - 1) & valid)
+            recv = jax.lax.ppermute(out, "pipe", perm_fwd)
+            return (recv, outs), None
+
+        (recv, outs), _ = jax.lax.scan(
+            step,
+            (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+            jnp.arange(n_steps),
+        )
+        return outs
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("pipe"), P(), P(), P()),
+        # dxs banks live ONLY on stage 0 — concatenate over pipe and let
+        # the caller slice stage 0's block (a replicated out_spec on a
+        # stage-varying value is undefined)
+        out_specs=(P("pipe"), P("pipe")),
+        axis_names={"pipe"},
+        check_vma=False,
+    )
+    def run_bwd(local_params, xs, sides, dys):
+        stage = jax.lax.axis_index("pipe")
+        R = 2 * p - 1
+        n_steps = 2 * (p - 1) + m
+        g_params0 = jax.tree.map(jnp.zeros_like, local_params)
+        ring0 = jnp.zeros((R,) + xs.shape[1:], xs.dtype)
+
+        def sides_at(i):
+            return {
+                k: jax.lax.dynamic_index_in_dim(v, i, 0, False)
+                for k, v in sides.items()
+            }
+
+        def step(carry, t):
+            recv, cot_recv, ring, dxs, g_params = carry
+            # ---- recompute-forward wavefront (same as the fwd pass) ----
+            f_idx, f_valid = _wavefront(stage, t, m)
+            mb_x = jax.lax.dynamic_index_in_dim(xs, f_idx, 0, False)
+            inp = jnp.where(stage == 0, mb_x, recv)
+            out = stage_call(local_params, inp, sides_at(f_idx))
+            # ring-buffer this stage's input for its (later) backward;
+            # invalid wavefront steps overwrite nothing that is still live
+            slot_f = jnp.where(f_valid, f_idx % R, R - 1)
+            keep = jax.lax.dynamic_index_in_dim(ring, slot_f, 0, False)
+            ring = jax.lax.dynamic_update_index_in_dim(
+                ring, jnp.where(f_valid, inp, keep), slot_f, 0
+            )
+            # ---- backward of the micro-batch whose cotangent arrived ----
+            b_i = t - 2 * (p - 1) + stage
+            b_idx = jnp.clip(b_i, 0, m - 1)
+            b_valid = (b_i >= 0) & (b_i < m)
+            dy_mb = jax.lax.dynamic_index_in_dim(dys, b_idx, 0, False)
+            cot_in = jnp.where(stage == p - 1, dy_mb, cot_recv)
+            saved = jax.lax.dynamic_index_in_dim(
+                ring, b_idx % R, 0, False
+            )
+            _, vjp_fn = jax.vjp(
+                lambda pp, xx: stage_call(pp, xx, sides_at(b_idx)),
+                local_params,
+                saved,
+            )
+            g_p, g_x = vjp_fn(cot_in)
+            g_params = jax.tree.map(
+                lambda acc, g: acc + jnp.where(b_valid, g, 0).astype(
+                    acc.dtype
+                ),
+                g_params,
+                g_p,
+            )
+            # stage 0 banks input cotangents (grads wrt xs)
+            dxs = _bank(
+                dxs, b_idx, g_x.astype(dxs.dtype), (stage == 0) & b_valid
+            )
+            recv = jax.lax.ppermute(out, "pipe", perm_fwd)
+            cot_recv = jax.lax.ppermute(g_x, "pipe", perm_bwd)
+            return (recv, cot_recv, ring, dxs, g_params), None
+
+        carry0 = (
+            jnp.zeros_like(xs[0]),
+            jnp.zeros_like(xs[0]),
+            ring0,
+            jnp.zeros_like(xs),
+            g_params0,
+        )
+        (recv, cot_recv, ring, dxs, g_params), _ = jax.lax.scan(
+            step, carry0, jnp.arange(n_steps)
+        )
+        return g_params, dxs
+
+    @jax.custom_vjp
+    def _pipeline(stacked_params, xs, sides):
+        outs = run_fwd(stacked_params, xs, sides)
+        return outs[(p - 1) * m :]
+
+    def _fwd(stacked_params, xs, sides):
+        # residuals = the pipeline's own inputs; NOTHING per-step is saved
+        return _pipeline(stacked_params, xs, sides), (
+            stacked_params, xs, sides,
+        )
+
+    def _bwd(res, dy):
+        stacked_params, xs, sides = res
+        g_params, dxs_all = run_bwd(stacked_params, xs, sides, dy)
+        dxs = dxs_all[:m]  # stage 0's bank
+        g_sides = jax.tree.map(jnp.zeros_like, sides)
+        return g_params, dxs, g_sides
+
+    _pipeline.defvjp(_fwd, _bwd)
+    ys = _pipeline(stacked_params, xs, sides)
+    return ys.reshape((B,) + x.shape[1:])

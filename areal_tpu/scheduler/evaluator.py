@@ -217,6 +217,27 @@ def _claimed_devices(cfg) -> int:
     return n
 
 
+def _live_jax_view():
+    """(devices, backend) from jax IF a backend is already initialized in
+    this process, else (None, None).  The process launcher's monitor must
+    NEVER initialize an accelerator runtime itself: libtpu is
+    process-exclusive, so a parent grabbing the chips would break every
+    worker subprocess (code-review r5)."""
+    import sys
+
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return None, None
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:  # noqa: SLF001 - liveness probe only
+            return None, None
+    except Exception:  # noqa: BLE001 - private API moved: stay safe
+        return None, None
+    return jax_mod.devices(), jax_mod.default_backend()
+
+
 def resolve_eval_env(cfg, device: str) -> Dict[str, str]:
     """Subprocess env for ``EvaluatorConfig.device``:
 
@@ -224,23 +245,37 @@ def resolve_eval_env(cfg, device: str) -> Dict[str, str]:
       the experiment's workers leave one free — the reference's dedicated
       eval partition (realhf/scheduler/evaluator.py:34) — pinned via
       ``TPU_VISIBLE_DEVICES`` so the subprocess cannot grab the training
-      chips; with no spare device the eval falls back to CPU (an eval
-      contending for a training chip would OOM it).
+      chips; with no spare device (or when this process has no live jax
+      backend to consult, as in the subprocess launcher's monitor) the
+      eval falls back to CPU.
     * a platform string (``"cpu"``, ``"tpu"``): forced via JAX_PLATFORMS.
     * ``""``: inherit the host platform unconditionally.
     """
     if device == "auto":
-        import jax
-
-        n_dev = len(jax.devices())
+        devices, backend = _live_jax_view()
+        if devices is None:
+            logger.info(
+                "evaluator: no live jax backend in this process; eval "
+                "jobs run on CPU (set EvaluatorConfig.device='' for a "
+                "dedicated on-chip evaluator)"
+            )
+            return {**os.environ, "JAX_PLATFORMS": "cpu"}
+        n_dev = len(devices)
+        # TPU_VISIBLE_DEVICES takes CHIP indices; older generations have
+        # 2 cores (jax devices) per chip
+        cores_per_chip = 1 + max(
+            (getattr(d, "core_on_chip", 0) or 0) for d in devices
+        )
         claimed = _claimed_devices(cfg)
-        if claimed < n_dev:
+        if claimed <= n_dev - cores_per_chip:
             env = dict(os.environ)
             # the subprocess targets THIS host's platform (not whatever a
             # stale JAX_PLATFORMS in the launcher env says)
-            env["JAX_PLATFORMS"] = jax.default_backend()
-            if jax.default_backend() == "tpu":
-                env["TPU_VISIBLE_DEVICES"] = str(n_dev - 1)
+            env["JAX_PLATFORMS"] = backend
+            if backend == "tpu":
+                env["TPU_VISIBLE_DEVICES"] = str(
+                    n_dev // cores_per_chip - 1
+                )
             logger.info(
                 "evaluator: %d/%d local devices claimed by workers; "
                 "eval jobs run on-device",

@@ -300,6 +300,238 @@ def bench_interruption(cfg, params, n_reqs=32, prompt_len=256):
     }
 
 
+def bench_decode_ab(cfg15, params15):
+    """Paged vs bucketed-dense decode at the recipe's context regime
+    (2k/8k/16k/32k, Qwen2.5-1.5B architecture) — chunk-level A/B of the
+    exact jitted functions the serving engine dispatches, over synthetic
+    KV (decode throughput does not depend on KV values).  Each timed
+    chunk routes its sampled tokens through the host (the engine's real
+    pattern; it also defeats the axon tunnel's lazy-execution memo).
+
+    Also reports the CAPACITY row: at the reference recipe's 31k max gen
+    len, a dense cache must reserve kv_cache_len per row
+    (16 rows x 32k x 28 KB/token = 14.7 GB — over v5e HBM before the
+    3.1 GB of weights), while the paged pool allocates only the tokens
+    rows actually hold: 16 concurrent 16k-token rows run here."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models import paged
+    from areal_tpu.models.transformer import KVCache, decode_chunk
+
+    W = 64
+    BS = 1024
+
+    def greedy(logits, _rng):
+        return (
+            jnp.argmax(logits, -1).astype(jnp.int32),
+            jnp.max(jax.nn.log_softmax(logits), -1),
+        )
+
+    def no_stop(toks):
+        return jnp.zeros_like(toks, bool)
+
+    def bucket(n):
+        p = 256
+        while p < n:
+            p <<= 1
+        return p
+
+    dense_jit = jax.jit(
+        decode_chunk,
+        static_argnames=(
+            "cfg", "chunk_size", "sample_fn", "stop_fn", "attn_len"
+        ),
+        donate_argnums=(2,),
+    )
+    Hkv, hd = cfg15.n_kv_heads, cfg15.head_dim
+    kv_bytes_per_tok = cfg15.n_layers * Hkv * hd * 2 * 2
+
+    def run_dense(L, B):
+        # the ENGINE right-sizes its cache to the workload
+        # (bench_gen_cache_len), so dense reads L + slack, not pow2(L)
+        S = -(-(L + 2 * W + 8) // 256) * 256
+        key = jax.random.PRNGKey(0)
+        kd = jax.random.normal(
+            key, (cfg15.n_layers, B, Hkv, S, hd), jnp.bfloat16
+        ) * 0.05
+        cache = KVCache(
+            k=kd, v=kd + 0.0, lengths=jnp.full((B,), L, jnp.int32)
+        )
+        cur = jnp.full((B,), 7, jnp.int32)
+        active = jnp.ones((B,), bool)
+        budgets = jnp.full((B,), 10_000, jnp.int32)
+        rng = jax.random.PRNGKey(1)
+        times, cur_h = [], cur
+        for _ in range(4):
+            t0 = time.perf_counter()
+            cache, out_t, _, _, _, _, budgets, rng = dense_jit(
+                params15, cfg15, cache, cur_h, active,
+                budgets, rng, chunk_size=W, sample_fn=greedy,
+                stop_fn=no_stop, attn_len=S,
+            )
+            cur_h = jnp.asarray(np.asarray(out_t[:, -1]))
+            times.append(time.perf_counter() - t0)
+        del cache, kd
+        return B * W / min(times[2:])
+
+    def run_paged(L, B, kv_cache_len=None):
+        S = bucket(L + 2 * W + 8)
+        MB = -(-(kv_cache_len or S) // BS)
+        used = -(-(L + 2 * W + 8) // BS)
+        NB = B * used + 2  # pool sized by ACTUAL tokens, not reservation
+        key = jax.random.PRNGKey(0)
+        kp = jax.random.normal(
+            key, (cfg15.n_layers, NB, Hkv, BS, hd), jnp.bfloat16
+        ) * 0.05
+        vp = kp + 0.0
+        tables = np.zeros((B, MB), np.int32)
+        for b in range(B):
+            tables[b, :used] = np.arange(b * used, (b + 1) * used)
+        tables = jnp.asarray(tables)
+        lengths = jnp.full((B,), L, jnp.int32)
+        cur = jnp.full((B,), 7, jnp.int32)
+        active = jnp.ones((B,), bool)
+        budgets = jnp.full((B,), 10_000, jnp.int32)
+        rng = jax.random.PRNGKey(1)
+        times, cur_h = [], cur
+        for _ in range(4):
+            t0 = time.perf_counter()
+            (kp, vp, lengths, out_t, _, _, _, active, budgets, rng) = (
+                paged.paged_decode_chunk(
+                    params15, kp, vp, cfg15, tables, lengths, cur_h,
+                    active, budgets, rng, W, greedy, no_stop,
+                    use_kernel=True, max_len=(kv_cache_len or S),
+                )
+            )
+            cur_h = jnp.asarray(np.asarray(out_t[:, -1]))
+            times.append(time.perf_counter() - t0)
+        del kp, vp
+        return B * W / min(times[2:])
+
+    def safe(fn, *a, **kw):
+        try:
+            return fn(*a, **kw)
+        except Exception as e:  # noqa: BLE001 - OOM rows are DATA here
+            if "memory" in str(e).lower() or "hbm" in str(e).lower():
+                return None
+            raise
+
+    rows = {}
+    for L, B in ((2048, 16), (8192, 16), (16384, 16), (32768, 8)):
+        d = safe(run_dense, L, B)
+        p = safe(run_paged, L, B)
+        rows[f"ctx{L}_b{B}"] = {
+            "dense_toks_per_sec": round(d, 1) if d else "OOM",
+            "paged_toks_per_sec": round(p, 1) if p else "OOM",
+            "paged_over_dense": round(p / d, 3) if (p and d) else None,
+        }
+    # CAPACITY: the recipe regime — kv_cache_len 32768 (31k max gen len),
+    # 16 concurrent rows actually holding 16k tokens.  Dense must reserve
+    # B x kv_cache_len; paged allocates B x actual.
+    dense_reserved_gb = 16 * 32768 * kv_bytes_per_tok / 2**30
+    p_cap = run_paged(16384, 16, kv_cache_len=32768)
+    rows["capacity_16x16k_at_32k_reservation"] = {
+        "paged_toks_per_sec": round(p_cap, 1),
+        "paged_pool_gb": round(
+            16 * (16384 + 136) * kv_bytes_per_tok / 2**30, 2
+        ),
+        "dense_reserved_gb": round(dense_reserved_gb, 2),
+        "dense_fits_v5e": dense_reserved_gb + 3.1 < 15.75,
+    }
+    return rows
+
+
+def bench_chunked_prefill(cfg, gen_params):
+    """Decode-stall A/B during a LONG-prompt admission (round-4 verdict
+    #2): 8 short rows decode continuously; a 15k-token prompt arrives.
+    The dense engine prefills the whole wave in one call (decode stalls
+    for its duration); the paged engine admits it in
+    ``prefill_chunk_tokens`` chunks interleaved with decode chunks, so
+    the longest decode gap is ~one chunk's prefill.  Reported: the max
+    inter-step wall gap observed by the short rows after the long
+    admission, per mode."""
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine.inference_server import ContinuousBatchingEngine
+
+    long_len = 15 * 1024
+    rng = np.random.default_rng(5)
+    long_prompt = rng.integers(0, cfg.vocab_size, (long_len,)).tolist()
+
+    def run(mode):
+        eng = ContinuousBatchingEngine(
+            cfg,
+            gen_params,
+            max_batch=10,
+            kv_cache_len=16384,
+            chunk_size=64,
+            cache_mode=mode,
+            page_size=1024,
+            prefill_chunk_tokens=1024,
+        )
+        for i in range(8):
+            ids = rng.integers(0, cfg.vocab_size, (128,)).tolist()
+            eng.submit(
+                APIGenerateInput(
+                    qid=f"s{mode}{i}", prompt_ids=ids, input_ids=ids,
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=3000, temperature=1.0
+                    ),
+                )
+            )
+        # warm the decode path, then the LONG admission path (compile)
+        for _ in range(4):
+            eng.step()
+        warm = rng.integers(0, cfg.vocab_size, (long_len,)).tolist()
+        eng.submit(
+            APIGenerateInput(
+                qid=f"w{mode}", prompt_ids=warm, input_ids=warm,
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=4, temperature=1.0
+                ),
+            )
+        )
+        while eng.try_get_result(f"w{mode}") is None:
+            eng.step()
+        for _ in range(3):
+            eng.step()
+        # timed: submit the long prompt, watch per-step gaps until it
+        # finishes admission + its first tokens
+        eng.submit(
+            APIGenerateInput(
+                qid=f"L{mode}", prompt_ids=long_prompt,
+                input_ids=long_prompt,
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=4, temperature=1.0
+                ),
+            )
+        )
+        gaps = []
+        t_prev = time.perf_counter()
+        for _ in range(400):
+            eng.step()
+            now = time.perf_counter()
+            gaps.append(now - t_prev)
+            t_prev = now
+            if eng.try_get_result(f"L{mode}") is not None:
+                break
+        eng.pause()
+        eng.drain_results()
+        return max(gaps)
+
+    stall_paged = run("paged")
+    stall_dense = run("dense")
+    return {
+        "long_prompt_tokens": long_len,
+        "decode_stall_dense_s": round(stall_dense, 3),
+        "decode_stall_paged_chunked_s": round(stall_paged, 3),
+        "stall_reduction": round(stall_dense / max(stall_paged, 1e-9), 2),
+    }
+
+
 def qwen25_15b_config():
     """The true Qwen2.5-1.5B architecture (hidden 1536, 28 layers, GQA
     12q/2kv, head 128, inter 8960, vocab 151936, tied embedding) — random
@@ -507,10 +739,17 @@ def main():
     np.asarray(scale(big, jnp.float16(3)))
     d2h_gbps = (64 / 1024) / max(time.perf_counter() - t0, 1e-9)
 
-    # effective RL step on one chip: generate a batch, then train on the
-    # generated sequences (sync pipeline; gen and train share the chip)
-    B_eff, new_eff = (32, 512) if on_tpu else (2, 16)
-    prompt_eff = 512 if on_tpu else 32
+    # effective RL step on one chip AT THE RECIPE REGIME: ~8k-token
+    # sequences (prompt 7.5k + 512 generated), gen + train sharing the
+    # chip.  The reference baseline below was derived ASSUMING a mean
+    # sequence of 8000 tokens — at 8k our sequences match the assumption
+    # instead of flattering it (round-4 verdict #3; the old 1k-token row
+    # divided by an 8k-denominated baseline).  The 1.5B-arch train state
+    # (fp32 adam, 21 GB) exceeds one v5e; the recipe trains it on an
+    # 8-chip FSDP mesh (dryrun-validated) — this row keeps the 0.5B
+    # model, whose tok/s/TFLOP normalization is size-comparable.
+    B_eff, new_eff = (8, 512) if on_tpu else (2, 16)
+    prompt_eff = 7680 if on_tpu else 32
     eng = make_engine(cfg, gen_params, B_eff, prompt_eff, new_eff)
     submit_wave(eng, cfg, B_eff, prompt_eff, new_eff, "we")
     drain(eng)  # warm
@@ -538,13 +777,23 @@ def main():
     ours_per_tflop = effective_tok_s / (peak_flops(dev) / 1e12)
     del eng, engine, params  # free HBM before the 1.5B section
 
-    # 1.5B-architecture decode (the reference's smallest published scale).
-    # Init on the HOST CPU and ship straight as bf16 — a device-side fp32
-    # init would spike ~6 GB of HBM next to the other benches' remnants.
+    # chunked-prefill decode-stall A/B (0.5B; the mechanism under test is
+    # the engine's admission scheduling, not model-size-dependent)
+    chunked_prefill = (
+        bench_chunked_prefill(cfg, gen_params) if on_tpu else None
+    )
+
+    # 1.5B architecture (the reference's smallest published scale): the
+    # recipe-regime decode A/B (paged vs bucketed-dense at 2k-32k ctx)
+    # plus the capacity row.  Init on the HOST CPU and ship straight as
+    # bf16 — a device-side fp32 init would spike ~6 GB of HBM next to the
+    # other benches' remnants.
     gen_15b = None
+    decode_ab = None
     if on_tpu:
         import ml_dtypes
 
+        del gen_params
         cfg15 = qwen25_15b_config()
         shapes = jax.eval_shape(
             lambda k: transformer.init_params(cfg15, k),
@@ -560,6 +809,7 @@ def main():
         )
         g15 = bench_generation(cfg15, params15, n_reqs=32)
         gen_15b = {**g15, "n_params": param_count(params15)}
+        decode_ab = bench_decode_ab(cfg15, params15)
         del params15
 
     print(
@@ -582,7 +832,7 @@ def main():
                         "ref_step_seconds": round(REF_STEP_SECONDS, 2),
                         "ref_n_gpus": REF_N_GPUS,
                         "ref_gpu_peak_tflops": REF_GPU_PEAK_TFLOPS,
-                        "caveat": "ours: 1k-token seqs on 1 chip; ref: 32k-ctx 128-GPU async",
+                        "caveat": "ours: 8k-token seqs (matching the assumed ref mean) on 1 chip sync; ref: 128-GPU async",
                     },
                     "effective": {
                         "toks_per_sec": round(effective_tok_s, 1),
@@ -590,6 +840,7 @@ def main():
                         "train_s": round(t_train, 3),
                         "batch": B_eff,
                         "seq_len": eff_seq,
+                        "cache_mode": "paged",
                     },
                     "train_step_mfu": round(mfu, 4),
                     "train_mfu_attn_corrected": round(
@@ -603,6 +854,8 @@ def main():
                     "d2h_stream_gb_per_s": round(d2h_gbps, 3),
                     "generation_0p5b": gen,
                     "generation_qwen25_1p5b_arch": gen_15b,
+                    "decode_paged_vs_dense_1p5b": decode_ab,
+                    "chunked_prefill": chunked_prefill,
                     "interruption": interruption,
                     "prefix_reuse": prefix_reuse,
                 },
