@@ -300,7 +300,21 @@ def bench_interruption(cfg, params, n_reqs=32, prompt_len=256):
     }
 
 
-def bench_decode_ab(cfg15, params15):
+
+def _section(fn, *args, **kw):
+    """Run one bench section; a failure becomes DATA (error string) so a
+    single section can never zero out the whole round's bench."""
+    try:
+        return fn(*args, **kw)
+    except Exception as e:  # noqa: BLE001 - report, don't die
+        import traceback
+
+        traceback.print_exc()
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
+def bench_decode_ab(cfg15, params15, cases=None, page=1024, chunk=64,
+                    capacity_case=True):
     """Paged vs bucketed-dense decode at the recipe's context regime
     (2k/8k/16k/32k, Qwen2.5-1.5B architecture) — chunk-level A/B of the
     exact jitted functions the serving engine dispatches, over synthetic
@@ -319,8 +333,8 @@ def bench_decode_ab(cfg15, params15):
     from areal_tpu.models import paged
     from areal_tpu.models.transformer import KVCache, decode_chunk
 
-    W = 64
-    BS = 1024
+    W = chunk
+    BS = page
 
     def greedy(logits, _rng):
         return (
@@ -418,7 +432,7 @@ def bench_decode_ab(cfg15, params15):
             raise
 
     rows = {}
-    for L, B in ((2048, 16), (8192, 16), (16384, 16), (32768, 8)):
+    for L, B in (cases or ((2048, 16), (8192, 16), (16384, 16), (32768, 8))):
         d = safe(run_dense, L, B)
         p = safe(run_paged, L, B)
         rows[f"ctx{L}_b{B}"] = {
@@ -426,23 +440,27 @@ def bench_decode_ab(cfg15, params15):
             "paged_toks_per_sec": round(p, 1) if p else "OOM",
             "paged_over_dense": round(p / d, 3) if (p and d) else None,
         }
-    # CAPACITY: the recipe regime — kv_cache_len 32768 (31k max gen len),
-    # 16 concurrent rows actually holding 16k tokens.  Dense must reserve
-    # B x kv_cache_len; paged allocates B x actual.
-    dense_reserved_gb = 16 * 32768 * kv_bytes_per_tok / 2**30
-    p_cap = run_paged(16384, 16, kv_cache_len=32768)
-    rows["capacity_16x16k_at_32k_reservation"] = {
-        "paged_toks_per_sec": round(p_cap, 1),
-        "paged_pool_gb": round(
-            16 * (16384 + 136) * kv_bytes_per_tok / 2**30, 2
-        ),
-        "dense_reserved_gb": round(dense_reserved_gb, 2),
-        "dense_fits_v5e": dense_reserved_gb + 3.1 < 15.75,
-    }
+    if capacity_case:
+        # CAPACITY: the recipe regime — kv_cache_len 32768 (31k max gen
+        # len), 16 concurrent rows actually holding 16k tokens.  Dense
+        # must reserve B x kv_cache_len; paged allocates B x actual.
+        dense_reserved_gb = 16 * 32768 * kv_bytes_per_tok / 2**30
+        p_cap = safe(run_paged, 16384, 16, kv_cache_len=32768)
+        rows["capacity_16x16k_at_32k_reservation"] = {
+            "paged_toks_per_sec": round(p_cap, 1) if p_cap else "OOM",
+            "paged_pool_gb": round(
+                16 * (16384 + 136) * kv_bytes_per_tok / 2**30, 2
+            ),
+            "dense_reserved_gb": round(dense_reserved_gb, 2),
+            "dense_fits_v5e": dense_reserved_gb + 3.1 < 15.75,
+        }
     return rows
 
 
-def bench_chunked_prefill(cfg, gen_params):
+def bench_chunked_prefill(
+    cfg, gen_params, long_len=15 * 1024, kv_len=16384,
+    prefill_chunk=1024, page=1024, short_new=3000, short_prompt=128,
+):
     """Decode-stall A/B during a LONG-prompt admission (round-4 verdict
     #2): 8 short rows decode continuously; a 15k-token prompt arrives.
     The dense engine prefills the whole wave in one call (decode stalls
@@ -457,7 +475,6 @@ def bench_chunked_prefill(cfg, gen_params):
     )
     from areal_tpu.engine.inference_server import ContinuousBatchingEngine
 
-    long_len = 15 * 1024
     rng = np.random.default_rng(5)
     long_prompt = rng.integers(0, cfg.vocab_size, (long_len,)).tolist()
 
@@ -466,19 +483,19 @@ def bench_chunked_prefill(cfg, gen_params):
             cfg,
             gen_params,
             max_batch=10,
-            kv_cache_len=16384,
+            kv_cache_len=kv_len,
             chunk_size=64,
             cache_mode=mode,
-            page_size=1024,
-            prefill_chunk_tokens=1024,
+            page_size=page,
+            prefill_chunk_tokens=prefill_chunk,
         )
         for i in range(8):
-            ids = rng.integers(0, cfg.vocab_size, (128,)).tolist()
+            ids = rng.integers(0, cfg.vocab_size, (short_prompt,)).tolist()
             eng.submit(
                 APIGenerateInput(
                     qid=f"s{mode}{i}", prompt_ids=ids, input_ids=ids,
                     gconfig=GenerationHyperparameters(
-                        max_new_tokens=3000, temperature=1.0
+                        max_new_tokens=short_new, temperature=1.0
                     ),
                 )
             )
@@ -554,8 +571,16 @@ def qwen25_15b_config():
 
 
 def main():
+    import sys
+
     import jax
     import jax.numpy as jnp
+
+    _t0 = time.perf_counter()
+
+    def mark(msg):
+        print(f"[bench {time.perf_counter() - _t0:5.0f}s] {msg}",
+              file=sys.stderr, flush=True)
 
     from areal_tpu.api.data import MicroBatchSpec, SequenceSample
     from areal_tpu.base.topology import MeshSpec
@@ -647,12 +672,14 @@ def main():
         attn = 12 * cfg.n_layers * cfg.n_q_heads * cfg.head_dim * (T / 2)
         return tps * (6 * n_params + attn) / peak_flops(dev)
 
+    mark("train 2k")
     train_toks_per_sec = time_train(sample, tokens_per_step)
     mfu = train_toks_per_sec * 6 * n_params / peak_flops(dev)
 
     # long-context train step (the reference's recipe runs 32k ctx;
     # attention-CORRECTED MFU is the honest long-T efficiency number —
     # param-only MFU mechanically decays as the quadratic term grows)
+    mark("train 8k")
     train_long = None
     if on_tpu:
         T_long, n_long = 8192, 4
@@ -676,6 +703,7 @@ def main():
 
     # generation throughput at 0.5B, batch sweep (tiny shapes off-TPU:
     # a CPU smoke run needs signal, not 512-token decode waves)
+    mark("gen 0.5B")
     gen = {}
     gen_shape = {} if on_tpu else {"prompt_len": 32, "max_new": 16}
     for B in gen_batches:
@@ -684,17 +712,20 @@ def main():
         )
 
     # interruption A/B + update-visibility latency
+    mark("interruption")
     interruption = (
-        bench_interruption(cfg, gen_params) if on_tpu else None
+        _section(bench_interruption, cfg, gen_params) if on_tpu else None
     )
 
     # group-prompt KV dedup at admission (prefix-reuse A/B)
+    mark("prefix reuse")
     prefix_reuse = (
-        bench_prefix_reuse(cfg, gen_params) if on_tpu else None
+        _section(bench_prefix_reuse, cfg, gen_params) if on_tpu else None
     )
 
     # train->generation weight publish (sharded raw-param checkpoint,
     # inference dtype; reference budget <3 s)
+    mark("publish")
     import shutil
     import tempfile
 
@@ -748,6 +779,7 @@ def main():
     # (fp32 adam, 21 GB) exceeds one v5e; the recipe trains it on an
     # 8-chip FSDP mesh (dryrun-validated) — this row keeps the 0.5B
     # model, whose tok/s/TFLOP normalization is size-comparable.
+    mark("effective 8k")
     B_eff, new_eff = (8, 512) if on_tpu else (2, 16)
     prompt_eff = 7680 if on_tpu else 32
     eng = make_engine(cfg, gen_params, B_eff, prompt_eff, new_eff)
@@ -779,8 +811,9 @@ def main():
 
     # chunked-prefill decode-stall A/B (0.5B; the mechanism under test is
     # the engine's admission scheduling, not model-size-dependent)
+    mark("chunked prefill")
     chunked_prefill = (
-        bench_chunked_prefill(cfg, gen_params) if on_tpu else None
+        _section(bench_chunked_prefill, cfg, gen_params) if on_tpu else None
     )
 
     # 1.5B architecture (the reference's smallest published scale): the
@@ -788,6 +821,7 @@ def main():
     # plus the capacity row.  Init on the HOST CPU and ship straight as
     # bf16 — a device-side fp32 init would spike ~6 GB of HBM next to the
     # other benches' remnants.
+    mark("1.5B section")
     gen_15b = None
     decode_ab = None
     if on_tpu:
@@ -807,9 +841,11 @@ def main():
             ),
             shapes,
         )
-        g15 = bench_generation(cfg15, params15, n_reqs=32)
+        g15 = _section(bench_generation, cfg15, params15, n_reqs=32)
         gen_15b = {**g15, "n_params": param_count(params15)}
-        decode_ab = bench_decode_ab(cfg15, params15)
+        mark("decode A/B")
+        decode_ab = _section(bench_decode_ab, cfg15, params15)
+        mark("done")
         del params15
 
     print(
