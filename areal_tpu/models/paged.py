@@ -49,6 +49,7 @@ from areal_tpu.models.transformer import (
 )
 from areal_tpu.ops.paged_attention import (
     paged_flash_attention,
+    paged_flash_attention_deep,
     reference_paged_partials,
 )
 
@@ -70,7 +71,7 @@ def pool_zeros(
 
 def _prefix_partials(
     q, k_pool, v_pool, tables, lengths, layer, use_kernel,
-    mesh=None, kv_axis=None,
+    mesh=None, kv_axis=None, deep=False,
 ):
     """Paged-attention partials over each row's cached prefix.  ``q`` is
     [B, Q, Hq, hd]; returns (acc, m, l) with Q query tokens per row.
@@ -81,6 +82,9 @@ def _prefix_partials(
     the head count doesn't divide), each shard streaming only its own
     heads' pages (code-review r5 #2)."""
     if use_kernel:
+        kernel_fn = (
+            paged_flash_attention_deep if deep else paged_flash_attention
+        )
         interp = jax.default_backend() != "tpu"
         if mesh is not None:
             from jax.experimental.shard_map import shard_map
@@ -94,7 +98,7 @@ def _prefix_partials(
             )
 
             def kern(qq, kk, vv, tb, ln, ly):
-                return paged_flash_attention(
+                return kernel_fn(
                     qq, kk, vv, tb, ln, layer=ly, interpret=interp
                 )
 
@@ -120,7 +124,7 @@ def _prefix_partials(
                 q, k_pool, v_pool, tables, lengths,
                 jnp.asarray(layer, jnp.int32).reshape(1),
             )
-        return paged_flash_attention(
+        return kernel_fn(
             q, k_pool, v_pool, tables, lengths, layer=layer,
             interpret=interp,
         )
@@ -249,7 +253,7 @@ def paged_fill_chunk(
     jax.jit,
     static_argnames=(
         "cfg", "chunk_size", "use_kernel", "max_len", "sample_fn",
-        "stop_fn", "mesh", "kv_axis",
+        "stop_fn", "mesh", "kv_axis", "deep_kernel",
     ),
     donate_argnums=(1, 2),
 )
@@ -271,6 +275,7 @@ def paged_decode_chunk(
     max_len: int,
     mesh=None,
     kv_axis=None,
+    deep_kernel: bool = False,
 ):
     """Generate up to ``chunk_size`` tokens for all active rows device-side
     over the paged pool (the paged twin of ``transformer.decode_chunk``).
@@ -340,7 +345,7 @@ def paged_decode_chunk(
             s_win = jnp.where(mask_win, s_win, _NEG_INF)  # [B,Hkv,r,1,W]
             acc, m_main, l_main = _prefix_partials(
                 q, k_pool, v_pool, tables, read_lens, l, use_kernel,
-                mesh=mesh, kv_axis=kv_axis,
+                mesh=mesh, kv_axis=kv_axis, deep=deep_kernel,
             )
             acc = acc.reshape(B, Hkv, r, hd)
             m_main = m_main.reshape(B, Hkv, r)

@@ -133,6 +133,53 @@ def _paged_kv_map(b, qb, j, lengths_ref, tables_ref, layer_ref, *,
     return (pid, 0, 0, 0)
 
 
+
+def _group_queries(q, Hkv, r):
+    """Pad + regroup [B, Q, Hq, hd] queries into per-(kv-head) row tiles
+    [B, QB, Hkv, QT*r, hd] (QT bounded by MAX_Q_ROWS); returns
+    (qg, QT, QB, Qp)."""
+    B, Q, Hq, hd = q.shape
+    QT = max(1, min(Q, MAX_Q_ROWS // r))
+    QB = -(-Q // QT)
+    Qp = QB * QT
+    q_pad = (
+        jnp.pad(q, ((0, 0), (0, Qp - Q), (0, 0), (0, 0)))
+        if Qp != Q
+        else q
+    )
+    qg = (
+        q_pad.reshape(B, QB, QT, Hkv, r, hd)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(B, QB, Hkv, QT * r, hd)
+    )
+    return qg, QT, QB, Qp
+
+
+def _ungroup_outputs(acc, m, l, B, QB, QT, Hkv, r, Q, Hq, hd):
+    """Invert :func:`_group_queries` on the kernel's (acc, m, l)."""
+
+    def unravel(x, lanes):
+        return (
+            x.reshape(B, QB, Hkv, QT, r, lanes)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(B, QB * QT, Hq, lanes)[:, :Q]
+        )
+
+    return (
+        unravel(acc, hd),
+        unravel(m, 128)[..., 0],
+        unravel(l, 128)[..., 0],
+    )
+
+
+def _layer_scalar(layer):
+    return (
+        jnp.zeros((1,), jnp.int32)
+        if layer is None
+        else jnp.asarray(layer, jnp.int32).reshape(1)
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_flash_attention(
     q: jax.Array,  # [B, Q, Hq, hd]
@@ -170,24 +217,8 @@ def paged_flash_attention(
         assert layer is not None, "layer index required for a stacked pool"
     r = Hq // Hkv
     # tile the query axis: QT tokens per grid cell, QT*r rows of scratch
-    QT = max(1, min(Q, MAX_Q_ROWS // r))
-    QB = -(-Q // QT)
-    Qp = QB * QT
-    q_pad = (
-        jnp.pad(q, ((0, 0), (0, Qp - Q), (0, 0), (0, 0)))
-        if Qp != Q
-        else q
-    )
-    qg = (
-        q_pad.reshape(B, QB, QT, Hkv, r, hd)
-        .transpose(0, 1, 3, 2, 4, 5)
-        .reshape(B, QB, Hkv, QT * r, hd)
-    )
-    layer_arr = (
-        jnp.zeros((1,), jnp.int32)
-        if layer is None
-        else jnp.asarray(layer, jnp.int32).reshape(1)
-    )
+    qg, QT, QB, Qp = _group_queries(q, Hkv, r)
+    layer_arr = _layer_scalar(layer)
 
     G = min(PAGE_GROUP, MB)
     grid = (B, QB, -(-MB // G))
@@ -264,18 +295,203 @@ def paged_flash_attention(
         *([v_pool] * G),
     )
 
-    def unravel(x, lanes):
+    return _ungroup_outputs(acc, m, l, B, QB, QT, Hkv, r, Q, Hq, hd)
+
+
+#: in-flight page DMAs of the deep-pipelined kernel (see
+#: paged_flash_attention_deep); 8 x ~0.5 MB tiles keep the HBM stream
+#: saturated where the BlockSpec pipeline's 1-deep lookahead cannot
+DEEP_BUFFERS = 8
+
+
+def _deep_kernel(
+    lengths_ref,  # scalar prefetch [B]
+    tables_ref,  # scalar prefetch [B, MB]
+    layer_ref,  # scalar prefetch [1]
+    q_ref,  # (1, 1, Hkv, QR, hd) VMEM
+    k_hbm,  # full pool, stays in HBM
+    v_hbm,
+    acc_ref,  # out (1, 1, Hkv, QR, hd) f32
+    m_ref,  # out (1, 1, Hkv, QR, 128) f32
+    l_ref,  # out (1, 1, Hkv, QR, 128) f32
+    kbuf,  # scratch (NBUF, Hkv, BS, hd)
+    vbuf,  # scratch (NBUF, Hkv, BS, hd)
+    s_acc,  # scratch (Hkv, QR, hd) f32
+    s_m,  # scratch (Hkv, QR, 128) f32
+    s_l,  # scratch (Hkv, QR, 128) f32
+    k_sems,  # DMA sems (NBUF,)
+    v_sems,  # DMA sems (NBUF,)
+    *,
+    block_size: int,
+    scale: float,
+    n_kv_heads: int,
+    layered: bool,
+    max_blocks: int,
+    n_buffers: int,
+):
+    NBUF = n_buffers
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_blocks = jnp.minimum(
+        jnp.maximum((length + block_size - 1) // block_size, 0), max_blocks
+    )
+    lay = layer_ref[0]
+
+    softmax_scratch_init(s_acc, s_m, s_l)
+
+    def src(j):
+        pid = tables_ref[b, jnp.minimum(j, max_blocks - 1)]
+        if layered:
+            return lambda r: r.at[lay, pid]
+        return lambda r: r.at[pid]
+
+    def dma_pair(j, slot):
+        sel = src(j)
         return (
-            x.reshape(B, QB, Hkv, QT, r, lanes)
-            .transpose(0, 1, 3, 2, 4, 5)
-            .reshape(B, Qp, Hq, lanes)[:, :Q]
+            pltpu.make_async_copy(sel(k_hbm), kbuf.at[slot], k_sems.at[slot]),
+            pltpu.make_async_copy(sel(v_hbm), vbuf.at[slot], v_sems.at[slot]),
         )
 
-    return (
-        unravel(acc, hd),
-        unravel(m, 128)[..., 0],
-        unravel(l, 128)[..., 0],
+    # warm-up: fill the buffer ring
+    def warm(j, _):
+        @pl.when(j < n_blocks)
+        def _():
+            kd, vd = dma_pair(j, j % NBUF)
+            kd.start()
+            vd.start()
+        return 0
+
+    jax.lax.fori_loop(0, NBUF, warm, 0)
+
+    def body(j, _):
+        slot = j % NBUF
+        kd, vd = dma_pair(j, slot)
+        kd.wait()
+        vd.wait()
+        k_all = kbuf[slot]
+        v_all = vbuf[slot]
+        for h in range(n_kv_heads):
+            softmax_block_update(
+                q_ref[0, 0, h], k_all[h], v_all[h],
+                s_acc.at[h], s_m.at[h], s_l.at[h],
+                base=j * block_size, length=length, scale=scale,
+            )
+        # refill this slot with the page NBUF ahead
+        nxt = j + NBUF
+
+        @pl.when(nxt < n_blocks)
+        def _():
+            kd2, vd2 = dma_pair(nxt, slot)
+            kd2.start()
+            vd2.start()
+        return 0
+
+    jax.lax.fori_loop(0, n_blocks, body, 0)
+
+    acc_ref[0, 0] = s_acc[...]
+    m_ref[0, 0] = s_m[...]
+    l_ref[0, 0] = s_l[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_flash_attention_deep(
+    q: jax.Array,  # [B, Q, Hq, hd]
+    k_pool: jax.Array,  # [NB, Hkv, BS, hd] or [L, NB, Hkv, BS, hd]
+    v_pool: jax.Array,
+    tables: jax.Array,  # [B, MB]
+    lengths: jax.Array,  # [B]
+    layer: jax.Array | None = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Deep-pipelined variant of :func:`paged_flash_attention`: the pool
+    stays in HBM and the kernel issues its own page DMAs with a
+    ``DEEP_BUFFERS``-deep ring, so up to 8 page copies are in flight —
+    the BlockSpec pipeline's single-step lookahead is what caps the
+    default kernel at ~350 GB/s on v5e (DMA-latency-bound).  Same
+    (acc, m, l) contract; rows stream only their valid pages.
+
+    EXPERIMENTAL: numerics are parity-tested (interpret mode + TPU), but
+    until it is measured FASTER on hardware the engine keeps the default
+    kernel (bench.py's decode A/B reports both).
+    """
+    B, Q, Hq, hd = q.shape
+    layered = k_pool.ndim == 5
+    NB, Hkv, BS, _ = k_pool.shape[-4:]
+    MB = tables.shape[1]
+    assert Hq % Hkv == 0
+    if layered:
+        assert layer is not None
+    r = Hq // Hkv
+    qg, QT, QB, Qp = _group_queries(q, Hkv, r)
+    layer_arr = _layer_scalar(layer)
+    # ring depth bounded by a ~12 MB VMEM budget for the two page rings
+    tile_bytes = Hkv * BS * hd * jnp.dtype(k_pool.dtype).itemsize
+    nbuf = int(max(2, min(DEEP_BUFFERS, (6 << 20) // max(tile_bytes, 1))))
+    grid = (B, QB)
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _deep_kernel,
+            block_size=BS,
+            scale=1.0 / np.sqrt(hd),
+            n_kv_heads=Hkv,
+            layered=layered,
+            max_blocks=MB,
+            n_buffers=nbuf,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, Hkv, QT * r, hd),
+                    lambda b, qb, L, T, Y: (b, qb, 0, 0, 0),
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, 1, Hkv, QT * r, hd),
+                    lambda b, qb, L, T, Y: (b, qb, 0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, Hkv, QT * r, 128),
+                    lambda b, qb, L, T, Y: (b, qb, 0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, Hkv, QT * r, 128),
+                    lambda b, qb, L, T, Y: (b, qb, 0, 0, 0),
+                ),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((nbuf, Hkv, BS, hd), k_pool.dtype),
+                pltpu.VMEM((nbuf, Hkv, BS, hd), v_pool.dtype),
+                pltpu.VMEM((Hkv, QT * r, hd), jnp.float32),
+                pltpu.VMEM((Hkv, QT * r, 128), jnp.float32),
+                pltpu.VMEM((Hkv, QT * r, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA((nbuf,)),
+                pltpu.SemaphoreType.DMA((nbuf,)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        tables.astype(jnp.int32),
+        layer_arr,
+        qg,
+        k_pool,
+        v_pool,
     )
+
+    return _ungroup_outputs(acc, m, l, B, QB, QT, Hkv, r, Q, Hq, hd)
 
 
 def gather_paged_kv(

@@ -389,7 +389,7 @@ def bench_decode_ab(cfg15, params15, cases=None, page=1024, chunk=64,
         del cache, kd
         return B * W / min(times[2:])
 
-    def run_paged(L, B, kv_cache_len=None):
+    def run_paged(L, B, kv_cache_len=None, deep=False):
         S = bucket(L + 2 * W + 8)
         MB = -(-(kv_cache_len or S) // BS)
         used = -(-(L + 2 * W + 8) // BS)
@@ -416,6 +416,7 @@ def bench_decode_ab(cfg15, params15, cases=None, page=1024, chunk=64,
                     params15, kp, vp, cfg15, tables, lengths, cur_h,
                     active, budgets, rng, W, greedy, no_stop,
                     use_kernel=True, max_len=(kv_cache_len or S),
+                    deep_kernel=deep,
                 )
             )
             cur_h = jnp.asarray(np.asarray(out_t[:, -1]))
@@ -435,10 +436,13 @@ def bench_decode_ab(cfg15, params15, cases=None, page=1024, chunk=64,
     for L, B in (cases or ((2048, 16), (8192, 16), (16384, 16), (32768, 8))):
         d = safe(run_dense, L, B)
         p = safe(run_paged, L, B)
+        pd = safe(run_paged, L, B, deep=True)
         rows[f"ctx{L}_b{B}"] = {
             "dense_toks_per_sec": round(d, 1) if d else "OOM",
             "paged_toks_per_sec": round(p, 1) if p else "OOM",
+            "paged_deep_toks_per_sec": round(pd, 1) if pd else "OOM",
             "paged_over_dense": round(p / d, 3) if (p and d) else None,
+            "deep_over_dense": round(pd / d, 3) if (pd and d) else None,
         }
     if capacity_case:
         # CAPACITY: the recipe regime — kv_cache_len 32768 (31k max gen

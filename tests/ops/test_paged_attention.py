@@ -117,6 +117,74 @@ def test_layered_pool_matches_per_layer_slice():
         )
 
 
+@pytest.mark.parametrize(
+    "lengths", [[512, 512, 512, 512], [1, 130, 256, 511], [0, 512, 37, 300]]
+)
+def test_deep_pipelined_kernel_matches_reference(lengths):
+    """The experimental manual-DMA kernel (deep page-copy ring) must give
+    the same partials as the reference/default kernel."""
+    from areal_tpu.ops.paged_attention import paged_flash_attention_deep
+
+    q, kp, vp, tables, lens = _setup(lengths=lengths, seed=4)
+    acc, m, l = paged_flash_attention_deep(
+        q, kp, vp, tables, lens, interpret=True
+    )
+    acc_r, m_r, l_r = reference_paged_partials(q, kp, vp, tables, lens)
+    valid = np.asarray(lens) > 0
+    out = np.asarray(acc)[valid] / np.asarray(l)[valid][..., None, None]
+    out_r = np.asarray(acc_r)[valid] / np.asarray(l_r)[valid][..., None, None]
+    np.testing.assert_allclose(out, out_r, rtol=3e-3, atol=3e-3)
+    empty = ~valid
+    if empty.any():
+        assert (np.asarray(l)[empty] == 0).all()
+
+
+def test_deep_kernel_ring_wraparound():
+    """Rows spanning MORE pages than the DMA ring is deep: the
+    steady-state refill path (slot reuse, dma_pair(j + NBUF)) must
+    produce correct attention — the core mechanism of the deep kernel,
+    unreachable at <= ring-depth pages."""
+    from areal_tpu.ops.paged_attention import (
+        DEEP_BUFFERS,
+        paged_flash_attention_deep,
+    )
+
+    MB = 2 * DEEP_BUFFERS  # 16 pages per row at ring depth 8
+    q, kp, vp, tables, lens = _setup(
+        B=2, Hq=4, Hkv=2, MB=MB, NB=2 * MB + 4,
+        lengths=[MB * BS, MB * BS - 37], seed=13,
+    )
+    acc, m, l = paged_flash_attention_deep(
+        q, kp, vp, tables, lens, interpret=True
+    )
+    acc_r, m_r, l_r = reference_paged_partials(q, kp, vp, tables, lens)
+    out = np.asarray(acc) / np.asarray(l)[..., None]
+    out_r = np.asarray(acc_r) / np.asarray(l_r)[..., None]
+    np.testing.assert_allclose(out, out_r, rtol=3e-3, atol=3e-3)
+
+
+def test_deep_kernel_layered_pool():
+    from areal_tpu.ops.paged_attention import paged_flash_attention_deep
+
+    q, kp, vp, tables, lens = _setup(
+        B=2, Hq=4, Hkv=2, MB=2, NB=8, lengths=[200, 77], seed=12
+    )
+    L = 2
+    kps = jnp.stack([kp + i for i in range(L)])
+    vps = jnp.stack([vp - i for i in range(L)])
+    for layer in range(L):
+        acc_d, m_d, l_d = paged_flash_attention_deep(
+            q, kps, vps, tables, lens,
+            layer=jnp.int32(layer), interpret=True,
+        )
+        acc_r, m_r, l_r = reference_paged_partials(
+            q, kps[layer], vps[layer], tables, lens
+        )
+        out = np.asarray(acc_d) / np.asarray(l_d)[..., None]
+        out_r = np.asarray(acc_r) / np.asarray(l_r)[..., None]
+        np.testing.assert_allclose(out, out_r, rtol=3e-3, atol=3e-3)
+
+
 def test_shared_blocks_between_rows():
     # two rows pointing at the SAME pool blocks (group prompt sharing)
     # read identical KV
