@@ -21,11 +21,19 @@ interruptible-vs-drain weight-update throughput (the reference's +12-17%
 mechanism, blog/AReaL_v0_3.md:125), and publish block/commit latency
 (reference budget <3 s, blog/AReaL_v0_2.md:52-54).
 
-Caveats stated where measured: our effective step runs 1k-token sequences
-on ONE chip (the reference's 32k-context multi-node number amortizes
-differently); 1.5B uses the true Qwen2.5-1.5B architecture with random
-weights (zero-egress image has no checkpoint; the HF importer is
-parity-tested separately).
+Round 5 moved the headline to the RECIPE REGIME: the effective row runs
+~8k-token sequences (prompt 7.5k + 512 generated) through the PAGED
+serving engine, so the baseline's assumed 8000-token mean cancels instead
+of flattering a short-sequence number; `detail` adds the paged-vs-dense
+decode A/B at 2k-32k context (1.5B arch) with the 16x16k capacity row,
+and the chunked-prefill decode-stall A/B.
+
+Caveats stated where measured: ONE chip, sync gen+train (the reference's
+number is 128-GPU async); 1.5B uses the true Qwen2.5-1.5B architecture
+with random weights (zero-egress image has no checkpoint; the HF importer
+is parity-tested separately); the 1.5B fp32-adam train state (21 GB)
+exceeds one v5e, so the effective row keeps the 0.5B model (the recipe
+trains 1.5B on an 8-chip FSDP mesh — dryrun-validated).
 """
 
 from __future__ import annotations
