@@ -4,14 +4,31 @@ and the Megatron lr-scheduler wiring in realhf/impl/model/backend/megatron.py:52
 optax replaces Megatron's DistributedOptimizer: optimizer-state sharding falls
 out of the params' NamedShardings (ZeRO-equivalent on the fsdp axis) with no
 dedicated machinery.
+
+Low-precision optimizer state (the train-MFU memory lever): ``mu_dtype`` and
+``nu_dtype`` store the Adam moments sub-fp32 at rest (all moment ARITHMETIC
+stays fp32 — states are upcast before the update and downcast after, so the
+only loss is storage rounding, the same contract as optax's ``mu_dtype``).
+``factored_second_moment`` replaces the full second moment of every large
+matrix with Adafactor's rank-1 row/col statistics (Shazeer & Stern 2018):
+for a [.., n, m] param it stores n+m numbers instead of n*m.  At the 0.5B
+bench model fp32 Adam state is ~4 GB; bf16 moments halve it and factored-nu
+cuts the second moment to ~1/1000th — HBM that goes straight to activations
+(i.e. to LESS rematerialisation; see models/remat.py).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Any, List, NamedTuple, Optional
 
+import jax
+import jax.numpy as jnp
 import optax
+
+
+def _h(text: str):
+    return {"help": text}
 
 
 @dataclasses.dataclass
@@ -28,6 +45,39 @@ class OptimizerConfig:
     gradient_clipping: float = 1.0
     # offload / initial_loss_scale etc. are GPU-specific; bf16 on TPU needs no
     # loss scaling.
+
+    # -- optimizer-state precision (Megatron `use_precision_aware_optimizer` /
+    #    `main_params_dtype`-family knobs -> these three fields) -------------
+    mu_dtype: Optional[str] = dataclasses.field(
+        default=None,
+        metadata=_h(
+            "storage dtype of the Adam first moment (e.g. 'bfloat16'); "
+            "None keeps the param dtype. Arithmetic stays fp32."
+        ),
+    )
+    nu_dtype: Optional[str] = dataclasses.field(
+        default=None,
+        metadata=_h(
+            "storage dtype of the Adam second moment (e.g. 'bfloat16'); "
+            "None keeps the param dtype. Arithmetic stays fp32."
+        ),
+    )
+    factored_second_moment: bool = dataclasses.field(
+        default=False,
+        metadata=_h(
+            "Adafactor-style rank-1 second moment for stacked matrices "
+            "(ndim >= 3, e.g. the [L, n, m] scanned layer params) whose "
+            "last two dims both reach factored_min_dim: stores row+col "
+            "means instead of the full elementwise moment."
+        ),
+    )
+    factored_min_dim: int = dataclasses.field(
+        default=128,
+        metadata=_h(
+            "minimum size of BOTH trailing dims for a param to use the "
+            "factored second moment (Adafactor's min_dim_size_to_factor)."
+        ),
+    )
 
 
 def make_lr_schedule(
@@ -50,18 +100,203 @@ def make_lr_schedule(
     return optax.join_schedules([warmup, main], [warmup_steps])
 
 
+# ---------------------------------------------------------------------------
+# Second-moment dtype wrapper (nu_dtype over optax's own scale_by_adam)
+# ---------------------------------------------------------------------------
+
+
+def _map_adam_nu(state, fn):
+    """Apply ``fn`` to the ``nu`` tree of every ScaleByAdamState nested in an
+    optax chain state (chain states are (named)tuples of sub-states)."""
+    if isinstance(state, optax.ScaleByAdamState):
+        return state._replace(nu=fn(state.nu))
+    if isinstance(state, tuple):
+        mapped = tuple(_map_adam_nu(s, fn) for s in state)
+        if hasattr(state, "_fields"):  # namedtuple: rebuild by fields
+            return type(state)(*mapped)
+        return mapped
+    return state
+
+
+def _with_nu_dtype(
+    inner: optax.GradientTransformation, nu_dtype
+) -> optax.GradientTransformation:
+    """Store the second moment in ``nu_dtype`` AT REST, computing in fp32:
+    the wrapper upcasts nu before the inner update and downcasts after, so
+    the inner transformation's arithmetic is unchanged (the counterpart of
+    optax.adamw's built-in mu_dtype, which has no nu analogue)."""
+    dt = jnp.dtype(nu_dtype)
+
+    def cast(to_dtype):
+        return lambda nu: jax.tree.map(
+            lambda x: x.astype(to_dtype), nu
+        )
+
+    def init_fn(params):
+        return _map_adam_nu(inner.init(params), cast(dt))
+
+    def update_fn(updates, state, params=None):
+        state = _map_adam_nu(state, cast(jnp.float32))
+        updates, new_state = inner.update(updates, state, params)
+        return updates, _map_adam_nu(new_state, cast(dt))
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# Factored (Adafactor-style) second moment with Adam-style first moment
+# ---------------------------------------------------------------------------
+
+
+class FactoredAdamState(NamedTuple):
+    """State of :func:`_scale_by_factored_adam`.
+
+    ``nu`` is a LIST over the flattened param leaves (flatten order), each
+    entry either a full-moment array or a ``{"r", "c"}`` dict of trailing
+    row/col means — plain containers only, so orbax checkpoints it without
+    custom-node registration and tree_map never has to zip a factored leaf
+    against an array leaf.
+    """
+
+    count: jax.Array
+    mu: Any
+    nu: List[Any]
+
+
+def _scale_by_factored_adam(
+    b1: float,
+    b2: float,
+    eps: float,
+    mu_dtype=None,
+    nu_dtype=None,
+    min_dim: int = 128,
+) -> optax.GradientTransformation:
+    """Adam direction with an Adafactor-factored second moment for STACKED
+    matrices — ndim >= 3 leaves whose both trailing dims reach ``min_dim``
+    (the [L, n, m] layer params factor over (n, m), keeping exact
+    per-layer stats).  2-D leaves are deliberately NOT factored: shape
+    alone cannot tell a true matrix (embedding) from a stacked per-layer
+    vector like a [L, D] norm scale, and factoring across the stack axis
+    would mix second-moment statistics between layers; these leaves are a
+    negligible share of the moment memory in a scanned transformer.
+
+    For a factored leaf, V is estimated as r c^T / sum(r) (Shazeer & Stern
+    2018 eq. 4, computed with means — identical ratio); other leaves keep
+    the exact elementwise moment.  Moments are stored in ``mu_dtype``/
+    ``nu_dtype`` at rest, computed in fp32.
+    """
+    mu_dt = jnp.dtype(mu_dtype) if mu_dtype is not None else None
+    nu_dt = jnp.dtype(nu_dtype) if nu_dtype is not None else None
+
+    def factorable(shape) -> bool:
+        return (
+            len(shape) >= 3
+            and shape[-1] >= min_dim
+            and shape[-2] >= min_dim
+        )
+
+    def store(x, dt):
+        return x if dt is None else x.astype(dt)
+
+    def init_fn(params):
+        leaves = jax.tree.leaves(params)
+        mu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dt or p.dtype), params
+        )
+        nu = [
+            {
+                "r": jnp.zeros(p.shape[:-1], nu_dt or p.dtype),
+                "c": jnp.zeros(p.shape[:-2] + p.shape[-1:], nu_dt or p.dtype),
+            }
+            if factorable(p.shape)
+            else jnp.zeros_like(p, dtype=nu_dt or p.dtype)
+            for p in leaves
+        ]
+        return FactoredAdamState(
+            count=jnp.zeros([], jnp.int32), mu=mu, nu=nu
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = optax.safe_int32_increment(state.count)
+        g_leaves, treedef = jax.tree.flatten(updates)
+        mu_leaves = treedef.flatten_up_to(state.mu)
+
+        bc1 = 1.0 - b1 ** count.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        new_mu, new_nu, out = [], [], []
+        for g, mu, nu in zip(g_leaves, mu_leaves, state.nu):
+            g32 = g.astype(jnp.float32)
+            m = b1 * mu.astype(jnp.float32) + (1.0 - b1) * g32
+            g2 = g32 * g32
+            if isinstance(nu, dict):
+                r = b2 * nu["r"].astype(jnp.float32) + (1.0 - b2) * jnp.mean(
+                    g2, axis=-1
+                )
+                c = b2 * nu["c"].astype(jnp.float32) + (1.0 - b2) * jnp.mean(
+                    g2, axis=-2
+                )
+                # V ~ r c^T / mean(r): exact rank-1 reconstruction of the
+                # row/col statistics (ratio identical to the sum form)
+                v = (
+                    r[..., :, None]
+                    * c[..., None, :]
+                    / jnp.maximum(
+                        jnp.mean(r, axis=-1, keepdims=True)[..., None], 1e-30
+                    )
+                )
+                new_nu.append(
+                    {"r": store(r, nu_dt), "c": store(c, nu_dt)}
+                )
+            else:
+                v = b2 * nu.astype(jnp.float32) + (1.0 - b2) * g2
+                new_nu.append(store(v, nu_dt))
+            direction = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            new_mu.append(store(m, mu_dt))
+            out.append(direction.astype(g.dtype))
+        return (
+            jax.tree.unflatten(treedef, out),
+            FactoredAdamState(
+                count=count,
+                mu=jax.tree.unflatten(treedef, new_mu),
+                nu=new_nu,
+            ),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(
     cfg: OptimizerConfig, total_train_steps: int
 ) -> optax.GradientTransformation:
     schedule = make_lr_schedule(cfg, total_train_steps)
     if cfg.type == "adam":
-        opt = optax.adamw(
-            schedule,
-            b1=cfg.beta1,
-            b2=cfg.beta2,
-            eps=cfg.eps,
-            weight_decay=cfg.weight_decay,
-        )
+        if cfg.factored_second_moment:
+            # adamw's exact chain with the factored scale step swapped in
+            opt = optax.chain(
+                _scale_by_factored_adam(
+                    cfg.beta1,
+                    cfg.beta2,
+                    cfg.eps,
+                    mu_dtype=cfg.mu_dtype,
+                    nu_dtype=cfg.nu_dtype,
+                    min_dim=cfg.factored_min_dim,
+                ),
+                optax.add_decayed_weights(cfg.weight_decay),
+                optax.scale_by_learning_rate(schedule),
+            )
+        else:
+            opt = optax.adamw(
+                schedule,
+                b1=cfg.beta1,
+                b2=cfg.beta2,
+                eps=cfg.eps,
+                weight_decay=cfg.weight_decay,
+                mu_dtype=cfg.mu_dtype,
+            )
+            if cfg.nu_dtype is not None:
+                opt = _with_nu_dtype(opt, cfg.nu_dtype)
     elif cfg.type == "sgd":
         opt = optax.sgd(schedule)
     else:
@@ -71,3 +306,16 @@ def make_optimizer(
         chain.append(optax.clip_by_global_norm(cfg.gradient_clipping))
     chain.append(opt)
     return optax.chain(*chain)
+
+
+def opt_state_bytes(opt_state) -> int:
+    """Total bytes of an optimizer state tree (the moment-storage lever's
+    observable: fp32 Adam = 2x params; bf16 moments = 1x; factored-nu
+    drops the second moment to ~(n+m)/(n*m))."""
+    import numpy as np
+
+    return sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize
+        for x in jax.tree.leaves(opt_state)
+        if hasattr(x, "dtype")
+    )

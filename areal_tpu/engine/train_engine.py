@@ -92,7 +92,20 @@ class TrainEngine:
 
         if optimizer_cfg is not None:
             self.tx = make_optimizer(optimizer_cfg, total_train_steps)
+            # moment shapes/dtypes (incl. mu_dtype/nu_dtype/factored) are
+            # fixed HERE: checkpoint save/restore derives its abstract tree
+            # from this live state, so the two can never disagree
             self.opt_state = jax.jit(self.tx.init)(self.params)
+            from areal_tpu.engine.optimizer import opt_state_bytes
+
+            logger.info(
+                "optimizer state: %.2f MB (mu_dtype=%s nu_dtype=%s "
+                "factored=%s)",
+                opt_state_bytes(self.opt_state) / 2**20,
+                optimizer_cfg.mu_dtype,
+                optimizer_cfg.nu_dtype,
+                optimizer_cfg.factored_second_moment,
+            )
         else:
             self.tx = None
             self.opt_state = None
@@ -280,7 +293,9 @@ class TrainEngine:
         out = jax.device_get(out)  # ONE host sync per train step
         denom_f = float(out["denom"])
         host_stats: Dict[str, float] = {}
-        for k, v in jax.tree.leaves_with_path(out["stats"]):
+        # jax.tree.leaves_with_path only exists from jax 0.5; tree_util's
+        # spelling works on every version this repo supports
+        for k, v in jax.tree_util.tree_leaves_with_path(out["stats"]):
             name = "/".join(
                 p.key if hasattr(p, "key") else str(p) for p in k
             )

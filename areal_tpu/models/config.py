@@ -57,10 +57,14 @@ class TransformerConfig:
     # rematerialize each layer in backward (jax.checkpoint over the layer
     # scan) — trades FLOPs for activation memory, standard for training.
     remat: bool = False
-    # what the layer-checkpoint keeps: "none" = full recompute;
-    # "qkv_attn" = save q/k/v projections + attention output (skips the
-    # attention-block recompute in backward at ~200MB/layer for 32k tokens);
-    # "dots" = save every matmul output (cheapest backward, most memory).
+    # what the layer-checkpoint keeps — a graduated preset table
+    # (areal_tpu/models/remat.py), smallest device footprint first:
+    # "none" = full recompute; "offload_qkv" = save q/k/v + attn output to
+    # HOST memory (qkv_attn's FLOP savings at none's HBM footprint);
+    # "attn_out" = save the attention-block output only; "mlp" = save both
+    # block boundaries (attn_out + mlp_out); "qkv_attn" = save q/k/v
+    # projections + attention output (v5p-class memory); "dots" = save
+    # every matmul output (cheapest backward, most memory).
     remat_policy: str = "none"
     # context-parallel attention over a sharded `seq` mesh axis:
     # "ring" rotates KV blocks with n ppermutes (scales to any length);
@@ -86,8 +90,11 @@ class TransformerConfig:
         assert self.pipe_schedule in ("gpipe", "1f1b"), (
             f"unknown pipe_schedule {self.pipe_schedule!r}"
         )
-        assert self.remat_policy in ("none", "qkv_attn", "dots"), (
-            f"unknown remat_policy {self.remat_policy!r}"
+        from areal_tpu.models.remat import POLICY_NAMES
+
+        assert self.remat_policy in POLICY_NAMES, (
+            f"unknown remat_policy {self.remat_policy!r} "
+            f"(valid: {POLICY_NAMES})"
         )
         assert self.cp_impl in ("ring", "ulysses"), (
             f"unknown cp_impl {self.cp_impl!r}"

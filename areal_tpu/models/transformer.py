@@ -556,6 +556,7 @@ def _layer(
 
     h = _norm(x, lp["mlp_norm"], cfg)
     mlp_out, aux = _mlp_block(cfg, lp, h, seg_ids=seg_ids)
+    mlp_out = checkpoint_name(mlp_out, "mlp_out")
     x = x + mlp_out
     return x, (k_full, v_full), aux
 
@@ -573,18 +574,16 @@ def _scan_layers(cfg: TransformerConfig, stacked_lp, x, positions, mask,
         return y, aux if cfg.is_moe else None
 
     if cfg.remat:
-        if cfg.remat_policy == "qkv_attn":
-            policy = jax.checkpoint_policies.save_only_these_names(
-                "q_proj", "k_proj", "v_proj", "attn_out"
-            )
-            body = jax.checkpoint(body, policy=policy)
-        elif cfg.remat_policy == "dots":
-            body = jax.checkpoint(
-                body,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            )
-        else:
+        # graduated policy table over the checkpoint_name tags planted
+        # above (q_proj/k_proj/v_proj/attn_out/mlp_out) — see
+        # areal_tpu/models/remat.py for the per-preset memory/FLOP trade
+        from areal_tpu.models import remat as remat_policies
+
+        policy = remat_policies.policy_for(cfg.remat_policy)
+        if policy is None:
             body = jax.checkpoint(body)
+        else:
+            body = jax.checkpoint(body, policy=policy)
     return jax.lax.scan(body, x, stacked_lp)
 
 

@@ -33,6 +33,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from areal_tpu.base.jax_compat import pallas_tpu_compiler_params
+
 DEFAULT_BLOCK = 256
 _NEG_INF = -1e30
 
@@ -197,7 +199,7 @@ def flash_decode(
             jax.ShapeDtypeStruct((B, Hkv, r, 128), jnp.float32),
             jax.ShapeDtypeStruct((B, Hkv, r, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

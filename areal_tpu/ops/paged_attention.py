@@ -43,6 +43,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from areal_tpu.base.jax_compat import pallas_tpu_compiler_params
+
 from areal_tpu.ops.decode_attention import (
     softmax_block_update,
     softmax_emit,
@@ -282,7 +284,7 @@ def paged_flash_attention(
             jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, 128), jnp.float32),
             jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -478,7 +480,7 @@ def paged_flash_attention_deep(
             jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, 128), jnp.float32),
             jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,

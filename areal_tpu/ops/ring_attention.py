@@ -125,7 +125,7 @@ def ring_attention(
 ) -> jax.Array:
     """shard_map wrapper: batch over ``batch_axes``, sequence over ``axis``,
     heads over ``head_axis``; XLA only moves KV blocks over the ring."""
-    from jax import shard_map
+    from areal_tpu.base.jax_compat import shard_map
 
     bspec = P(batch_axes)
     qkv_spec = P(batch_axes, axis, head_axis, None)

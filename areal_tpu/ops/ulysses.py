@@ -119,7 +119,7 @@ def ulysses_attention(
     sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """shard_map wrapper mirroring :func:`ring_attention.ring_attention`."""
-    from jax import shard_map
+    from areal_tpu.base.jax_compat import shard_map
 
     n = mesh.shape.get(axis, 1)
     tp = mesh.shape.get(head_axis, 1) if head_axis else 1

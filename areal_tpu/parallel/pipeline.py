@@ -36,6 +36,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from areal_tpu.base.jax_compat import shard_map as _shard_map
+
 Aux = Any
 # stage_fn(local_stacked_params, {"x": [B,T,D], **side_inputs}) -> (y, aux)
 StageFn = Callable[[Any, Dict[str, jax.Array]], Tuple[jax.Array, Aux]]
@@ -126,7 +128,7 @@ def pipeline_apply(
     has_aux = aux_zero is not None
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(
             jax.sharding.PartitionSpec("pipe"),
@@ -241,7 +243,7 @@ def pipeline_apply_1f1b(
         return out
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P("pipe"), P(), P()),
         out_specs=P("pipe"),
@@ -270,7 +272,7 @@ def pipeline_apply_1f1b(
         return outs
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P("pipe"), P(), P(), P()),
         # dxs banks live ONLY on stage 0 — concatenate over pipe and let

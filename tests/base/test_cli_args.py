@@ -85,3 +85,39 @@ def test_experiment_config_parses():
     assert exp.ppo.gen.max_new_tokens == 64
     assert exp.mesh_spec.model == 2
     assert exp.actor.type_ == "random"
+
+
+def test_optimizer_precision_and_remat_flags_thread_through():
+    """The new train-MFU levers are plain dotted overrides: optimizer
+    moment dtypes/factoring via OptimizerConfig, remat presets via the
+    model args (both reach their engines untouched)."""
+    from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.experiments.sft_exp import SFTExperiment
+
+    exp = parse_cli(
+        SFTExperiment,
+        [
+            "experiment_name=e",
+            "trial_name=t",
+            "model.type_=random",
+            "model.args.remat=true",
+            "model.args.remat_policy=attn_out",
+            "dataset.type_=prompt_answer",
+            "optimizer.mu_dtype=bfloat16",
+            "optimizer.nu_dtype=bfloat16",
+            "optimizer.factored_second_moment=true",
+            "optimizer.factored_min_dim=64",
+        ],
+    )
+    assert isinstance(exp.optimizer, OptimizerConfig)
+    assert exp.optimizer.mu_dtype == "bfloat16"
+    assert exp.optimizer.nu_dtype == "bfloat16"
+    assert exp.optimizer.factored_second_moment is True
+    assert exp.optimizer.factored_min_dim == 64
+    assert exp.model.args["remat_policy"] == "attn_out"
+
+    # the help surface lists the new flags with their metadata
+    from areal_tpu.api.cli_args import _flag_help
+
+    help_text = "\n".join(_flag_help(OptimizerConfig))
+    assert "mu_dtype" in help_text and "factored_second_moment" in help_text

@@ -1,0 +1,147 @@
+"""Tier-1 CPU smoke of bench.py's round-6 sections (the bench_decode_ab
+pattern from 9ab0b16: size-parametrized helpers validated end-to-end at
+tiny shapes so bench logic breakage is caught BEFORE a hardware round).
+
+Covers the {remat_policy x moment dtype} train sweep and the fail-safe
+device probe (bounded retry + structured JSON error record at rc=0)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import bench
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    from areal_tpu.models.config import tiny_config
+
+    return tiny_config(vocab_size=64)
+
+
+def test_train_sweep_runs_end_to_end_at_tiny_shapes(tiny_cfg):
+    import jax
+
+    out = bench.bench_train_sweep(
+        tiny_cfg,
+        seq_len=16,
+        n_seqs=2,
+        dev=jax.devices()[0],
+        timed_steps=1,
+        cells=(
+            ("none", "fp32"),
+            ("attn_out", "bf16_mu"),
+            ("offload_qkv", "bf16_mu"),
+            ("attn_out", "factored"),
+        ),
+    )
+    assert out["seq_len"] == 16 and out["n_seqs"] == 2
+    cells = {k: v for k, v in out.items() if "|" in k}
+    assert set(cells) == {
+        "none|fp32",
+        "attn_out|bf16_mu",
+        "offload_qkv|bf16_mu",
+        "attn_out|factored",
+    }
+    for key, row in cells.items():
+        assert "error" not in row, (key, row)
+        # per-cell report: throughput + the memory-analysis numbers the
+        # fits-v5e assertion reads on hardware
+        assert row["toks_per_sec"] > 0, (key, row)
+        assert row["tok_per_sec_per_tflop"] > 0, (key, row)
+        assert row["peak_temp_gb"] > 0, (key, row)
+        assert row["opt_state_mb"] > 0, (key, row)
+        assert np.isfinite(row["loss"]), (key, row)
+    # bf16 moments must actually shrink the optimizer state
+    assert (
+        cells["attn_out|bf16_mu"]["opt_state_mb"]
+        < cells["none|fp32"]["opt_state_mb"]
+    )
+
+
+def test_train_sweep_reports_would_oom_cells_as_data(tiny_cfg):
+    """A cell over the HBM budget is reported from the memory analysis and
+    skipped for timing — never a crash (the qkv_attn r4 OOM, as data)."""
+    import jax
+
+    out = bench.bench_train_sweep(
+        tiny_cfg,
+        seq_len=16,
+        n_seqs=2,
+        dev=jax.devices()[0],
+        cells=(("qkv_attn", "fp32"),),
+        hbm_gb=1e-9,  # nothing fits
+    )
+    row = out["qkv_attn|fp32"]
+    assert row["fits_hbm"] is False
+    assert "skipped" in row and "toks_per_sec" not in row
+
+
+def _last_json_line(capsys):
+    err = capsys.readouterr()
+    lines = [l for l in err.out.strip().splitlines() if l.startswith("{")]
+    assert lines, err.out
+    return json.loads(lines[-1])
+
+
+def test_probe_devices_retries_then_succeeds(monkeypatch):
+    import jax
+
+    calls = {"n": 0}
+    real = jax.devices()
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("Unable to initialize backend 'axon'")
+        return real
+
+    monkeypatch.setattr(jax, "devices", flaky)
+    devs = bench._probe_devices(max_attempts=3, base_delay_s=0.01)
+    assert devs == real and calls["n"] == 2
+
+
+def test_probe_devices_emits_structured_error_record(monkeypatch, capsys):
+    import jax
+
+    def boom():
+        raise RuntimeError(
+            "Unable to initialize backend 'axon': UNAVAILABLE"
+        )
+
+    monkeypatch.setattr(jax, "devices", boom)
+    assert (
+        bench._probe_devices(max_attempts=2, base_delay_s=0.01) is None
+    )
+    rec = _last_json_line(capsys)
+    assert rec["value"] is None
+    assert rec["metric"] == "effective_rl_toks_per_sec_per_tflop"
+    assert rec["error"]["attempts"] == 2
+    assert "axon" in rec["error"]["message"]
+
+
+def test_probe_devices_bounds_a_hung_backend(monkeypatch, capsys):
+    """The axon shim HANGS (not raises) when the TPU is unreachable: the
+    probe's per-attempt timeout must turn that into the structured record."""
+    import jax
+
+    def hang():
+        time.sleep(3)
+        return []
+
+    monkeypatch.setattr(jax, "devices", hang)
+    t0 = time.perf_counter()
+    assert (
+        bench._probe_devices(
+            max_attempts=3, base_delay_s=0.01, attempt_timeout_s=0.2
+        )
+        is None
+    )
+    # a timed-out probe holds jax's init lock: NO retries, straight to
+    # the error record (one attempt's timeout, not three)
+    assert time.perf_counter() - t0 < 2.0
+    rec = _last_json_line(capsys)
+    assert "timeout" in rec["error"]["message"]
+    assert rec["error"]["attempts"] == 1

@@ -1,0 +1,199 @@
+"""Low-precision optimizer states: moment storage dtypes, loss-trajectory
+parity of bf16 moments vs fp32, factored-second-moment size/behavior, and
+checkpoint round trip of the new state dtypes (ISSUE 1 acceptance)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.engine.optimizer import (
+    FactoredAdamState,
+    OptimizerConfig,
+    make_optimizer,
+    opt_state_bytes,
+)
+from areal_tpu.engine.train_engine import TrainEngine
+from areal_tpu.interfaces.sft_interface import sft_loss_fn
+from areal_tpu.models.config import tiny_config
+from areal_tpu.models.transformer import init_params
+
+
+def _sample(cfg, seed=0, bs=8):
+    rng = np.random.RandomState(seed)
+    seqlens = rng.randint(6, 14, size=bs).tolist()
+    total = sum(seqlens)
+    return SequenceSample.from_default(
+        seqlens,
+        [f"s{i}" for i in range(bs)],
+        {
+            "packed_input_ids": rng.randint(1, cfg.vocab_size, size=total)
+            .astype(np.int32),
+            "prompt_mask": np.zeros(total, dtype=bool),
+        },
+    )
+
+
+def _opt_cfg(**kw):
+    return OptimizerConfig(
+        lr=1e-2, lr_scheduler_type="constant", warmup_steps_proportion=0.0,
+        **kw,
+    )
+
+
+def _run_losses(opt_cfg, n_steps=8):
+    cfg = tiny_config(vocab_size=64)
+    mesh = MeshSpec(data=1, fsdp=1, model=1).make_mesh(jax.devices()[:1])
+    engine = TrainEngine(
+        cfg, mesh, init_params(cfg, jax.random.PRNGKey(0)), opt_cfg, 100
+    )
+    sample = _sample(cfg, seed=1)
+    losses = [
+        engine.train_batch(sample, sft_loss_fn, MicroBatchSpec())["loss"]
+        for _ in range(n_steps)
+    ]
+    return losses, engine
+
+
+def _find_adam_state(state):
+    if isinstance(state, (optax.ScaleByAdamState, FactoredAdamState)):
+        return state
+    if isinstance(state, tuple):
+        for s in state:
+            found = _find_adam_state(s)
+            if found is not None:
+                return found
+    return None
+
+
+def _moment_dtypes(engine):
+    st = _find_adam_state(engine.opt_state)
+    assert st is not None, "no Adam state found in opt_state"
+    mu_dts = {str(x.dtype) for x in jax.tree.leaves(st.mu)}
+    nu_dts = {str(x.dtype) for x in jax.tree.leaves(st.nu)}
+    return mu_dts, nu_dts
+
+
+@pytest.fixture(scope="module")
+def fp32_reference():
+    """One fp32 trajectory shared by every parity test (the comparisons
+    differ only in the low-precision side)."""
+    return _run_losses(_opt_cfg())
+
+
+def test_bf16_mu_loss_trajectory_parity(fp32_reference):
+    """bf16 first moment must track the fp32 trajectory within tolerance
+    (the storage rounding is the ONLY difference; arithmetic stays fp32)."""
+    ref, e_ref = fp32_reference
+    got, e_bf16 = _run_losses(_opt_cfg(mu_dtype="bfloat16"))
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
+    assert got[-1] < got[0]  # still actually training
+    mu_dts, nu_dts = _moment_dtypes(e_bf16)
+    assert mu_dts == {"bfloat16"} and nu_dts == {"float32"}
+    mu_ref, nu_ref = _moment_dtypes(e_ref)
+    assert mu_ref == {"float32"}
+
+
+def test_bf16_nu_wrapper_dtype_and_parity(fp32_reference):
+    ref, _ = fp32_reference
+    got, engine = _run_losses(
+        _opt_cfg(mu_dtype="bfloat16", nu_dtype="bfloat16")
+    )
+    # second-moment rounding perturbs the preconditioner more than the
+    # first moment does the direction; allow a looser envelope
+    np.testing.assert_allclose(got, ref, rtol=0.15, atol=0.15)
+    assert got[-1] < got[0]
+    mu_dts, nu_dts = _moment_dtypes(engine)
+    assert mu_dts == {"bfloat16"} and nu_dts == {"bfloat16"}
+
+
+def test_factored_second_moment_trains_and_shrinks_state(fp32_reference):
+    ref, e_ref = fp32_reference
+    got, e_fac = _run_losses(
+        _opt_cfg(
+            mu_dtype="bfloat16",
+            factored_second_moment=True,
+            factored_min_dim=4,
+        ),
+        n_steps=10,
+    )
+    assert got[-1] < got[0]
+    st = _find_adam_state(e_fac.opt_state)
+    assert isinstance(st, FactoredAdamState)
+    # at least one matrix actually factored (dict leaf with r/c stats)
+    assert any(isinstance(nu, dict) for nu in st.nu)
+    assert opt_state_bytes(e_fac.opt_state) < opt_state_bytes(
+        e_ref.opt_state
+    )
+
+
+def test_factored_matches_adam_shape_semantics():
+    """Factored r/c stats keep exact per-layer statistics for stacked
+    [L, n, m] params: r is [L, n], c is [L, m]."""
+    cfg = _opt_cfg(factored_second_moment=True, factored_min_dim=4)
+    tx = make_optimizer(cfg, 10)
+    params = {"w": jax.numpy.ones((3, 8, 6)), "b": jax.numpy.ones((8,))}
+    st = tx.init(params)
+    adam = _find_adam_state(st)
+    factored = [nu for nu in adam.nu if isinstance(nu, dict)]
+    full = [nu for nu in adam.nu if not isinstance(nu, dict)]
+    assert len(factored) == 1 and len(full) == 1
+    assert factored[0]["r"].shape == (3, 8)
+    assert factored[0]["c"].shape == (3, 6)
+    assert full[0].shape == (8,)
+
+
+@pytest.mark.parametrize(
+    "opt_kw",
+    [
+        {"mu_dtype": "bfloat16", "nu_dtype": "bfloat16"},
+        {
+            "mu_dtype": "bfloat16",
+            "factored_second_moment": True,
+            "factored_min_dim": 4,
+        },
+    ],
+    ids=["bf16_moments", "factored"],
+)
+def test_checkpoint_round_trip_preserves_moment_dtypes(tmp_path, opt_kw):
+    """Sharded save/restore must reproduce the low-precision state exactly:
+    same dtypes, same continued trajectory (ISSUE 1 acceptance)."""
+    cfg = tiny_config(vocab_size=64)
+    mesh = MeshSpec(data=2, fsdp=2, model=2).make_mesh()
+    opt_cfg = _opt_cfg(**opt_kw)
+    sample = _sample(cfg, seed=2)
+
+    engine = TrainEngine(
+        cfg, mesh, init_params(cfg, jax.random.PRNGKey(0)), opt_cfg, 100
+    )
+    engine.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))
+    engine.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))
+    ckpt = str(tmp_path / "globalstep2")
+    engine.save_train_state(ckpt)
+
+    fresh = TrainEngine(
+        cfg, mesh, init_params(cfg, jax.random.PRNGKey(9)), opt_cfg, 100
+    )
+    assert fresh.load_train_state(ckpt)
+    ref_dts = [
+        str(x.dtype) for x in jax.tree.leaves(engine.opt_state)
+        if hasattr(x, "dtype")
+    ]
+    got_dts = [
+        str(x.dtype) for x in jax.tree.leaves(fresh.opt_state)
+        if hasattr(x, "dtype")
+    ]
+    assert got_dts == ref_dts
+    for a, b in zip(
+        jax.tree.leaves(fresh.opt_state), jax.tree.leaves(engine.opt_state)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32)
+        )
+    s1 = engine.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))
+    s2 = fresh.train_batch(sample, sft_loss_fn, MicroBatchSpec(n_mbs=2))
+    assert np.isclose(s1["loss"], s2["loss"], rtol=1e-5), (s1, s2)
