@@ -116,7 +116,25 @@ def _launch_once(
     }
     log_dir = constants.get_log_path()
     specs = _worker_specs(cfg)
-    for wtype, idx, wname in specs:
+    # observability plane: with AREAL_METRICS_PORT_BASE set, every worker's
+    # /metrics endpoint gets a deterministic port (base + launch index) so
+    # ops tooling/firewalls can pre-open them; unset, each worker binds a
+    # random free port and publishes it via name_resolve either way.
+    # Local mode only: the slurm client exports env at CONSTRUCTION, not
+    # per-submit, and cross-host port pinning belongs in the sbatch prolog.
+    metrics_base = None
+    raw_base = os.environ.get("AREAL_METRICS_PORT_BASE")
+    if raw_base and mode == "local":
+        try:
+            metrics_base = int(raw_base)
+        except ValueError:
+            logger.warning(
+                "ignoring non-numeric AREAL_METRICS_PORT_BASE=%r", raw_base
+            )
+    for seq, (wtype, idx, wname) in enumerate(specs):
+        worker_env = dict(wenv)
+        if metrics_base is not None:
+            worker_env["AREAL_METRICS_PORT"] = str(metrics_base + seq)
         sched.submit(
             wtype,
             [
@@ -132,23 +150,24 @@ def _launch_once(
                 "--worker_index",
                 str(idx),
             ],
-            env=wenv,
+            env=worker_env,
             log_path=os.path.join(log_dir, f"{wname}.log"),
         )
     try:
-        _monitor(sched, cfg, specs, timeout)
+        _monitor(sched, cfg, specs, timeout, mode=mode)
     except BaseException:
         sched.stop_all()
         raise
 
 
-def _make_evaluator(cfg: system_api.ExperimentConfig):
+def _make_evaluator(cfg: system_api.ExperimentConfig, mode: str = "local"):
     """Checkpoint-watching evaluator driven by the controller loop
     (reference: realhf/apps/main.py:96-154 builds the AutomaticEvaluator and
-    steps it while monitoring)."""
+    steps it while monitoring).  Eval jobs submit through the same
+    scheduler layer as workers, so slurm experiments get slurm evals."""
     from areal_tpu.scheduler.evaluator import make_evaluator
 
-    return make_evaluator(cfg)
+    return make_evaluator(cfg, scheduler_mode=mode)
 
 
 def _monitor(
@@ -156,6 +175,7 @@ def _monitor(
     cfg: system_api.ExperimentConfig,
     specs: List[Tuple[str, int, str]],
     timeout: Optional[float],
+    mode: str = "local",
 ) -> None:
     """Controller role: watch job + worker statuses; panic on failure; when
     the master completes, gracefully exit the remaining workers."""
@@ -170,7 +190,7 @@ def _monitor(
     # faster, heartbeats catch hosts that vanish without reaping
     hb_timeout = float(os.environ.get("AREAL_HEARTBEAT_TIMEOUT", "60"))
     panel = WorkerControlPanel(cfg.experiment_name, cfg.trial_name)
-    evaluator = _make_evaluator(cfg)
+    evaluator = _make_evaluator(cfg, mode)
     last_eval_step = time.monotonic()
     completed = False
     try:

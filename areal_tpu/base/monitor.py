@@ -76,6 +76,16 @@ class time_mark:
                     "duration": t1 - self._t0,
                 }
             )
+        # scrape-side mirror: one histogram series per mark name, so the
+        # marks show up at /metrics instead of living log-only
+        try:
+            from areal_tpu.observability import get_registry
+
+            get_registry().histogram("areal_time_mark_seconds").observe(
+                t1 - self._t0, mark=self.name
+            )
+        except Exception:  # noqa: BLE001 - marks must never raise
+            pass
         return False
 
 
@@ -106,6 +116,29 @@ def clear_time_marks():
 # ---------------------------------------------------------------------------
 # Device/host utilization sampling
 # ---------------------------------------------------------------------------
+
+#: dense bf16 peak TFLOP/s per chip, keyed by substrings of
+#: ``device.device_kind`` (the MFU denominators bench.py also uses)
+PEAK_TFLOPS_BF16 = {
+    "v3": 123,
+    "v4": 275,
+    "v5e": 197,
+    "v5 lite": 197,
+    "v5p": 459,
+    "v6e": 918,
+    "v6 lite": 918,
+    "trillium": 918,
+}
+
+
+def device_peak_flops(device) -> float:
+    """Peak bf16 FLOP/s of one device, or 0.0 when unknown (CPU backends;
+    MFU gauges are skipped then rather than reporting nonsense)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for name, tf in PEAK_TFLOPS_BF16.items():
+        if name in kind:
+            return tf * 1e12
+    return 0.0
 
 
 def _host_stats() -> Dict[str, float]:
@@ -158,9 +191,10 @@ class UtilizationMonitor:
     the last ``keep`` snapshots; ``export()`` returns the latest gauges for
     the metrics fan-out."""
 
-    def __init__(self, interval: float = 10.0, keep: int = 360):
+    def __init__(self, interval: float = 10.0, keep: int = 360, registry=None):
         self.interval = interval
         self.keep = keep
+        self._registry = registry
         self._snapshots: List[Dict[str, float]] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -180,6 +214,34 @@ class UtilizationMonitor:
             self._snapshots.append(snap)
             if len(self._snapshots) > self.keep:
                 self._snapshots.pop(0)
+        self._publish(snap)
+
+    def _publish(self, snap: Dict[str, float]):
+        """Mirror the latest sample into the scrape registry (instead of the
+        log-only output the sampler used to be).  Metric names are literal
+        at the call sites so scripts/check_metric_names.py can audit them."""
+        try:
+            from areal_tpu.observability import get_registry
+
+            reg = self._registry or get_registry()
+            if "host/load1" in snap:
+                reg.gauge("areal_host_load1").set(snap["host/load1"])
+            if "host/load5" in snap:
+                reg.gauge("areal_host_load5").set(snap["host/load5"])
+            if "host/rss_gb" in snap:
+                reg.gauge("areal_host_rss_gb").set(snap["host/rss_gb"])
+            for k, v in snap.items():
+                if not k.startswith("device") or "/" not in k:
+                    continue
+                dev, field = k.split("/", 1)
+                if field == "hbm_in_use_gb":
+                    reg.gauge("areal_device_hbm_in_use_gb").set(v, device=dev)
+                elif field == "hbm_peak_gb":
+                    reg.gauge("areal_device_hbm_peak_gb").set(v, device=dev)
+                elif field == "hbm_limit_gb":
+                    reg.gauge("areal_device_hbm_limit_gb").set(v, device=dev)
+        except Exception:  # noqa: BLE001 - monitoring must not kill work
+            logger.exception("metric registry publish failed")
 
     def _run(self):
         while not self._stop.wait(self.interval):

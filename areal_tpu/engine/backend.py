@@ -120,6 +120,7 @@ class TrainBackend(model_api.ModelBackend):
             model.init_params,
             optimizer_cfg=self.optimizer,
             total_train_steps=max(1, spec.total_train_steps),
+            name=str(model.name) if model.name else "",
         )
         model.init_params = None
         return model
@@ -145,6 +146,7 @@ class InferenceBackend(model_api.ModelBackend):
             model.mesh,
             model.init_params,
             optimizer_cfg=None,
+            name=str(model.name) if model.name else "",
         )
         model.init_params = None
         return model

@@ -386,6 +386,15 @@ class ContinuousBatchingEngine:
         self.prefill_tokens_total = 0  # unique-prompt tokens actually run
         self.prefill_calls = 0
         self.resumed_total = 0  # continuations resumed with zero prefill
+        # decode-loop time attribution (cumulative seconds): host = admit/
+        # bookkeeping/dispatch-enqueue, device = blocked waiting for chunk
+        # compute, fetch = device->host transfer after completion.  The
+        # split answers "is the decode gap the tunnel or host bookkeeping?"
+        # — surfaced at /metrics and in bench.py's decode sub-rows.
+        self.time_host_s = 0.0
+        self.time_device_s = 0.0
+        self.time_fetch_s = 0.0
+        self.chunks_total = 0
         self.park_ttl_steps = 512  # engine steps a parked row may idle
         # True = decode only, admit nothing (drain-before-update servers)
         self.hold_admissions = False
@@ -1377,7 +1386,19 @@ class ContinuousBatchingEngine:
             else x
             for x in (out_t, out_l, emitted, active_dev, cur_dev)
         )
+        # time attribution: block_until_ready isolates the wait for device
+        # compute from the device_get transfer that follows (the transfer
+        # is the tunnel/PCIe cost the pipelined stepping exists to hide)
+        tik = time.perf_counter()
+        for x in arrs:
+            if isinstance(x, jax.Array):
+                x.block_until_ready()
+        t_ready = time.perf_counter()
         out_t, out_l, emitted, active, cur = jax.device_get(arrs)
+        t_fetched = time.perf_counter()
+        self.time_device_s += t_ready - tik
+        self.time_fetch_s += t_fetched - t_ready
+        self.chunks_total += 1
         n_tokens = 0
         for row_id, epoch in snapshot:
             row = self.rows[row_id]
@@ -1425,6 +1446,16 @@ class ContinuousBatchingEngine:
                 return True
         return False
 
+    def timing_split(self) -> Dict[str, float]:
+        """Cumulative decode-loop time attribution (see the counters set in
+        ``__init__``/``_harvest``)."""
+        return {
+            "host_s": self.time_host_s,
+            "device_s": self.time_device_s,
+            "fetch_s": self.time_fetch_s,
+            "chunks": self.chunks_total,
+        }
+
     def step(self) -> int:
         """One engine iteration, PIPELINED: weight swap (if requested),
         admit, dispatch chunk N+1, then harvest chunk N.  Dispatch-before-
@@ -1434,27 +1465,41 @@ class ContinuousBatchingEngine:
         the number of tokens emitted (from chunk N)."""
         self._step_seq += 1
         if self._paused.is_set():
-            # drain the in-flight chunk so pause means quiesced
+            # drain the in-flight chunk so pause means quiesced (untimed:
+            # the idle-pause sleep would otherwise read as host overhead)
             n = self._harvest(self._pending_chunk)
             self._pending_chunk = None
             if n == 0:
                 time.sleep(0.01)
             return n
-        self._apply_pending_weights()
-        if self.paged:
-            self._admit_paged()
-            self._advance_fill()
-            self._ensure_decode_blocks()
+        # host time = everything in this step that is neither the blocked
+        # device wait nor the output fetch (both accumulated in _harvest)
+        tik = time.perf_counter()
+        d0, f0 = self.time_device_s, self.time_fetch_s
+        try:
+            self._apply_pending_weights()
+            if self.paged:
+                self._admit_paged()
+                self._advance_fill()
+                self._ensure_decode_blocks()
+                prev = self._pending_chunk
+                self._pending_chunk = None
+                if self.n_decoding > 0 and self._worth_dispatching(prev):
+                    self._dispatch_chunk_paged()
+                return self._harvest(prev)
+            self._admit()
             prev = self._pending_chunk
             self._pending_chunk = None
             if self.n_decoding > 0 and self._worth_dispatching(prev):
-                self._dispatch_chunk_paged()
+                self._dispatch_chunk(
+                    extra_len=self.chunk_size if prev is not None else 0
+                )
             return self._harvest(prev)
-        self._admit()
-        prev = self._pending_chunk
-        self._pending_chunk = None
-        if self.n_decoding > 0 and self._worth_dispatching(prev):
-            self._dispatch_chunk(
-                extra_len=self.chunk_size if prev is not None else 0
+        finally:
+            dt = time.perf_counter() - tik
+            self.time_host_s += max(
+                0.0,
+                dt
+                - (self.time_device_s - d0)
+                - (self.time_fetch_s - f0),
             )
-        return self._harvest(prev)

@@ -66,10 +66,14 @@ class TrainEngine:
         params,
         optimizer_cfg: Optional[OptimizerConfig] = None,
         total_train_steps: int = 1,
+        name: str = "",
     ):
         self.model_cfg = model_cfg
         self.mesh = mesh
         self.optimizer_cfg = optimizer_cfg
+        # metric label: co-hosted engines (actor + critic on one worker)
+        # must not conflate their areal_train_* series
+        self.name = name or "model"
 
         from areal_tpu.parallel import distributed as dist
 
@@ -116,6 +120,21 @@ class TrainEngine:
         self._train_step_cache: Dict[Tuple, Tuple[Callable, Callable]] = {}
         self._fwd_step_cache: Dict[int, Tuple[Callable, Callable]] = {}
         self.version = 0
+
+        # observability: step time / token throughput / MFU, scraped off the
+        # hosting worker's /metrics endpoint
+        from areal_tpu.base.monitor import device_peak_flops
+        from areal_tpu.observability import get_registry
+
+        reg = get_registry()
+        self._m_step_s = reg.histogram("areal_train_step_seconds")
+        self._m_tokens = reg.counter("areal_train_tokens_total")
+        self._m_tps = reg.gauge("areal_train_tokens_per_second")
+        self._m_mfu = reg.gauge("areal_train_mfu")
+        self._m_version = reg.gauge("areal_train_version")
+        self._peak_flops = (
+            device_peak_flops(mesh.devices.flat[0]) * mesh.devices.size
+        )
 
     # -- helpers ------------------------------------------------------------
 
@@ -281,7 +300,10 @@ class TrainEngine:
         token_key: str = "packed_input_ids",
     ) -> Dict[str, float]:
         """Micro-batched, grad-accumulated train step over ``sample``."""
+        import time
+
         assert self.tx is not None, "engine built without an optimizer"
+        tik = time.perf_counter()
         mbs, *_ = sample.split(mb_spec)
         batch, _ = self._stack_batches(mbs, token_key)
         n_mbs = next(iter(batch.values())).shape[0]  # bucketed count
@@ -291,7 +313,9 @@ class TrainEngine:
         )
         self.version += 1
         out = jax.device_get(out)  # ONE host sync per train step
+        elapsed = time.perf_counter() - tik
         denom_f = float(out["denom"])
+        self._record_step_metrics(sample, token_key, elapsed, denom_f)
         host_stats: Dict[str, float] = {}
         # jax.tree.leaves_with_path only exists from jax 0.5; tree_util's
         # spelling works on every version this repo supports
@@ -305,8 +329,46 @@ class TrainEngine:
             grad_norm=float(out["grad_norm"]),
             n_tokens=denom_f,
             n_mbs=len(mbs),
+            tokens_per_sec=self.last_tokens_per_sec,
         )
+        if self.last_mfu > 0:
+            host_stats["mfu"] = self.last_mfu
         return host_stats
+
+    #: last step's throughput/MFU (also exported as gauges)
+    last_tokens_per_sec: float = 0.0
+    last_mfu: float = 0.0
+
+    def _record_step_metrics(
+        self,
+        sample: SequenceSample,
+        token_key: str,
+        elapsed: float,
+        n_tokens: float,
+    ):
+        """Step time, token throughput, and (on hardware with a known peak)
+        MFU — the train-side half of the observability plane."""
+        self._m_step_s.observe(elapsed, model=self.name)
+        if n_tokens > 0:
+            self._m_tokens.inc(n_tokens, model=self.name)
+        self.last_tokens_per_sec = n_tokens / max(elapsed, 1e-9)
+        self._m_tps.set(self.last_tokens_per_sec, model=self.name)
+        self._m_version.set(self.version, model=self.name)
+        self.last_mfu = 0.0
+        if self._peak_flops > 0:
+            try:
+                from areal_tpu.system import flops_counter
+
+                lens = [
+                    int(l)
+                    for per_id in sample.seqlens[token_key]
+                    for l in per_id
+                ]
+                fl = flops_counter.train_flops(self.model_cfg, lens)
+                self.last_mfu = fl / max(elapsed, 1e-9) / self._peak_flops
+                self._m_mfu.set(self.last_mfu, model=self.name)
+            except Exception:  # noqa: BLE001 - accounting never kills a step
+                pass
 
     # -- inference ----------------------------------------------------------
 

@@ -1,0 +1,29 @@
+"""Cluster-wide observability plane.
+
+The paper's async design (§2.4/§5) only works when staleness, queue depth,
+and version lag are visible at runtime; the reference binds a metric server
+per worker group (reference: realhf/system/controller.py:41-74 wiring
+``names.metric_server`` keys).  This package is the TPU repo's rebuild of
+that plane as a real subsystem:
+
+* :mod:`registry` — process-local counters/gauges/histograms with labels
+  (thread-safe; workers record from poll loops and daemon threads alike).
+* :mod:`table` — the canonical metric name table.  Every metric name the
+  codebase emits must appear exactly once here
+  (``scripts/check_metric_names.py`` lints it, run in tier-1).
+* :mod:`prom_text` — Prometheus text-format renderer + strict parser.
+* :mod:`server` — per-worker HTTP ``/metrics`` endpoint, registered in
+  name_resolve under the ``base/names.py`` metric-server keys.
+* :mod:`aggregator` — master-side discovery + scrape + jsonl snapshot,
+  feeding the existing ``base/metrics.py`` sinks.
+"""
+
+from areal_tpu.observability.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from areal_tpu.observability.table import METRIC_TABLE, MetricSpec  # noqa: F401

@@ -1,0 +1,147 @@
+"""Master-side cluster metrics aggregator.
+
+Discovers every worker's ``/metrics`` endpoint through the name-resolve
+metric-server subtree (``names.metric_server_root``), scrapes them over
+HTTP, parses with the strict Prometheus parser, and
+
+* appends one cluster-wide snapshot per train step to
+  ``cluster_metrics.jsonl`` in the trial log dir (the machine-readable
+  artifact bench/VERDICT rounds can cite), and
+* returns a flat ``{cluster/<worker>/<series>: value}`` dict the master
+  feeds into the existing ``base/metrics.py`` sinks (tensorboard/wandb).
+
+Scrapes are best-effort: a dead worker costs one
+``areal_aggregator_scrape_errors_total`` increment, never a master stall
+(bounded per-endpoint timeout) or a step failure.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Dict, Optional
+
+from areal_tpu.base import logging_, name_resolve, names
+from areal_tpu.observability import prom_text
+from areal_tpu.observability.registry import MetricsRegistry, get_registry
+
+logger = logging_.getLogger("metrics_aggregator")
+
+
+def _series_key(sample: prom_text.Sample) -> str:
+    if not sample.labels:
+        return sample.name
+    body = ",".join(f"{k}={v}" for k, v in sorted(sample.labels.items()))
+    return f"{sample.name}{{{body}}}"
+
+
+class ClusterMetricsAggregator:
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        snapshot_path: Optional[str] = None,
+        scrape_timeout: float = 2.0,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.scrape_timeout = scrape_timeout
+        self._registry = registry or get_registry()
+        self._jsonl = (
+            open(snapshot_path, "a", buffering=1) if snapshot_path else None
+        )
+        # failed-endpoint backoff: a crashed worker's registration has no
+        # TTL, and paying a full connect timeout for it EVERY master step
+        # would put dead workers on the training critical path
+        self.failure_backoff_s = 30.0
+        self._skip_until: Dict[str, float] = {}
+
+    # -- discovery ----------------------------------------------------------
+
+    def discover(self) -> Dict[str, str]:
+        """{worker_name: host:port} of every registered metric server.
+        Re-scanned every call: workers may register late or restart onto a
+        new port mid-trial."""
+        root = names.metric_server_root(
+            self.experiment_name, self.trial_name
+        )
+        out: Dict[str, str] = {}
+        for key in name_resolve.find_subtree(root):
+            worker = key.rsplit("/", 1)[-1]
+            try:
+                out[worker] = name_resolve.get(key)
+            except name_resolve.NameEntryNotFoundError:
+                continue  # unregistered between scan and get
+        return out
+
+    # -- scraping -----------------------------------------------------------
+
+    def scrape_one(self, addr: str) -> Dict[str, prom_text.Family]:
+        with urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=self.scrape_timeout
+        ) as resp:
+            return prom_text.parse(resp.read().decode("utf-8"))
+
+    def scrape(self) -> Dict[str, Dict[str, prom_text.Family]]:
+        """Scrape every discovered endpoint; failures are counted, skipped,
+        and the endpoint is backed off for ``failure_backoff_s`` so a dead
+        worker costs one timeout per backoff window, not per step."""
+        import time as _time
+
+        errs = self._registry.counter("areal_aggregator_scrape_errors_total")
+        out: Dict[str, Dict[str, prom_text.Family]] = {}
+        now = _time.monotonic()
+        for worker, addr in sorted(self.discover().items()):
+            if self._skip_until.get(worker, 0.0) > now:
+                continue
+            try:
+                out[worker] = self.scrape_one(addr)
+                self._skip_until.pop(worker, None)
+            except Exception:  # noqa: BLE001 - dead worker != dead master
+                errs.inc(endpoint=worker)
+                self._skip_until[worker] = now + self.failure_backoff_s
+                logger.warning(
+                    "scrape of %s (%s) failed; backing off %.0fs",
+                    worker, addr, self.failure_backoff_s, exc_info=True,
+                )
+        return out
+
+    # -- snapshotting -------------------------------------------------------
+
+    def flatten(
+        self, scraped: Dict[str, Dict[str, prom_text.Family]]
+    ) -> Dict[str, float]:
+        """One flat dict per cluster scrape.  Histogram ``_bucket`` samples
+        are dropped (sum/count carry the trend; buckets stay scrapeable at
+        the per-worker endpoints), and so is the ``areal_stats`` fan-in
+        family — the master logs those scalars into the sinks under their
+        plain keys already, so re-importing its own scrape would double
+        every stat per step (that family exists for external Prometheus)."""
+        flat: Dict[str, float] = {}
+        for worker, fams in scraped.items():
+            for fam in fams.values():
+                if fam.name == "areal_stats":
+                    continue
+                for s in fam.samples:
+                    if s.name.endswith("_bucket"):
+                        continue
+                    flat[f"cluster/{worker}/{_series_key(s)}"] = s.value
+        return flat
+
+    def step(self, step: int) -> Dict[str, float]:
+        """Scrape the cluster, append one jsonl snapshot, return the flat
+        dict for the metrics sinks."""
+        flat = self.flatten(self.scrape())
+        if self._jsonl is not None:
+            self._jsonl.write(
+                json.dumps({"step": step, "time": time.time(), **flat})
+                + "\n"
+            )
+        return flat
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None

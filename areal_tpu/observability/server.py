@@ -1,0 +1,160 @@
+"""Per-worker HTTP ``/metrics`` endpoint.
+
+The serving half of the observability plane (reference: the metric server
+the controller binds per worker group, realhf/system/controller.py:41-74).
+A stdlib ``ThreadingHTTPServer`` runs on a daemon thread — no event-loop or
+framework dependency — and registers its address in name_resolve under the
+``base/names.py`` metric-server keys so the master-side aggregator (and any
+real Prometheus with a file_sd bridge) can discover it.
+
+Routes:
+  ``/metrics``  Prometheus text exposition of the worker's registry
+  ``/healthz``  200 "ok" (cheap liveness probe for ops tooling)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from areal_tpu.base import logging_, name_resolve, names, network
+from areal_tpu.observability.registry import MetricsRegistry, get_registry
+
+logger = logging_.getLogger("metrics_server")
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: launcher-wired fixed port (apps/main.py assigns one per worker from
+#: AREAL_METRICS_PORT_BASE); unset/0 = bind any free port
+PORT_ENV = "AREAL_METRICS_PORT"
+
+
+def worker_group(worker_name: str) -> str:
+    """Metric-server group of a worker: its type, i.e. the name with any
+    trailing ``_<index>`` stripped (``model_worker_3`` -> ``model_worker``,
+    ``master`` -> ``master``)."""
+    return re.sub(r"_\d+$", "", worker_name)
+
+
+class MetricsServer:
+    """HTTP server exposing one registry; optionally name-resolve
+    registered under ``names.metric_server(expr, trial, group, worker)``."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        port: int = 0,
+        host: str = "0.0.0.0",
+    ):
+        self.registry = registry or get_registry()
+        reg = self.registry
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = reg.render().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/healthz":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self._registered_key: Optional[str] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        return f"{network.gethostip()}:{self.port}"
+
+    def start(self) -> "MetricsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.25},
+                daemon=True,
+                name=f"metrics-server-{self.port}",
+            )
+            self._thread.start()
+        return self
+
+    def register(
+        self, experiment_name: str, trial_name: str, worker_name: str
+    ) -> str:
+        """Publish this endpoint under the canonical metric-server key."""
+        key = names.metric_server(
+            experiment_name,
+            trial_name,
+            worker_group(worker_name),
+            worker_name,
+        )
+        name_resolve.add(key, self.address, replace=True)
+        self._registered_key = key
+        return key
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._registered_key is not None:
+            try:
+                name_resolve.delete(self._registered_key)
+            except Exception:  # noqa: BLE001 - backend may already be gone
+                pass
+            self._registered_key = None
+
+
+def start_worker_metrics_server(
+    worker_name: str,
+    experiment_name: str,
+    trial_name: str,
+    registry: Optional[MetricsRegistry] = None,
+) -> Optional[MetricsServer]:
+    """Best-effort per-worker endpoint: bind (launcher-wired port if
+    ``AREAL_METRICS_PORT`` is set, else any free port), serve, register.
+    Observability must never kill a worker — failures log and return None.
+
+    Per-worker attribution assumes ONE worker per process (the production
+    launch unit, apps/remote.py).  When several WorkerServers share a
+    process (some tests), the default registry is shared too, so every
+    endpoint serves the union page — accurate in aggregate, but the
+    aggregator will attribute each series to every co-hosted worker; pass
+    a dedicated ``registry`` per worker if that matters.  The threaded
+    local runner creates workers without WorkerServers, so it registers
+    no endpoints at all.
+    """
+    try:
+        port = int(os.environ.get(PORT_ENV, "0") or "0")
+        srv = MetricsServer(registry=registry, port=port).start()
+        srv.register(experiment_name, trial_name, worker_name)
+        logger.info(
+            "worker %s serving /metrics at %s", worker_name, srv.address
+        )
+        return srv
+    except Exception:  # noqa: BLE001 - see docstring
+        logger.exception(
+            "metrics server for %s failed to start; continuing without",
+            worker_name,
+        )
+        return None

@@ -1,0 +1,243 @@
+"""Canonical metric name table.
+
+Single source of truth for every Prometheus series the system emits.  The
+registry resolves HELP text from here, ``docs/observability.md`` renders
+from here, and ``scripts/check_metric_names.py`` (run in tier-1) asserts
+that every name emitted anywhere in the codebase appears EXACTLY once in
+this table — so a typo'd or renamed metric fails CI instead of silently
+forking a series.
+
+The table is a *list* (not a dict) precisely so an accidental duplicate
+entry is representable and the lint can catch it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    type: str  # "counter" | "gauge" | "histogram"
+    help: str
+    labels: Tuple[str, ...] = ()
+
+
+METRIC_TABLE = [
+    # -- worker substrate (system/worker_base.py) ---------------------------
+    MetricSpec(
+        "areal_worker_info",
+        "gauge",
+        "Constant 1 per live worker; labels identify it",
+        ("worker", "group"),
+    ),
+    MetricSpec(
+        "areal_worker_uptime_seconds",
+        "gauge",
+        "Seconds since the worker's server started",
+    ),
+    # -- inference engine (engine/inference_server.py) ----------------------
+    MetricSpec(
+        "areal_inference_chunks_total",
+        "counter",
+        "Decode chunks harvested by the continuous-batching engine",
+    ),
+    MetricSpec(
+        "areal_inference_host_seconds_total",
+        "counter",
+        "Engine-loop time spent on host bookkeeping (admit/schedule/park)",
+    ),
+    MetricSpec(
+        "areal_inference_device_seconds_total",
+        "counter",
+        "Engine-loop time blocked waiting for device compute to finish",
+    ),
+    MetricSpec(
+        "areal_inference_fetch_seconds_total",
+        "counter",
+        "Engine-loop time fetching chunk outputs to host (tunnel/PCIe)",
+    ),
+    MetricSpec(
+        "areal_inference_generated_tokens_total",
+        "counter",
+        "New tokens emitted by the engine",
+    ),
+    MetricSpec(
+        "areal_inference_prefill_tokens_total",
+        "counter",
+        "Unique-prompt tokens actually prefilled (post group-dedup)",
+    ),
+    MetricSpec(
+        "areal_inference_inflight_rows",
+        "gauge",
+        "Rows currently decoding or chunk-filling",
+    ),
+    MetricSpec(
+        "areal_inference_pending_requests",
+        "gauge",
+        "Requests queued for admission",
+    ),
+    MetricSpec(
+        "areal_inference_weight_version",
+        "gauge",
+        "Weight version the engine currently serves",
+    ),
+    # -- gserver manager (system/gserver_manager.py) -------------------------
+    MetricSpec(
+        "areal_gserver_alloc_rejections_total",
+        "counter",
+        "Rollout allocations rejected, by reason (staled | capacity)",
+        ("reason",),
+    ),
+    MetricSpec(
+        "areal_gserver_running_rollouts",
+        "gauge",
+        "Rollouts currently in flight (queue depth of the staleness gate)",
+    ),
+    MetricSpec(
+        "areal_gserver_accepted_rollouts_total",
+        "counter",
+        "Rollouts finished and accepted",
+    ),
+    MetricSpec(
+        "areal_gserver_model_version",
+        "gauge",
+        "Latest weight version pushed to the generation servers",
+    ),
+    MetricSpec(
+        "areal_gserver_version_lag",
+        "gauge",
+        "expected_version - model_version (staleness headroom consumed)",
+    ),
+    MetricSpec(
+        "areal_gserver_server_requests",
+        "gauge",
+        "Sticky requests resident per generation server",
+        ("server",),
+    ),
+    MetricSpec(
+        "areal_gserver_server_tokens",
+        "gauge",
+        "Estimated resident tokens per generation server",
+        ("server",),
+    ),
+    # -- master buffer (system/buffer.py) ------------------------------------
+    MetricSpec(
+        "areal_buffer_size",
+        "gauge",
+        "Sequences resident in the master's sequence buffer",
+    ),
+    MetricSpec(
+        "areal_buffer_oldest_sample_age_seconds",
+        "gauge",
+        "Age of the oldest buffered sequence (birth-time to now)",
+    ),
+    # -- train engine (engine/train_engine.py) -------------------------------
+    MetricSpec(
+        "areal_train_step_seconds",
+        "histogram",
+        "Wall time of one train_batch call (pad + dispatch + host sync)",
+        ("model",),
+    ),
+    MetricSpec(
+        "areal_train_tokens_total",
+        "counter",
+        "Real (non-padding) tokens consumed by train steps",
+        ("model",),
+    ),
+    MetricSpec(
+        "areal_train_tokens_per_second",
+        "gauge",
+        "Token throughput of the most recent train step",
+        ("model",),
+    ),
+    MetricSpec(
+        "areal_train_mfu",
+        "gauge",
+        "Model FLOPs utilization of the most recent train step (0-1)",
+        ("model",),
+    ),
+    MetricSpec(
+        "areal_train_version",
+        "gauge",
+        "Optimizer-step count of this engine (published weight version)",
+        ("model",),
+    ),
+    # -- rollout worker (system/rollout_worker.py) ---------------------------
+    MetricSpec(
+        "areal_rollout_episodes_total",
+        "counter",
+        "Rollout episodes finished (accepted or not)",
+    ),
+    MetricSpec(
+        "areal_rollout_pushed_total",
+        "counter",
+        "Trajectories pushed to the training stream",
+    ),
+    MetricSpec(
+        "areal_rollout_alloc_rejected_total",
+        "counter",
+        "allocate_rollout denials observed, by reason",
+        ("reason",),
+    ),
+    # -- host/device monitor (base/monitor.py) -------------------------------
+    MetricSpec("areal_host_load1", "gauge", "Host 1-minute load average"),
+    MetricSpec("areal_host_load5", "gauge", "Host 5-minute load average"),
+    MetricSpec("areal_host_rss_gb", "gauge", "Worker process RSS in GB"),
+    MetricSpec(
+        "areal_device_hbm_in_use_gb",
+        "gauge",
+        "HBM bytes in use per local device, in GB",
+        ("device",),
+    ),
+    MetricSpec(
+        "areal_device_hbm_peak_gb",
+        "gauge",
+        "Peak HBM bytes in use per local device, in GB",
+        ("device",),
+    ),
+    MetricSpec(
+        "areal_device_hbm_limit_gb",
+        "gauge",
+        "HBM capacity per local device, in GB",
+        ("device",),
+    ),
+    MetricSpec(
+        "areal_time_mark_seconds",
+        "histogram",
+        "Named wall-clock intervals recorded via monitor.time_mark",
+        ("mark",),
+    ),
+    # -- master / stats fan-in (system/master_worker.py) ---------------------
+    MetricSpec(
+        "areal_master_step_seconds",
+        "histogram",
+        "End-to-end wall time of one master step (full MFC graph)",
+    ),
+    MetricSpec(
+        "areal_stats",
+        "gauge",
+        "Scalar stats exported from the hierarchical stats tracker",
+        ("key",),
+    ),
+    # -- aggregator self-metrics (observability/aggregator.py) ---------------
+    MetricSpec(
+        "areal_aggregator_scrape_errors_total",
+        "counter",
+        "Failed /metrics scrapes, by endpoint key",
+        ("endpoint",),
+    ),
+]
+
+
+def table_index() -> Dict[str, MetricSpec]:
+    """name -> spec.  Raises if the table itself holds duplicates (the
+    lint reports this as a table error rather than crashing)."""
+    out: Dict[str, MetricSpec] = {}
+    for spec in METRIC_TABLE:
+        if spec.name in out:
+            raise ValueError(f"duplicate metric table entry: {spec.name}")
+        out[spec.name] = spec
+    return out

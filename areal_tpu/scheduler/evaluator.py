@@ -6,9 +6,11 @@ realhf/scheduler/evaluator.py:34 ``AutomaticEvaluator`` / :131
 checkpoint dirs as they appear, submits one offline eval job per
 checkpoint (at most one running), parses the result JSON, and logs scores
 keyed by global step).  Ours submits the in-repo eval CLI
-(areal_tpu/apps/eval.py) as a subprocess — no slurm/singularity
-dependency — and fans scores out through the shared MetricsLogger
-(tensorboard + stats JSONL; wandb/swanlab opt-in).
+(areal_tpu/apps/eval.py) **through the scheduler client layer**
+(``scheduler/client.py`` — local subprocess or slurm), so on a cluster the
+eval job gets its own resources instead of forking an in-process CPU
+subprocess on the controller host; scores fan out through the shared
+MetricsLogger (tensorboard + stats JSONL; wandb/swanlab opt-in).
 """
 
 from __future__ import annotations
@@ -18,12 +20,18 @@ import enum
 import json
 import os
 import re
-import subprocess
 import sys
 import time
 from typing import Dict, List, Optional
 
 from areal_tpu.base import logging_
+from areal_tpu.scheduler.client import (
+    JobInfo,
+    JobState,
+    LocalSchedulerClient,
+    SchedulerClient,
+    make_scheduler,
+)
 
 logger = logging_.getLogger("evaluator")
 
@@ -43,7 +51,8 @@ class EvaluationStep:
     ckpt_dir: str
     output_path: str
     status: EvalStatus = EvalStatus.PENDING
-    process: Optional[subprocess.Popen] = None
+    #: worker_type the job was submitted under (scheduler job lookup key)
+    job_key: Optional[str] = None
 
     @classmethod
     def from_ckpt_dir(cls, ckpt_dir: str, output_root: str):
@@ -74,6 +83,7 @@ class AutomaticEvaluator:
         max_new_tokens: int = 256,
         env: Optional[Dict[str, str]] = None,
         eval_argv=None,  # (EvaluationStep) -> argv; test seam
+        scheduler: Optional[SchedulerClient] = None,
     ):
         self._eval_argv = eval_argv or self._default_argv
         self.ckpt_root = ckpt_root
@@ -83,6 +93,12 @@ class AutomaticEvaluator:
         self.max_prompts = max_prompts
         self.max_new_tokens = max_new_tokens
         self._env = env
+        # jobs go through the scheduler layer so a cluster deployment gives
+        # evals their own resources (slurm) while a dev box keeps the local
+        # subprocess behavior (reference: the dedicated eval partition)
+        self._sched = scheduler or LocalSchedulerClient(
+            "evaluator", "auto", env=env
+        )
         self._steps: Dict[int, EvaluationStep] = {}
         # resume: outputs that already exist are LOGGED equivalents
         if os.path.isdir(output_root):
@@ -141,30 +157,46 @@ class AutomaticEvaluator:
         log_path = os.path.join(
             os.path.dirname(step.output_path), "output.log"
         )
-        with open(log_path, "ab") as log_file:
-            step.process = subprocess.Popen(
-                self._eval_argv(step),
-                stdout=log_file,
-                stderr=subprocess.STDOUT,
-                env=self._env,
-                start_new_session=True,
-            )
+        step.job_key = f"eval_gs{step.global_step}"
+        self._sched.submit(
+            step.job_key,
+            self._eval_argv(step),
+            env=self._env,
+            log_path=log_path,
+        )
         step.status = EvalStatus.RUNNING
         logger.info("submitted eval for globalstep%d", step.global_step)
+
+    def _find_job(self, step: EvaluationStep) -> Optional[JobInfo]:
+        """The scheduler job of a RUNNING step.  Local clients name jobs
+        ``{worker_type}/{idx}``, slurm uses the bare worker_type — match
+        both."""
+        for job in self._sched.find_all():
+            if job.name == step.job_key or job.name.startswith(
+                step.job_key + "/"
+            ):
+                return job
+        return None
 
     def _harvest(self):
         for step in self._steps.values():
             if step.status != EvalStatus.RUNNING:
                 continue
-            rc = step.process.poll()
-            if rc is None:
+            job = self._find_job(step)
+            if job is None or job.state in (
+                JobState.PENDING,
+                JobState.RUNNING,
+            ):
                 continue
-            if rc != 0 or not os.path.isfile(step.output_path):
+            if job.state != JobState.COMPLETED or not os.path.isfile(
+                step.output_path
+            ):
                 step.status = EvalStatus.FAILED
                 logger.warning(
-                    "eval for globalstep%d failed (rc=%s)",
+                    "eval for globalstep%d failed (job %s: %s)",
                     step.global_step,
-                    rc,
+                    job.name,
+                    job.state.value,
                 )
                 continue
             try:
@@ -197,9 +229,7 @@ class AutomaticEvaluator:
         }
 
     def shutdown(self):
-        for s in self._steps.values():
-            if s.status == EvalStatus.RUNNING and s.process is not None:
-                s.process.terminate()
+        self._sched.stop_all()
 
 
 def _claimed_devices(cfg) -> int:
@@ -292,17 +322,40 @@ def resolve_eval_env(cfg, device: str) -> Dict[str, str]:
     return dict(os.environ)
 
 
-def make_evaluator(cfg) -> Optional[AutomaticEvaluator]:
+def make_evaluator(
+    cfg, scheduler_mode: str = "local", **scheduler_kwargs
+) -> Optional[AutomaticEvaluator]:
     """Build the checkpoint-watching evaluator for an ExperimentConfig
     (None when the experiment configures none).  Shared by the process
     launcher's monitor loop and the threaded local runner; the eval
-    subprocess device policy is :func:`resolve_eval_env`."""
+    subprocess device policy is :func:`resolve_eval_env` and jobs are
+    submitted through ``make_scheduler(scheduler_mode, ...)`` — "slurm"
+    gives evals their own cluster allocation."""
     if getattr(cfg, "evaluator", None) is None:
         return None
     from areal_tpu.base import constants
     from areal_tpu.base.metrics import MetricsLogger
 
     ecfg = cfg.evaluator
+    if scheduler_mode == "local":
+        env = resolve_eval_env(cfg, ecfg.device)
+    elif ecfg.device and ecfg.device != "auto":
+        # explicit platform override still honored on remote allocations
+        env = {**os.environ, "JAX_PLATFORMS": ecfg.device}
+    else:
+        # remote allocation (slurm): the job gets its own node, so the
+        # controller host's local-jax "spare chip" policy is meaningless
+        # there — inherit the remote node's platform instead of exporting
+        # a CPU pin or a local TPU_VISIBLE_DEVICES index
+        env = dict(os.environ)
+    # the scheduler client only needs the DELTA vs the submitting process's
+    # environment: local subprocesses inherit the rest, and sbatch exports
+    # the submission env by default — handing slurm the full os.environ
+    # would write every var (incl. exported bash functions) as repr()'d
+    # `export` lines into the sbatch script and corrupt it
+    env_delta = {
+        k: v for k, v in env.items() if os.environ.get(k) != v
+    }
     return AutomaticEvaluator(
         ckpt_root=os.path.join(constants.get_save_path(), ecfg.model_name),
         dataset_path=ecfg.dataset_path,
@@ -314,7 +367,14 @@ def make_evaluator(cfg) -> Optional[AutomaticEvaluator]:
         ),
         max_prompts=ecfg.max_prompts,
         max_new_tokens=ecfg.max_new_tokens,
-        env=resolve_eval_env(cfg, ecfg.device),
+        env=env,
+        scheduler=make_scheduler(
+            scheduler_mode,
+            cfg.experiment_name,
+            f"{cfg.trial_name}-eval",
+            env=env_delta,
+            **scheduler_kwargs,
+        ),
     )
 
 

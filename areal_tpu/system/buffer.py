@@ -41,6 +41,21 @@ class AsyncIOSequenceBuffer:
         self._id_to_idx: Dict[object, int] = {}
         self._lock = asyncio.Lock()
         self._cond = asyncio.Condition(self._lock)
+        from areal_tpu.observability import get_registry
+
+        reg = get_registry()
+        self._m_size = reg.gauge("areal_buffer_size")
+        self._m_age = reg.gauge("areal_buffer_oldest_sample_age_seconds")
+
+    def _export_metrics(self):
+        """Refresh the scrape gauges (called on every mutation, under the
+        buffer lock — sample age is birth-time of the oldest resident)."""
+        self._m_size.set(len(self._slots))
+        if self._slots:
+            oldest = min(s.birth_time for s in self._slots.values())
+            self._m_age.set(max(0.0, time.time() - oldest))
+        else:
+            self._m_age.set(0.0)
 
     @property
     def size(self) -> int:
@@ -66,6 +81,7 @@ class AsyncIOSequenceBuffer:
                         sample=one, birth_time=birth, keys=set(one.keys)
                     )
                     self._id_to_idx[sid] = idx
+            self._export_metrics()
             self._cond.notify_all()
 
     async def amend_batch(self, sample: SequenceSample):
@@ -81,6 +97,7 @@ class AsyncIOSequenceBuffer:
                 slot = self._slots[idx]
                 slot.sample.update_(one)
                 slot.keys |= set(one.keys)
+            self._export_metrics()
             self._cond.notify_all()
 
     def _ready_indices(
@@ -122,6 +139,7 @@ class AsyncIOSequenceBuffer:
                     sid = self._slots[i].sample.ids[0]
                     del self._id_to_idx[sid]
                     del self._slots[i]
+            self._export_metrics()
             return chosen, gathered
 
     async def pop_consumed(self, by_rpcs: Sequence[str]) -> List[object]:
@@ -135,4 +153,5 @@ class AsyncIOSequenceBuffer:
                     done_ids.append(slot.sample.ids[0])
                     del self._id_to_idx[slot.sample.ids[0]]
                     del self._slots[idx]
+            self._export_metrics()
         return done_ids

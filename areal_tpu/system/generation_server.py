@@ -162,6 +162,44 @@ class GenerationServerWorker(worker_base.Worker):
         self._update_reply_idents = []  # clients awaiting update_weights
         self._start_time = time.monotonic()
 
+        # observability: the engine keeps plain cumulative floats (no
+        # registry dependency in the hot loop); the worker mirrors them
+        # into the scrape registry as counter deltas + gauges per poll
+        from areal_tpu.observability import get_registry
+
+        reg = get_registry()
+        self._obs = {
+            "chunks": reg.counter("areal_inference_chunks_total"),
+            "host": reg.counter("areal_inference_host_seconds_total"),
+            "device": reg.counter("areal_inference_device_seconds_total"),
+            "fetch": reg.counter("areal_inference_fetch_seconds_total"),
+            "gen_tokens": reg.counter("areal_inference_generated_tokens_total"),
+            "prefill_tokens": reg.counter("areal_inference_prefill_tokens_total"),
+            "inflight": reg.gauge("areal_inference_inflight_rows"),
+            "pending": reg.gauge("areal_inference_pending_requests"),
+            "version": reg.gauge("areal_inference_weight_version"),
+        }
+        self._obs_last: Dict[str, float] = {}
+
+    def _export_engine_metrics(self):
+        eng = self.engine
+        totals = {
+            "chunks": float(eng.chunks_total),
+            "host": eng.time_host_s,
+            "device": eng.time_device_s,
+            "fetch": eng.time_fetch_s,
+            "gen_tokens": float(eng.gen_tokens_total),
+            "prefill_tokens": float(eng.prefill_tokens_total),
+        }
+        for key, total in totals.items():
+            delta = total - self._obs_last.get(key, 0.0)
+            if delta > 0:
+                self._obs[key].inc(delta)
+                self._obs_last[key] = total
+        self._obs["inflight"].set(eng.n_inflight)
+        self._obs["pending"].set(eng.n_pending)
+        self._obs["version"].set(eng.version)
+
     # -- API ---------------------------------------------------------------
 
     def _serve_api(self):
@@ -262,6 +300,11 @@ class GenerationServerWorker(worker_base.Worker):
             "gen_tokens_total": self.engine.gen_tokens_total,
             "version": self.engine.version,
             "uptime": time.monotonic() - self._start_time,
+            # decode-loop host/device/fetch attribution (cumulative s)
+            **{
+                f"time_{k}": v
+                for k, v in self.engine.timing_split().items()
+            },
         }
 
     # -- poll ---------------------------------------------------------------
@@ -278,6 +321,7 @@ class GenerationServerWorker(worker_base.Worker):
             self._apply_commands(batch)
             n = self.engine.step()
             self._reply_finished()
+            self._export_engine_metrics()
             return worker_base.PollResult(sample_count=n)
         # follower: lockstep replay of the leader's command stream — one
         # engine.step() per published message, so chunk dispatches pair up
@@ -293,6 +337,7 @@ class GenerationServerWorker(worker_base.Worker):
         self._apply_commands(batch)
         n = self.engine.step()
         self.engine.drain_results()  # leader owns client replies
+        self._export_engine_metrics()
         return worker_base.PollResult(sample_count=n)
 
     def _exit_hook(self):

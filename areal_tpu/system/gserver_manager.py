@@ -97,6 +97,30 @@ class GserverManager(worker_base.Worker):
             replace=True,
         )
         self._last_version_check = 0.0
+        self._init_metrics()
+
+    def _init_metrics(self):
+        """Observability: the staleness gate's whole state becomes
+        scrapeable (the paper's §2.4 knobs — queue depth, version lag,
+        rejections)."""
+        from areal_tpu.observability import get_registry
+
+        reg = get_registry()
+        self._m_rejects = reg.counter("areal_gserver_alloc_rejections_total")
+        self._m_running = reg.gauge("areal_gserver_running_rollouts")
+        self._m_accepted = reg.counter("areal_gserver_accepted_rollouts_total")
+        self._m_version = reg.gauge("areal_gserver_model_version")
+        self._m_lag = reg.gauge("areal_gserver_version_lag")
+        self._m_srv_reqs = reg.gauge("areal_gserver_server_requests")
+        self._m_srv_toks = reg.gauge("areal_gserver_server_tokens")
+
+    def _export_metrics(self):
+        self._m_running.set(self.rollout_stat.running)
+        self._m_version.set(self._model_version)
+        self._m_lag.set(self.version_lag())
+        for addr in self.server_addrs:
+            self._m_srv_reqs.set(self._server_load[addr], server=addr)
+            self._m_srv_toks.set(self._server_tokens[addr], server=addr)
 
     # -- scheduling / staleness --------------------------------------------
 
@@ -169,26 +193,31 @@ class GserverManager(worker_base.Worker):
         except name_resolve.NameEntryNotFoundError:
             return 0
 
-    def is_staled(self) -> bool:
-        """Would a rollout started now exceed the staleness bound?
-        (reference: realhf/system/gserver_manager.py:417-453).  In-flight
-        rollouts are counted in sequences (``group_size`` per rollout) to
-        match ``train_batch_size`` units."""
+    def version_lag(self) -> int:
+        """expected_version - model_version: how much of the
+        max_head_offpolicyness headroom the cluster is consuming right now
+        (the series the staleness gate thresholds on)."""
         n_seqs = (
             self.get_training_sample_cnt()
             + self.rollout_stat.running * max(1, self.config.group_size)
         )
         expected_version = n_seqs // max(1, self.config.train_batch_size)
-        return (
-            expected_version
-            > self._model_version + self.config.max_head_offpolicyness
-        )
+        return expected_version - self._model_version
+
+    def is_staled(self) -> bool:
+        """Would a rollout started now exceed the staleness bound?
+        (reference: realhf/system/gserver_manager.py:417-453).  In-flight
+        rollouts are counted in sequences (``group_size`` per rollout) to
+        match ``train_batch_size`` units."""
+        return self.version_lag() > self.config.max_head_offpolicyness
 
     def _allocate_rollout(self, qid: str) -> Dict:
         cap = self.config.max_concurrent_rollouts or 10**9
         if self.rollout_stat.running >= cap:
+            self._m_rejects.inc(reason="capacity")
             return {"ok": False, "reason": "capacity"}
         if self.is_staled():
+            self._m_rejects.inc(reason="staled")
             return {"ok": False, "reason": "staled"}
         self.rollout_stat.submitted += 1
         self.rollout_stat.running += 1
@@ -198,6 +227,7 @@ class GserverManager(worker_base.Worker):
         self.rollout_stat.running = max(0, self.rollout_stat.running - 1)
         if accepted:
             self.rollout_stat.accepted += 1
+            self._m_accepted.inc()
         # scheduling registered per-group-member qids "{qid}-{i}"; multi-turn
         # agents prefix per-turn requests as "{qid}@t{j}" before the member
         # suffix, so both derived forms must be swept
@@ -327,6 +357,7 @@ class GserverManager(worker_base.Worker):
             info = self._check_new_params()
             if info is not None:
                 self._flush_and_update(info)
+            self._export_metrics()
         return worker_base.PollResult(sample_count=1)
 
     def _exit_hook(self):

@@ -76,9 +76,30 @@ class MasterWorker(worker_base.AsyncWorker):
             trial_name=constants.trial_name(),
         )
         # device-HBM/host sampler (reference: the gpu_utilization_monitor
-        # thread, realhf/base/monitor.py:266)
+        # thread, realhf/base/monitor.py:266); gauges land in the scrape
+        # registry so the master's own /metrics page carries them
         self._util_monitor = UtilizationMonitor()
         self._util_monitor.start()
+
+        # cluster-wide scrape aggregator: discovers every worker's /metrics
+        # endpoint via name_resolve, snapshots to jsonl each step, and feeds
+        # the MetricsLogger sinks (reference: the controller-bound metric
+        # servers, realhf/system/controller.py:41-74 — ours pulls instead)
+        import os as _os
+
+        from areal_tpu.observability import get_registry
+        from areal_tpu.observability.aggregator import (
+            ClusterMetricsAggregator,
+        )
+
+        self._m_step_s = get_registry().histogram("areal_master_step_seconds")
+        self._cluster_agg = ClusterMetricsAggregator(
+            constants.experiment_name(),
+            constants.trial_name(),
+            snapshot_path=_os.path.join(
+                constants.get_log_path(), "cluster_metrics.jsonl"
+            ),
+        )
 
     async def _lazy_init(self):
         cfg = self.config
@@ -277,7 +298,26 @@ class MasterWorker(worker_base.AsyncWorker):
         stats.update(self._util_monitor.export())
         self.stats = stats
         self.stats_history.append(stats)
-        self._metrics.log(stats, step.global_step)
+        # observability plane: master step time + scoped stats become
+        # scrapeable BEFORE the cluster scrape (so the master's own page is
+        # fresh), then the cluster snapshot merges into THIS step's sink
+        # row — one jsonl row per step, cluster/* keys alongside the stats
+        self._m_step_s.observe(elapsed)
+        from areal_tpu.observability import get_registry
+
+        get_registry().set_stats(
+            {
+                k: v
+                for k, v in stats.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+        )
+        cluster = {}
+        try:
+            cluster = self._cluster_agg.step(step.global_step)
+        except Exception:  # noqa: BLE001 - scraping never fails a step
+            self.logger.exception("cluster metrics scrape failed")
+        self._metrics.log({**stats, **cluster}, step.global_step)
         self.logger.info(
             "step %d (epoch %d, %.2fs): %s",
             step.global_step,
@@ -342,3 +382,5 @@ class MasterWorker(worker_base.AsyncWorker):
             self._metrics.close()
         if hasattr(self, "_util_monitor"):
             self._util_monitor.stop()
+        if hasattr(self, "_cluster_agg"):
+            self._cluster_agg.close()

@@ -70,6 +70,13 @@ class RolloutWorker(worker_base.AsyncWorker):
         self.push_count = 0
         self._alloc_counter = 0
 
+        from areal_tpu.observability import get_registry
+
+        reg = get_registry()
+        self._m_episodes = reg.counter("areal_rollout_episodes_total")
+        self._m_pushed = reg.counter("areal_rollout_pushed_total")
+        self._m_rejected = reg.counter("areal_rollout_alloc_rejected_total")
+
     async def _rollout_task(self, qid: str, prompt_sample):
         obs_q: asyncio.Queue = asyncio.Queue()
         act_q: asyncio.Queue = asyncio.Queue()
@@ -109,6 +116,7 @@ class RolloutWorker(worker_base.AsyncWorker):
             if accepted:
                 self.pusher.push([t.as_json_compatible() for t in trajs])
                 self.push_count += len(trajs)
+                self._m_pushed.inc(len(trajs))
         finally:
             if not pump.done():
                 pump.cancel()
@@ -119,6 +127,7 @@ class RolloutWorker(worker_base.AsyncWorker):
                 {"qid": qid, "accepted": accepted},
             )
             self.rollout_count += 1
+            self._m_episodes.inc()
 
     async def _poll_async(self) -> worker_base.PollResult:
         # harvest finished tasks (exceptions propagate)
@@ -139,6 +148,7 @@ class RolloutWorker(worker_base.AsyncWorker):
             self.manager_client.call, "allocate_rollout", {"qid": qid}
         )
         if not resp["ok"]:
+            self._m_rejected.inc(reason=resp.get("reason") or "unknown")
             await asyncio.sleep(0.05)
             return worker_base.PollResult(sample_count=0)
         task = asyncio.create_task(self._rollout_task(qid, prompt_sample))

@@ -74,6 +74,26 @@ class WorkerServer:
             keepalive_ttl=None,
             replace=True,
         )
+        # observability plane: every worker type serves Prometheus text at
+        # /metrics, discovered via the names.metric_server keys (reference:
+        # the per-group metric servers realhf/system/controller.py:41-74)
+        from areal_tpu.observability import get_registry
+        from areal_tpu.observability.server import (
+            start_worker_metrics_server,
+            worker_group,
+        )
+
+        self.metrics_registry = get_registry()
+        self.metrics_registry.gauge("areal_worker_info").set(
+            1, worker=worker_name, group=worker_group(worker_name)
+        )
+        self._uptime_gauge = self.metrics_registry.gauge(
+            "areal_worker_uptime_seconds"
+        )
+        self._start_time = time.monotonic()
+        self.metrics_server = start_worker_metrics_server(
+            worker_name, experiment_name, trial_name, self.metrics_registry
+        )
         self._status = WorkerServerStatus.IDLE
         self._status_key = names.worker_status(
             experiment_name, trial_name, worker_name
@@ -96,6 +116,9 @@ class WorkerServer:
     def beat(self):
         """Write a liveness timestamp."""
         name_resolve.add(self._heartbeat_key, str(time.time()), replace=True)
+        # the beat thread doubles as the uptime ticker: gauges are pulled
+        # at scrape time, so something must refresh this between polls
+        self._uptime_gauge.set(time.monotonic() - self._start_time)
 
     def _beat_loop(self):
         while not self._beat_stop.wait(HEARTBEAT_INTERVAL):
@@ -140,6 +163,9 @@ class WorkerServer:
     def close(self):
         self._beat_stop.set()
         self._sock.close(linger=0)
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
 
 class WorkerControlPanel:

@@ -165,9 +165,17 @@ def bench_generation(cfg, params, n_reqs, prompt_len=512, max_new=512):
     eng._admit()
     int(np.asarray(eng.cache.lengths)[0])  # force prefill completion
     t_prefill = time.perf_counter() - t0
+    # zero the timing counters so the split covers ONLY the timed decode
+    # phase (warmup compiles + admission would otherwise dominate host_s)
+    eng.time_host_s = eng.time_device_s = eng.time_fetch_s = 0.0
+    eng.chunks_total = 0
     t0 = time.perf_counter()
     n_decoded = drain(eng)
     t_decode = time.perf_counter() - t0
+    split = eng.timing_split()
+    attributed = max(
+        split["host_s"] + split["device_s"] + split["fetch_s"], 1e-9
+    )
     del eng
     return {
         "prefill_toks_per_sec": round(n_reqs * prompt_len / t_prefill, 1),
@@ -175,6 +183,17 @@ def bench_generation(cfg, params, n_reqs, prompt_len=512, max_new=512):
         "batch": n_reqs,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new,
+        # decode-loop time attribution (engine-vs-jit gap): host
+        # bookkeeping vs blocked-on-device vs output fetch (tunnel/PCIe)
+        "decode_split": {
+            "host_s": round(split["host_s"], 4),
+            "device_s": round(split["device_s"], 4),
+            "fetch_s": round(split["fetch_s"], 4),
+            "chunks": int(split["chunks"]),
+            "host_frac": round(split["host_s"] / attributed, 3),
+            "device_frac": round(split["device_s"] / attributed, 3),
+            "fetch_frac": round(split["fetch_s"] / attributed, 3),
+        },
     }
 
 

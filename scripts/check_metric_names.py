@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Lint: every emitted metric name appears exactly once in the canonical
+metric name table (areal_tpu/observability/table.py).
+
+"Emitted" = any string literal passed as the first argument of a
+``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")`` call
+anywhere under ``areal_tpu/`` or in ``bench.py``, found by AST walk (so
+formatting/aliasing of the registry object doesn't matter, and dynamically
+computed names are rejected by construction — metric names must be
+literals or the scrape vocabulary becomes unauditable).
+
+Exit code 0 = clean; 1 = violations (each printed, one per line).  Run in
+tier-1 via tests/observability/test_metric_names_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_REGISTRY_METHODS = ("counter", "gauge", "histogram")
+
+#: files whose registry-shaped calls are not metric emissions; currently
+#: none — even registry.py's own set_stats emission (areal_stats) is real
+_SKIP_FILES: Tuple[str, ...] = ()
+
+
+def _iter_source_files() -> List[str]:
+    out = [os.path.join(REPO_ROOT, "bench.py")]
+    for dirpath, _, filenames in os.walk(
+        os.path.join(REPO_ROOT, "areal_tpu")
+    ):
+        for f in filenames:
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def collect_emitted_names() -> Dict[str, List[Tuple[str, int]]]:
+    """{metric_name: [(rel_path, lineno), ...]} plus non-literal call sites
+    recorded under the sentinel key ``<non-literal>``."""
+    emitted: Dict[str, List[Tuple[str, int]]] = {}
+    for path in _iter_source_files():
+        rel = os.path.relpath(path, REPO_ROOT)
+        if rel in _SKIP_FILES:
+            continue
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=rel)
+            except SyntaxError as e:
+                emitted.setdefault("<syntax-error>", []).append(
+                    (rel, e.lineno or 0)
+                )
+                continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                not isinstance(fn, ast.Attribute)
+                or fn.attr not in _REGISTRY_METHODS
+                or not node.args
+            ):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                emitted.setdefault(arg.value, []).append((rel, node.lineno))
+            else:
+                emitted.setdefault("<non-literal>", []).append(
+                    (rel, node.lineno)
+                )
+    return emitted
+
+
+def run_lint() -> List[str]:
+    """Returns a list of violation messages (empty = clean)."""
+    sys.path.insert(0, REPO_ROOT)
+    from areal_tpu.observability.table import METRIC_TABLE
+
+    problems: List[str] = []
+    counts: Dict[str, int] = {}
+    for spec in METRIC_TABLE:
+        counts[spec.name] = counts.get(spec.name, 0) + 1
+    for name, n in sorted(counts.items()):
+        if n != 1:
+            problems.append(
+                f"table: {name} appears {n} times in METRIC_TABLE "
+                "(must be exactly once)"
+            )
+
+    emitted = collect_emitted_names()
+    for name, sites in sorted(emitted.items()):
+        where = ", ".join(f"{p}:{ln}" for p, ln in sites)
+        if name == "<non-literal>":
+            problems.append(
+                f"non-literal metric name at {where} — metric names must "
+                "be string literals so the table lint can see them"
+            )
+            continue
+        if name == "<syntax-error>":
+            problems.append(f"unparseable source: {where}")
+            continue
+        if counts.get(name, 0) == 0:
+            problems.append(
+                f"emitted metric {name} ({where}) is missing from "
+                "areal_tpu/observability/table.py METRIC_TABLE"
+            )
+
+    emitted_names = set(emitted) - {"<non-literal>", "<syntax-error>"}
+    for name in sorted(set(counts) - emitted_names):
+        problems.append(
+            f"table entry {name} is never emitted anywhere under "
+            "areal_tpu/ or bench.py (dead vocabulary — remove it or wire "
+            "the instrument)"
+        )
+    return problems
+
+
+def main() -> int:
+    problems = run_lint()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"check_metric_names: {len(problems)} problem(s)")
+        return 1
+    print("check_metric_names: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,3 +66,51 @@ def test_rollout_stat():
     rs.accepted += 1
     rs.running -= 1
     assert rs.as_dict() == {"submitted": 2, "accepted": 1, "running": 1}
+
+
+def test_time_marks_publish_histogram_to_registry():
+    """Marks are no longer log-only: each interval lands in the
+    areal_time_mark_seconds histogram (one series per mark name)."""
+    from areal_tpu.observability import get_registry
+
+    clear_time_marks()
+    with time_mark("publish_check", identifier="w0", step=1):
+        time.sleep(0.005)
+    with time_mark("publish_check", identifier="w0", step=2):
+        pass
+    h = get_registry().histogram("areal_time_mark_seconds")
+    total, count = h.snapshot(mark="publish_check")
+    assert count == 2
+    assert total >= 0.005
+    clear_time_marks()
+
+
+def test_utilization_monitor_publishes_gauges():
+    """The HBM/host sampler exports into the registry instead of staying
+    log-only (satellite of the observability plane)."""
+    from areal_tpu.observability.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    mon = UtilizationMonitor(interval=1000, registry=reg)
+    mon._sample()  # one synchronous sample, no thread needed
+    names = reg.names()
+    # host gauges always present on linux
+    assert "areal_host_load1" in names or "areal_host_rss_gb" in names
+    # device gauges appear iff the backend reports memory_stats
+    if device_memory_stats():
+        assert "areal_device_hbm_in_use_gb" in names
+
+
+def test_device_peak_flops_table():
+    from areal_tpu.base.monitor import device_peak_flops
+
+    class _D:
+        device_kind = "TPU v5e"
+
+    assert device_peak_flops(_D()) == 197e12
+
+    class _C:
+        device_kind = "cpu"
+
+    assert device_peak_flops(_C()) == 0.0
+    assert device_peak_flops(object()) == 0.0

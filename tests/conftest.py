@@ -34,3 +34,6 @@ def _fresh_globals():
     from areal_tpu.models import transformer
 
     transformer.set_ambient_mesh(None)
+    from areal_tpu.observability import set_registry
+
+    set_registry(None)  # fresh metric series per test

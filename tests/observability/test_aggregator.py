@@ -1,0 +1,152 @@
+"""Master-side aggregator: name-resolve discovery, multi-worker scrape
+(>= 3 live endpoints), jsonl snapshotting, sink fan-out, and dead-endpoint
+tolerance.  The three workers carry the acceptance-critical series:
+staleness (gserver), queue depth (gserver), and step time (trainer)."""
+
+import json
+
+import pytest
+
+from areal_tpu.base import constants, name_resolve
+from areal_tpu.observability.aggregator import ClusterMetricsAggregator
+from areal_tpu.observability.registry import MetricsRegistry
+from areal_tpu.observability.server import MetricsServer
+
+EXPR, TRIAL = "aggtest", "t0"
+
+
+@pytest.fixture(autouse=True)
+def _names():
+    name_resolve.reconfigure("memory")
+    constants.set_experiment_trial_names(EXPR, TRIAL)
+    yield
+
+
+@pytest.fixture
+def three_live_workers():
+    """A gserver manager, a model worker, and a gen server — each a live
+    HTTP endpoint over its own registry, registered under the canonical
+    metric-server keys."""
+    gsm = MetricsRegistry()
+    gsm.counter("areal_gserver_alloc_rejections_total").inc(4, reason="staled")
+    gsm.gauge("areal_gserver_running_rollouts").set(12)
+    gsm.gauge("areal_gserver_version_lag").set(2)
+
+    trainer = MetricsRegistry()
+    trainer.histogram("areal_train_step_seconds").observe(1.5, model="actor")
+    trainer.gauge("areal_train_tokens_per_second").set(1e5, model="actor")
+
+    gen = MetricsRegistry()
+    gen.counter("areal_inference_host_seconds_total").inc(0.25)
+    gen.counter("areal_inference_device_seconds_total").inc(1.5)
+    gen.counter("areal_inference_fetch_seconds_total").inc(0.5)
+
+    servers = []
+    for wname, reg in (
+        ("gserver_manager", gsm),
+        ("model_worker_0", trainer),
+        ("gen_server_0", gen),
+    ):
+        srv = MetricsServer(registry=reg).start()
+        srv.register(EXPR, TRIAL, wname)
+        servers.append(srv)
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def test_discovers_and_scrapes_three_live_workers(
+    three_live_workers, tmp_path
+):
+    snap = tmp_path / "cluster_metrics.jsonl"
+    agg = ClusterMetricsAggregator(EXPR, TRIAL, snapshot_path=str(snap))
+    assert sorted(agg.discover()) == [
+        "gen_server_0",
+        "gserver_manager",
+        "model_worker_0",
+    ]
+    flat = agg.step(step=7)
+    agg.close()
+
+    # staleness / queue-depth / step-time series all present, per worker
+    assert (
+        flat[
+            "cluster/gserver_manager/"
+            "areal_gserver_alloc_rejections_total{reason=staled}"
+        ]
+        == 4.0
+    )
+    assert flat["cluster/gserver_manager/areal_gserver_running_rollouts"] == 12.0
+    assert flat["cluster/gserver_manager/areal_gserver_version_lag"] == 2.0
+    assert (
+        flat["cluster/model_worker_0/areal_train_step_seconds_count{model=actor}"]
+        == 1.0
+    )
+    assert (
+        flat["cluster/model_worker_0/areal_train_step_seconds_sum{model=actor}"]
+        == 1.5
+    )
+    assert (
+        flat["cluster/gen_server_0/areal_inference_device_seconds_total"]
+        == 1.5
+    )
+    # histogram buckets are dropped from the flat view (sum/count kept)
+    assert not any("_bucket" in k for k in flat)
+
+    # the jsonl snapshot is the same flat dict, stamped with the step
+    rows = [json.loads(l) for l in snap.read_text().splitlines()]
+    assert len(rows) == 1
+    assert rows[0]["step"] == 7
+    assert (
+        rows[0]["cluster/gserver_manager/areal_gserver_running_rollouts"]
+        == 12.0
+    )
+
+
+def test_dead_endpoint_counted_not_fatal(three_live_workers):
+    # kill one worker but leave its name-resolve registration behind
+    three_live_workers[0]._registered_key = None  # keep the stale key
+    three_live_workers[0].stop()
+    agg = ClusterMetricsAggregator(EXPR, TRIAL, scrape_timeout=0.5)
+    scraped = agg.scrape()
+    assert sorted(scraped) == ["gen_server_0", "model_worker_0"]
+    errs = agg._registry.counter("areal_aggregator_scrape_errors_total")
+    assert errs.value(endpoint="gserver_manager") == 1.0
+
+
+def test_malformed_page_rejected_by_strict_parser(three_live_workers):
+    """A worker serving junk (partial write, wrong handler) is an error,
+    not silently-wrong numbers."""
+    import http.server
+    import threading
+
+    class JunkHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b"not_declared 1.0\n"
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), JunkHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        from areal_tpu.base import names
+
+        name_resolve.add(
+            names.metric_server(EXPR, TRIAL, "junk", "junk_worker"),
+            f"127.0.0.1:{httpd.server_address[1]}",
+            replace=True,
+        )
+        agg = ClusterMetricsAggregator(EXPR, TRIAL, scrape_timeout=2.0)
+        scraped = agg.scrape()
+        assert "junk_worker" not in scraped  # rejected, counted as error
+        assert len(scraped) == 3  # the healthy workers still land
+        errs = agg._registry.counter("areal_aggregator_scrape_errors_total")
+        assert errs.value(endpoint="junk_worker") == 1.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

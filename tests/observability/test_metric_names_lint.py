@@ -1,0 +1,48 @@
+"""Tier-1 gate for the canonical metric vocabulary: every emitted name
+appears exactly once in observability/table.py, no dynamic names, no dead
+table entries (scripts/check_metric_names.py)."""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+SCRIPT = os.path.join(REPO_ROOT, "scripts", "check_metric_names.py")
+
+
+def test_codebase_metric_names_match_table():
+    proc = subprocess.run(
+        [sys.executable, SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, (
+        "metric-name lint failed:\n" + proc.stdout + proc.stderr
+    )
+
+
+def test_lint_catches_violations(tmp_path, monkeypatch):
+    """The lint actually detects the three violation classes (a lint that
+    can't fail is no gate)."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import check_metric_names as lint
+    finally:
+        sys.path.pop(0)
+
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "reg.counter('totally_unknown_total').inc()\n"
+        "reg.gauge(computed_name).set(1)\n"
+    )
+    monkeypatch.setattr(
+        lint, "_iter_source_files", lambda: [str(src)]
+    )
+    problems = lint.run_lint()
+    assert any("totally_unknown_total" in p for p in problems)
+    assert any("non-literal" in p for p in problems)
+    # every real table entry is now "never emitted" too
+    assert any("dead vocabulary" in p for p in problems)

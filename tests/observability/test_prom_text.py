@@ -1,0 +1,77 @@
+"""Strict Prometheus text parser: accepts the renderer's output verbatim,
+rejects out-of-spec pages a real Prometheus server would refuse."""
+
+import pytest
+
+from areal_tpu.observability.prom_text import PromParseError, parse
+from areal_tpu.observability.registry import MetricsRegistry
+
+
+def test_render_parse_round_trip_with_label_escapes():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(2, path='a"b\\c\nd')
+    reg.gauge("g").set(-1.5, k="v")
+    reg.histogram("h_seconds", buckets=(0.5, 2.0)).observe(1.0)
+    fams = parse(reg.render())
+    assert fams["c_total"].series(path='a"b\\c\nd') == 2.0
+    assert fams["g"].series(k="v") == -1.5
+    assert fams["h_seconds"].series("_count") == 1.0
+    assert fams["h_seconds"].series("_sum") == 1.0
+    assert fams["h_seconds"].series("_bucket", le="0.5") == 0.0
+    assert fams["h_seconds"].series("_bucket", le="2.0") == 1.0
+    assert fams["h_seconds"].series("_bucket", le="+Inf") == 1.0
+
+
+def test_special_values_and_timestamps():
+    text = (
+        "# TYPE g gauge\n"
+        "g{a=\"x\"} +Inf\n"
+        "g{a=\"y\"} NaN 1712345678000\n"
+    )
+    fams = parse(text)
+    assert fams["g"].series(a="x") == float("inf")
+    v = fams["g"].series(a="y")
+    assert v != v  # NaN
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "no_type_declared 1.0\n",  # sample without # TYPE
+        "# TYPE g gauge\ng{a=}\n",  # unquoted label value
+        "# TYPE g gauge\ng 1.0\ng 2.0\n",  # duplicate sample
+        "# TYPE g bogus\ng 1.0\n",  # unknown type
+        "# TYPE g gauge\ng{a=\"x\" 1.0\n",  # unterminated labels
+        "# TYPE g gauge\ng not-a-number\n",  # bad value
+        "# TYPE h histogram\nh 1.0\n",  # histogram sample w/o suffix
+    ],
+)
+def test_strictness_rejects(bad):
+    with pytest.raises(PromParseError):
+        parse(bad)
+
+
+def test_histogram_consistency_enforced():
+    # non-cumulative buckets
+    bad = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="1.0"} 5\n'
+        'h_bucket{le="+Inf"} 3\n'
+        "h_sum 1.0\n"
+        "h_count 3\n"
+    )
+    with pytest.raises(PromParseError):
+        parse(bad)
+    # +Inf bucket must equal _count
+    bad2 = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="+Inf"} 3\n'
+        "h_sum 1.0\n"
+        "h_count 4\n"
+    )
+    with pytest.raises(PromParseError):
+        parse(bad2)
+    # missing +Inf
+    bad3 = "# TYPE h histogram\n" 'h_bucket{le="1.0"} 1\n' "h_count 1\n"
+    with pytest.raises(PromParseError):
+        parse(bad3)

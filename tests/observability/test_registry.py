@@ -1,0 +1,112 @@
+"""Registry semantics: label identity, type safety, canonical-table label
+enforcement, and exactness under concurrent writers."""
+
+import threading
+
+import pytest
+
+from areal_tpu.observability.registry import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+
+
+def test_counter_and_gauge_series_by_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("areal_gserver_alloc_rejections_total")
+    c.inc(reason="staled")
+    c.inc(2, reason="staled")
+    c.inc(reason="capacity")
+    assert c.value(reason="staled") == 3.0
+    assert c.value(reason="capacity") == 1.0
+    g = reg.gauge("areal_buffer_size")
+    g.set(10)
+    g.set(4)
+    assert g.value() == 4.0
+
+
+def test_counter_rejects_decrease_and_type_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("areal_rollout_episodes_total")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # re-registration returns the same object; a different type is an error
+    assert reg.counter("areal_rollout_episodes_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("areal_rollout_episodes_total")
+
+
+def test_table_label_schema_enforced():
+    """Metrics in the canonical table must use exactly their declared
+    labels — a typo'd label would silently fork a series otherwise."""
+    reg = MetricsRegistry()
+    c = reg.counter("areal_gserver_alloc_rejections_total")
+    with pytest.raises(ValueError):
+        c.inc()  # declared label 'reason' missing
+    with pytest.raises(ValueError):
+        c.inc(cause="staled")  # wrong label name
+    # off-table names are free-form (ad-hoc/test metrics)
+    reg.counter("adhoc_total").inc(anything="goes")
+
+
+def test_histogram_buckets_and_snapshot():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    total, count = h.snapshot()
+    assert count == 4
+    assert abs(total - 55.55) < 1e-9
+    # default buckets are strictly increasing (render relies on it)
+    assert list(DEFAULT_BUCKETS) == sorted(set(DEFAULT_BUCKETS))
+
+
+def test_concurrent_writers_exact_counts():
+    """16 threads x 500 increments each must land exactly — the registry is
+    written from poll loops, beat threads, and samplers concurrently."""
+    reg = MetricsRegistry()
+    c = reg.counter("concurrency_total")
+    h = reg.histogram("concurrency_seconds", buckets=(1.0,))
+    n_threads, n_iters = 16, 500
+    barrier = threading.Barrier(n_threads)
+
+    def work(i):
+        barrier.wait()
+        for _ in range(n_iters):
+            c.inc(writer=str(i % 4))
+            h.observe(0.5)
+
+    threads = [
+        threading.Thread(target=work, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(c.value(writer=str(w)) for w in range(4))
+    assert total == n_threads * n_iters
+    _, count = h.snapshot()
+    assert count == n_threads * n_iters
+
+
+def test_set_stats_fans_into_areal_stats_gauge():
+    reg = MetricsRegistry()
+    reg.set_stats({"ppo/loss": 0.5, "bad": "skip-me", "n": 3})
+    g = reg.gauge("areal_stats")
+    assert g.value(key="ppo/loss") == 0.5
+    assert g.value(key="n") == 3.0
+    assert 'key="bad"' not in reg.render()
+    # replace semantics: a key absent from the next export disappears
+    # instead of lingering at its stale value
+    reg.set_stats({"ppo/loss": 0.25})
+    assert 'key="n"' not in reg.render()
+    assert g.value(key="ppo/loss") == 0.25
+
+
+def test_default_registry_swap():
+    a = get_registry()
+    assert get_registry() is a
+    set_registry(None)
+    assert get_registry() is not a

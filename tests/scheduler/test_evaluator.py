@@ -108,6 +108,83 @@ def test_failed_eval_marked_not_logged(tmp_path):
     assert metrics.logged == []
 
 
+def test_jobs_go_through_scheduler_client(tmp_path):
+    """Eval jobs submit through the scheduler layer (local + slurm share
+    the SchedulerClient interface) — a mock binary writes the result JSON,
+    the harvest reads job state from the client, and shutdown stops jobs
+    via the client (no in-process Popen bookkeeping)."""
+    import stat
+
+    from areal_tpu.scheduler.client import JobState, LocalSchedulerClient
+
+    ckpt_root = str(tmp_path / "ckpts")
+    _mk_ckpt(ckpt_root, 1, 1, 3)
+
+    # mock eval binary: argv[1] = output path
+    mock = tmp_path / "mock_eval"
+    mock.write_text(
+        "#!/bin/sh\n"
+        'echo \'{"accuracy": 1.0, "per_task": {}}\' > "$1"\n'
+    )
+    mock.chmod(mock.stat().st_mode | stat.S_IEXEC)
+
+    class RecordingScheduler(LocalSchedulerClient):
+        def __init__(self):
+            super().__init__("evaltest", "t0")
+            self.submissions = []
+
+        def submit(self, worker_type, cmd, **kw):
+            self.submissions.append((worker_type, list(cmd)))
+            super().submit(worker_type, cmd, **kw)
+
+    sched = RecordingScheduler()
+    metrics = StubMetrics()
+    ev = AutomaticEvaluator(
+        ckpt_root,
+        "unused.jsonl",
+        str(tmp_path / "eval"),
+        metrics=metrics,
+        eval_argv=lambda s: [str(mock), s.output_path],
+        scheduler=sched,
+    )
+    _drive(ev, lambda: len(ev.results) == 1)
+    # submitted exactly once, through the client, under a step-keyed type
+    assert [wt for wt, _ in sched.submissions] == ["eval_gs3"]
+    assert sched.submissions[0][1][0] == str(mock)
+    assert ev._steps[3].job_key == "eval_gs3"
+    # the client observed the completion (harvest used job state, not rc)
+    (job,) = sched.find_all()
+    assert job.state == JobState.COMPLETED
+    assert metrics.logged == [(3, {"eval/accuracy": 1.0})]
+    ev.shutdown()
+
+
+def test_scheduler_reported_failure_marks_step_failed(tmp_path):
+    """A job the scheduler reports FAILED (non-zero exit on a cluster)
+    must mark the step FAILED even though an output file never appears."""
+    from areal_tpu.scheduler.client import LocalSchedulerClient
+
+    ckpt_root = str(tmp_path / "ckpts")
+    _mk_ckpt(ckpt_root, 1, 1, 9)
+    ev = AutomaticEvaluator(
+        ckpt_root,
+        "unused.jsonl",
+        str(tmp_path / "eval"),
+        eval_argv=_fail_argv,
+        scheduler=LocalSchedulerClient("evaltest", "t1"),
+    )
+    _drive(
+        ev,
+        lambda: ev._steps
+        and all(
+            s.status in (EvalStatus.FAILED, EvalStatus.DONE)
+            for s in ev._steps.values()
+        ),
+    )
+    assert ev._steps[9].status == EvalStatus.FAILED
+    ev.shutdown()
+
+
 def test_eval_result_json_roundtrip(tmp_path):
     # the aggregate JSON the eval CLI writes is what _harvest parses
     result = {

@@ -26,6 +26,7 @@ def _manager(policy="least_requests", **cfg_kwargs):
     m.rollout_stat = RolloutStat()
     m._model_version = 0
     m._expr, m._trial = "test-exp", "test-trial"
+    m._init_metrics()
     return m
 
 
