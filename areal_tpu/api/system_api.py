@@ -136,6 +136,19 @@ class GenServerConfig:
     page_size: int = 1024
     kv_pool_tokens: Optional[int] = None
     prefill_chunk_tokens: int = 1024
+    # decode-pipeline depth: max chunks dispatched-but-unharvested (the
+    # engine's in-flight ring).  2 overlaps each chunk's output fetch
+    # with the next chunk's device time; raise it when the fetch RTT
+    # exceeds a chunk's device time (high-latency tunnels).  1 =
+    # unpipelined baseline.
+    pipeline_depth: int = 2
+    # measured dispatch-table overrides for cache_mode="auto" (None =
+    # builtin defaults / bench-derived values from engine/dispatch.py):
+    # paged_min_cache_len switches dense->paged by kv_cache_len;
+    # deep_kernel_min_context switches the paged decode kernel to the
+    # deep DMA-ring variant once the batch's longest context crosses it
+    paged_min_cache_len: Optional[int] = None
+    deep_kernel_min_context: Optional[int] = None
     # which local device hosts this server's engine (trainer/generation
     # device split on one host; None = default device)
     device_idx: Optional[int] = None

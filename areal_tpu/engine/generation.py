@@ -24,7 +24,7 @@ import numpy as np
 
 from areal_tpu.api import model_api
 from areal_tpu.api.data import SequenceSample
-from areal_tpu.base import logging_
+from areal_tpu.base import jax_compat, logging_
 from areal_tpu.engine.batching import bucket_len
 from areal_tpu.engine.sampling import SamplingParams, sample_logits
 from areal_tpu.models.config import TransformerConfig
@@ -238,6 +238,10 @@ def generate_tokens(
         sampling=sampling,
         cache_len=cache_len,
     )
+    # start all four device->host copies before the first blocking
+    # conversion: sequential np.asarray calls would each pay a full
+    # tunnel/PCIe round-trip, serialized
+    jax_compat.start_host_copies((out_tokens, out_logps, n_gen, no_eos))
     out_tokens = np.asarray(out_tokens)
     out_logps = np.asarray(out_logps)
     n_gen = np.asarray(n_gen)

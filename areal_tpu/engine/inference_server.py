@@ -11,9 +11,17 @@ Design:
   ``KVCache`` rows advance independently, so admission is a per-row prefill
   scatter and decoding is one jitted multi-token chunk over all rows).
 * The host loop alternates: admit pending requests into free rows ->
-  run a ``decode_chunk`` (``chunk_size`` tokens fully device-side) ->
-  harvest finished rows.  Host<->device sync happens once per chunk, the
-  XLA analogue of the reference's CUDA-graphed decode.
+  dispatch a ``decode_chunk`` (``chunk_size`` tokens fully device-side)
+  into a ``pipeline_depth``-deep in-flight ring -> harvest the OLDEST
+  dispatched chunk once the ring is full.  Up to K chunks are queued on
+  the device at once and every chunk's outputs start an async
+  device->host copy at dispatch time, so the fetch round-trip of chunk N
+  overlaps the device time of chunks N+1..N+K — host<->device sync is
+  one *overlapped* fetch per chunk, the XLA analogue of the reference's
+  CUDA-graphed decode behind a deep submission queue.  All harvest
+  decisions are dispatch-count-based (never wall-clock or readiness
+  probes): multi-host SPMD controllers replay the same command stream
+  and must take identical branches.
 * ``update_weights(params)`` interrupts between chunks: the current chunk
   finishes, weights swap, and every in-flight row's KV is recomputed by
   re-prefilling its tokens under the new weights (the patch's
@@ -38,25 +46,30 @@ import dataclasses
 import threading
 import time
 import uuid
+from collections import deque
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from areal_tpu.api import model_api
-from areal_tpu.base import logging_
+from areal_tpu.base import jax_compat, logging_
 from areal_tpu.engine.batching import bucket_len
+from areal_tpu.engine.dispatch import (
+    DEFAULT_PAGED_MIN_CACHE_LEN,
+    PagedDispatchTable,
+)
 from areal_tpu.engine.sampling import SamplingParams, sample_logits
 from areal_tpu.models import paged
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import KVCache, decode_step, prefill
 
-#: auto cache mode picks the paged pool at/above this cache length — below
-#: it the dense bucketed path wins (short prefixes amortize no block
-#: machinery; measured crossover on v5e, see bench.py decode rows)
-PAGED_MIN_CACHE_LEN = 2048
+#: back-compat alias: the auto dense/paged crossover now lives in the
+#: (config-overridable, bench-derivable) dispatch table — see
+#: areal_tpu/engine/dispatch.py
+PAGED_MIN_CACHE_LEN = DEFAULT_PAGED_MIN_CACHE_LEN
 
 
 @partial(jax.jit, static_argnames=("sampling",))
@@ -144,6 +157,23 @@ class _Fill:
     blocks: List[int]
     targets: List[_FillTarget]
     fill_pos: int = 0
+
+
+@dataclasses.dataclass
+class _InflightChunk:
+    """One dispatched-but-unharvested decode chunk in the pipeline ring.
+
+    ``arrs`` holds the chunk's device outputs ``(out_t, out_l, emitted,
+    active, cur)`` — already swapped for the local replica on multi-host
+    meshes, with an async device->host copy started at dispatch time so
+    the transfer rides under the device time of the chunks queued behind
+    it.  ``snapshot`` is the dispatch-time ``(row_id, epoch)`` occupancy:
+    the harvest folds outputs ONLY into rows whose epoch still matches
+    (a slot freed-and-reused mid-ring carries a different epoch and is
+    skipped — the harvest-identity invariant)."""
+
+    arrs: Tuple[Any, ...]
+    snapshot: List[Tuple[int, int]]
 
 
 @partial(jax.jit, static_argnames=("cfg", "sampling"), donate_argnums=(2,))
@@ -283,6 +313,8 @@ class ContinuousBatchingEngine:
         page_size: int = 1024,
         kv_pool_tokens: Optional[int] = None,
         prefill_chunk_tokens: int = 1024,
+        pipeline_depth: int = 2,
+        dispatch_table: Optional[PagedDispatchTable] = None,
     ):
         """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
         shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
@@ -293,8 +325,20 @@ class ContinuousBatchingEngine:
         ``cache_mode``: "dense" keeps per-row ``[max_batch, kv_cache_len]``
         KV; "paged" uses a shared block pool + block tables (capacity in
         ``page_size``-token pages, chunked prefill, block-shared group
-        prompts); "auto" picks paged at ``kv_cache_len >=
-        PAGED_MIN_CACHE_LEN`` for global-attention models.
+        prompts); "auto" consults ``dispatch_table`` (default: paged at
+        ``kv_cache_len >= 2048``) for global-attention models, and the
+        same table picks the deep DMA-ring paged kernel once the batch's
+        longest context crosses its measured threshold.
+
+        ``pipeline_depth``: max decode chunks dispatched-but-unharvested
+        (the in-flight ring).  K=1 is the unpipelined baseline (dispatch
+        then immediately block — parity reference); K=2 overlaps one
+        chunk's fetch with the next chunk's device time; K>=3 keeps the
+        device fed even when the output-fetch RTT exceeds a chunk's own
+        device time (high-latency tunnels).  Token streams are identical
+        across K under greedy sampling; under temperature sampling the
+        rng SPLIT SEQUENCE depends on how many speculative tail chunks
+        get dispatched, so distributions match but streams may not.
         ``kv_pool_tokens`` sizes the paged pool (default: dense-equivalent
         ``max_batch * kv_cache_len``; set smaller to serve long contexts a
         dense cache could never reserve).  ``prefill_chunk_tokens`` bounds
@@ -305,9 +349,12 @@ class ContinuousBatchingEngine:
         self.device = device
         self.mesh = mesh
         assert cache_mode in ("auto", "dense", "paged"), cache_mode
+        assert pipeline_depth >= 1, pipeline_depth
+        self.pipeline_depth = pipeline_depth
+        self.dispatch_table = dispatch_table or PagedDispatchTable()
         self.paged = cache_mode == "paged" or (
             cache_mode == "auto"
-            and kv_cache_len >= PAGED_MIN_CACHE_LEN
+            and kv_cache_len >= self.dispatch_table.paged_min_cache_len
             and cfg.sliding_window is None
         )
         if self.paged and cfg.sliding_window is not None:
@@ -395,14 +442,19 @@ class ContinuousBatchingEngine:
         self.time_device_s = 0.0
         self.time_fetch_s = 0.0
         self.chunks_total = 0
+        # async-fetch accounting: chunks whose outputs started a
+        # device->host copy at dispatch, and harvests that found the
+        # oldest chunk already complete (its fetch fully overlapped)
+        self.async_fetches_total = 0
+        self.fetch_ready_total = 0
         self.park_ttl_steps = 512  # engine steps a parked row may idle
         # True = decode only, admit nothing (drain-before-update servers)
         self.hold_admissions = False
         self._step_seq = 0  # deterministic clock (one tick per step())
         self._epoch_counter = 0  # admission/resume stamp source
-        # the dispatched-but-unharvested decode chunk (pipelined stepping):
-        # (out_t, out_l, emitted, active, cur, snapshot_row_ids)
-        self._pending_chunk = None
+        # the in-flight chunk ring: dispatched-but-unharvested decode
+        # chunks, FIFO, at most ``pipeline_depth`` deep
+        self._ring: Deque[_InflightChunk] = deque()
 
     # -- paged-cache state --------------------------------------------------
 
@@ -586,13 +638,19 @@ class ContinuousBatchingEngine:
         return len(self._pending)
 
     @property
+    def inflight_chunks(self) -> int:
+        """Decode chunks dispatched but not yet harvested (ring depth in
+        use; bounded by ``pipeline_depth``)."""
+        return len(self._ring)
+
+    @property
     def has_work(self) -> bool:
         # host-side bookkeeping only — no device fetch; parked rows are
         # idle and do not keep the loop hot
         return (
             self.n_pending > 0
             or self.n_inflight > 0
-            or self._pending_chunk is not None
+            or bool(self._ring)
             or (self.paged and bool(self._filling or self._preempted))
         )
 
@@ -603,9 +661,10 @@ class ContinuousBatchingEngine:
             if self._new_params is None:
                 return
         # the host row state must be exact before re-prefilling in-flight
-        # rows: drain the pipelined chunk first
-        self._harvest(self._pending_chunk)
-        self._pending_chunk = None
+        # rows: quiesce the WHOLE pipeline ring first (every dispatched
+        # chunk was computed under the old weights and must be folded in
+        # before the swap — none may be emitted after it as if new)
+        self._drain_ring()
         with self._lock:
             new_params = self._new_params
             self._new_params = None
@@ -846,19 +905,29 @@ class ContinuousBatchingEngine:
             pending = [f for f in pending if f.fill_pos < len(f.tokens)]
 
     def _advance_fill(self):
-        """One chunked-prefill step: advance in-flight fills by at most
-        ``prefill_chunk_tokens`` total, then activate rows whose prompt
-        completed (sample first tokens / restore preempted state)."""
-        if not self._filling:
-            return
-        completed, idxs, logits = self._run_fill_batch(
-            self._filling, self.prefill_chunk_tokens
-        )
-        if not completed:
-            return
-        for f in completed:
-            self._filling.remove(f)
-        self._distribute_fills(completed, idxs, logits)
+        """Advance in-flight chunked prefills.
+
+        With rows decoding, ONE ``prefill_chunk_tokens`` batch per engine
+        step bounds the decode stall at a single chunk (the chunked-
+        prefill interleave).  With NOTHING decoding there is no stall to
+        bound, so the whole admission wave's chunks are dispatched
+        back-to-back in this one call — each ``paged_fill_chunk`` is an
+        async jit dispatch chaining on the donated pool, so a 16k prompt
+        issues its 16 chunks with no host round-trip between them
+        instead of paying one engine-step (admit/harvest bookkeeping +
+        fetch) per chunk."""
+        while self._filling:
+            completed, idxs, logits = self._run_fill_batch(
+                self._filling, self.prefill_chunk_tokens
+            )
+            if completed:
+                for f in completed:
+                    self._filling.remove(f)
+                self._distribute_fills(completed, idxs, logits)
+            elif logits is None:
+                return  # nothing advanced: no fill has tokens left
+            if self.n_decoding > 0:
+                return
 
     def _distribute_fills(self, fills: List[_Fill], idxs, logits):
         """Hand a completed fill's blocks to its targets: target 0 owns
@@ -1085,18 +1154,22 @@ class ContinuousBatchingEngine:
         active rows (recompute-on-readmit, the deterministic analogue of
         vLLM's recompute preemption)."""
         W = self.chunk_size
+        # every un-harvested chunk that snapshot a row may advance it by
+        # up to W more tokens the host has not folded in yet (row_id
+        # match only: the device does not know epochs — any chunk
+        # dispatched while the slot was active moves its length).  One
+        # pass over the ring, not one per row: this is the decode hot
+        # loop whose host_s share the split exists to minimize.
+        pend_counts: Dict[int, int] = {}
+        for ch in self._ring:
+            for rid, _ in ch.snapshot:
+                pend_counts[rid] = pend_counts.get(rid, 0) + 1
         for row_id in range(self.max_batch):
             row = self.rows[row_id]
             if row is None or row.parked or row.filling:
                 continue
-            pend = (
-                self._pending_chunk is not None
-                and any(rid == row_id for rid, _ in self._pending_chunk[5])
-            )
-            host_len = len(row.prompt) + len(row.generated) + 1
-            if pend:
-                # un-harvested chunk may advance this row by up to W more
-                host_len += W
+            n_pend = pend_counts.get(row_id, 0)
+            host_len = len(row.prompt) + len(row.generated) + 1 + n_pend * W
             need = -(-(host_len + W) // self.page_size)
             need = min(need, self.blocks_per_row)
             while need > len(self._row_blocks[row_id]):
@@ -1118,8 +1191,22 @@ class ContinuousBatchingEngine:
                         f"kv_cache_len={self.kv_cache_len}"
                     )
                 self._preempt_row(victim)
-                if self.rows[row_id] is None or self.rows[row_id] is not row:
-                    break  # this very row finished during the drain
+                # the preemption DRAINED the ring: pending chunks are now
+                # folded into every row's generated, so the counts taken
+                # above would double-charge them — recompute this row's
+                # demand and zero the counts for the rows that follow
+                pend_counts.clear()
+                if (
+                    self.rows[row_id] is None
+                    or self.rows[row_id] is not row
+                    or row.parked
+                ):
+                    break  # this very row finished/parked during the drain
+                host_len = len(row.prompt) + len(row.generated) + 1
+                need = min(
+                    -(-(host_len + W) // self.page_size),
+                    self.blocks_per_row,
+                )
 
     def _pick_preemption_victim(self, exclude: int) -> Optional[int]:
         """Youngest active row (highest epoch) — deterministic, and the
@@ -1138,10 +1225,10 @@ class ContinuousBatchingEngine:
     def _preempt_row(self, row_id: int):
         """Stop decoding a row and reclaim its blocks; it re-admits
         through the fill queue (prefix recompute) when space frees up."""
-        # the in-flight chunk must be folded in first: preemption rewrites
-        # the row set the harvest snapshot refers to
-        self._harvest(self._pending_chunk)
-        self._pending_chunk = None
+        # every in-flight chunk must be folded in first: preemption
+        # rewrites the row set the harvest snapshots refer to (a full
+        # pipeline flush — preemption is rare, correctness is not)
+        self._drain_ring()
         row = self.rows[row_id]
         if row is None or row.parked or row.filling:
             return  # the drain finished or parked the victim: done
@@ -1154,6 +1241,26 @@ class ContinuousBatchingEngine:
             "pressure",
             row_id, row.req.qid, len(row.prompt) + len(row.generated),
         )
+
+    def _use_deep_kernel(self) -> bool:
+        """Dispatch-table decision: route this chunk through the deep
+        DMA-ring paged kernel when the batch's longest live context (plus
+        the un-harvested ring allowance) crosses the measured threshold.
+        Host-deterministic (SPMD-safe); at most two compiled variants
+        exist, so threshold crossings cost one compile each, once."""
+        if not self._use_paged_kernel:
+            return False
+        thr = self.dispatch_table.deep_min_context
+        longest = 0
+        for row in self.rows:
+            # filling rows are excluded just like the dispatch snapshot
+            # excludes them: a 16k prompt mid-prefill must not route the
+            # short decoding rows' chunk onto the deep kernel
+            if row is not None and not row.parked and not row.filling:
+                longest = max(
+                    longest, len(row.prompt) + len(row.generated) + 1
+                )
+        return longest + len(self._ring) * self.chunk_size >= thr
 
     def _dispatch_chunk_paged(self):
         snapshot = [
@@ -1193,9 +1300,10 @@ class ContinuousBatchingEngine:
             max_len=self.kv_cache_len,
             mesh=self.mesh,
             kv_axis=getattr(self, "_kv_axis", None),
+            deep_kernel=self._use_deep_kernel(),
         )
         self.cur_tokens = cur
-        self._pending_chunk = (
+        self._enqueue_chunk(
             out_t, out_l, emitted, self.active, self.cur_tokens, snapshot
         )
 
@@ -1335,7 +1443,7 @@ class ContinuousBatchingEngine:
             p <<= 1
         return min(p, self.kv_cache_len)
 
-    def _dispatch_chunk(self, extra_len: int):
+    def _dispatch_chunk(self):
         """Enqueue one decode chunk on the device (async) and record its
         output futures + the in-flight row snapshot for a later harvest."""
         snapshot = [
@@ -1363,33 +1471,64 @@ class ContinuousBatchingEngine:
             self.chunk_size,
             self.stop_tokens,
             self.sampling,
-            attn_len=self._attn_bucket(extra=extra_len),
+            attn_len=self._attn_bucket(
+                extra=len(self._ring) * self.chunk_size
+            ),
         )
-        self._pending_chunk = (
+        self._enqueue_chunk(
             out_t, out_l, emitted, self.active, self.cur_tokens, snapshot
         )
 
-    def _harvest(self, pending) -> int:
-        """Fetch one dispatched chunk's outputs and fold them into the host
-        rows.  Only the rows in the dispatch-time snapshot are touched —
-        rows admitted after the dispatch emitted nothing in this chunk."""
-        if pending is None:
-            return 0
-        out_t, out_l, emitted, active_dev, cur_dev, snapshot = pending
-        # ONE batched host fetch per chunk (separate np.asarray calls each
-        # paid a full tunnel/PCIe round-trip).  Multi-host meshes: the
-        # outputs are replicated but not fully addressable from one
-        # process — swap in the local replica first, then one device_get.
+    def _enqueue_chunk(
+        self, out_t, out_l, emitted, active_dev, cur_dev, snapshot
+    ):
+        """Append a dispatched chunk to the in-flight ring and START its
+        device->host output copy.  The copy rides under the device time
+        of the chunks queued behind it, so by the time the harvest blocks
+        on this chunk the fetch round-trip is (partly or fully) paid —
+        the async-fetch half of the deep pipeline.  Multi-host meshes:
+        outputs are replicated but not fully addressable from one
+        process, so the local replica is swapped in before the copy."""
         arrs = tuple(
             x.addressable_data(0)
             if isinstance(x, jax.Array) and not x.is_fully_addressable
             else x
             for x in (out_t, out_l, emitted, active_dev, cur_dev)
         )
+        if jax_compat.start_host_copies(arrs):
+            self.async_fetches_total += 1
+        self._ring.append(_InflightChunk(arrs=arrs, snapshot=snapshot))
+
+    def _drain_ring(self) -> int:
+        """Harvest EVERY in-flight chunk, oldest first (pipeline flush:
+        pause, weight swap, preemption — host rows exact afterwards)."""
+        n = 0
+        while self._ring:
+            n += self._harvest_oldest()
+        return n
+
+    def _harvest_oldest(self) -> int:
+        """Fetch the OLDEST dispatched chunk's outputs and fold them into
+        the host rows.  FIFO order is the ring-ordering invariant: a row's
+        tokens append in dispatch sequence.  Only rows in the dispatch-time
+        snapshot (matching epoch) are touched — rows admitted/resumed
+        after the dispatch emitted nothing in this chunk."""
+        if not self._ring:
+            return 0
+        chunk = self._ring.popleft()
+        arrs, snapshot = chunk.arrs, chunk.snapshot
         # time attribution: block_until_ready isolates the wait for device
         # compute from the device_get transfer that follows (the transfer
-        # is the tunnel/PCIe cost the pipelined stepping exists to hide)
+        # is the tunnel/PCIe cost the async dispatch-time copy hides)
         tik = time.perf_counter()
+        try:
+            ready = all(
+                x.is_ready() for x in arrs if isinstance(x, jax.Array)
+            )
+        except Exception:  # noqa: BLE001 - readiness probe is telemetry
+            ready = False  # only; never load-bearing (SPMD determinism)
+        if ready:
+            self.fetch_ready_total += 1
         for x in arrs:
             if isinstance(x, jax.Array):
                 x.block_until_ready()
@@ -1428,27 +1567,35 @@ class ContinuousBatchingEngine:
                 row.cur_token = int(cur[row_id])
         return n_tokens
 
-    def _worth_dispatching(self, prev) -> bool:
+    def _worth_dispatching(self) -> bool:
         """Skip a dispatch that could only decode rows the un-harvested
-        chunk ``prev`` is certain to finish (budget exhaustion is
-        deterministic; EOS is not, so an occasional wasted tail chunk
-        remains)."""
-        prev_rows = set(prev[5]) if prev is not None else set()
+        ring is certain to finish (budget exhaustion is deterministic;
+        EOS is not, so an occasional wasted tail chunk remains).
+
+        A row appearing in ``c`` ring snapshots may consume up to
+        ``c * chunk_size`` more budget the host has not folded in yet; it
+        is certainly alive only if its budget exceeds that.  Counting
+        occurrences per (row_id, epoch) — not "is it in the one pending
+        snapshot" — is what makes this correct for rows admitted or
+        resumed MID-RING: their epoch appears in no snapshot (c=0), so
+        their full budget counts and they always earn the dispatch."""
+        if not self._ring:
+            return True
+        counts: Dict[Tuple[int, int], int] = {}
+        for ch in self._ring:
+            for key in ch.snapshot:
+                counts[key] = counts.get(key, 0) + 1
         for row_id, row in enumerate(self.rows):
             if row is None or row.parked or row.filling:
                 continue
-            if prev is None or row.budget_left > self.chunk_size:
-                return True
-            # rows admitted/resumed after the pending dispatch (epoch not in
-            # the snapshot) still have their full budget and are certainly
-            # alive — matching the harvest's (row_id, epoch) identity
-            if (row_id, row.epoch) not in prev_rows:
+            c = counts.get((row_id, row.epoch), 0)
+            if row.budget_left > c * self.chunk_size:
                 return True
         return False
 
     def timing_split(self) -> Dict[str, float]:
         """Cumulative decode-loop time attribution (see the counters set in
-        ``__init__``/``_harvest``)."""
+        ``__init__``/``_harvest_oldest``)."""
         return {
             "host_s": self.time_host_s,
             "device_s": self.time_device_s,
@@ -1457,23 +1604,29 @@ class ContinuousBatchingEngine:
         }
 
     def step(self) -> int:
-        """One engine iteration, PIPELINED: weight swap (if requested),
-        admit, dispatch chunk N+1, then harvest chunk N.  Dispatch-before-
-        harvest keeps the device busy while the host pays the fetch
-        round-trip (through a tunnel that round-trip can exceed the chunk's
-        own device time — measured 2.5x decode throughput on v5e).  Returns
-        the number of tokens emitted (from chunk N)."""
+        """One engine iteration, DEEP-PIPELINED: weight swap (if
+        requested), admit, dispatch chunk N+K-1, then harvest chunk N —
+        the oldest of up to ``pipeline_depth`` in-flight chunks.  Keeping
+        K chunks queued (with their output fetches started at dispatch)
+        keeps the device busy even when the fetch round-trip exceeds a
+        chunk's own device time (through a tunnel it does — measured
+        2.5x decode throughput on v5e at K=2 vs unpipelined).  Harvest
+        policy is dispatch-count-based only (ring full, or nothing left
+        to dispatch) — never readiness probes, so SPMD follower
+        controllers replaying the command stream take identical branches.
+        Returns the number of tokens emitted (from the harvested chunk;
+        0 on ring-filling warm-up steps)."""
         self._step_seq += 1
         if self._paused.is_set():
-            # drain the in-flight chunk so pause means quiesced (untimed:
-            # the idle-pause sleep would otherwise read as host overhead)
-            n = self._harvest(self._pending_chunk)
-            self._pending_chunk = None
+            # drain the whole ring so pause means quiesced (untimed: the
+            # idle-pause sleep would otherwise read as host overhead)
+            n = self._drain_ring()
             if n == 0:
                 time.sleep(0.01)
             return n
         # host time = everything in this step that is neither the blocked
-        # device wait nor the output fetch (both accumulated in _harvest)
+        # device wait nor the output fetch (both accumulated in the
+        # harvest)
         tik = time.perf_counter()
         d0, f0 = self.time_device_s, self.time_fetch_s
         try:
@@ -1482,19 +1635,29 @@ class ContinuousBatchingEngine:
                 self._admit_paged()
                 self._advance_fill()
                 self._ensure_decode_blocks()
-                prev = self._pending_chunk
-                self._pending_chunk = None
-                if self.n_decoding > 0 and self._worth_dispatching(prev):
+                dispatched = False
+                if (
+                    self.n_decoding > 0
+                    and len(self._ring) < self.pipeline_depth
+                    and self._worth_dispatching()
+                ):
                     self._dispatch_chunk_paged()
-                return self._harvest(prev)
-            self._admit()
-            prev = self._pending_chunk
-            self._pending_chunk = None
-            if self.n_decoding > 0 and self._worth_dispatching(prev):
-                self._dispatch_chunk(
-                    extra_len=self.chunk_size if prev is not None else 0
-                )
-            return self._harvest(prev)
+                    dispatched = True
+            else:
+                self._admit()
+                dispatched = False
+                if (
+                    self.n_decoding > 0
+                    and len(self._ring) < self.pipeline_depth
+                    and self._worth_dispatching()
+                ):
+                    self._dispatch_chunk()
+                    dispatched = True
+            if len(self._ring) >= self.pipeline_depth or (
+                not dispatched and self._ring
+            ):
+                return self._harvest_oldest()
+            return 0
         finally:
             dt = time.perf_counter() - tik
             self.time_host_s += max(

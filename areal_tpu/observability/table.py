@@ -70,9 +70,32 @@ METRIC_TABLE = [
         "Unique-prompt tokens actually prefilled (post group-dedup)",
     ),
     MetricSpec(
+        "areal_inference_async_fetches_total",
+        "counter",
+        "Decode chunks whose outputs started an async device-to-host "
+        "copy at dispatch time (the fetch-overlap half of the pipeline)",
+    ),
+    MetricSpec(
+        "areal_inference_fetch_ready_total",
+        "counter",
+        "Harvests that found the oldest in-flight chunk already complete "
+        "(its output fetch fully overlapped by newer chunks' device time)",
+    ),
+    MetricSpec(
         "areal_inference_inflight_rows",
         "gauge",
         "Rows currently decoding or chunk-filling",
+    ),
+    MetricSpec(
+        "areal_inference_ring_depth",
+        "gauge",
+        "Configured decode-pipeline depth (max in-flight decode chunks)",
+    ),
+    MetricSpec(
+        "areal_inference_inflight_chunks",
+        "gauge",
+        "Decode chunks currently dispatched but not yet harvested "
+        "(pipeline-ring occupancy; bounded by areal_inference_ring_depth)",
     ),
     MetricSpec(
         "areal_inference_pending_requests",

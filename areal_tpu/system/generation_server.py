@@ -38,6 +38,7 @@ class GenerationServerWorker(worker_base.Worker):
         self.logger = logging_.getLogger(self.worker_name)
 
         from areal_tpu.engine.backend import make_model
+        from areal_tpu.engine.dispatch import resolve_dispatch_table
         from areal_tpu.engine.inference_server import ContinuousBatchingEngine
         from areal_tpu.engine.sampling import SamplingParams
 
@@ -96,6 +97,11 @@ class GenerationServerWorker(worker_base.Worker):
             page_size=config.page_size,
             kv_pool_tokens=config.kv_pool_tokens,
             prefill_chunk_tokens=config.prefill_chunk_tokens,
+            pipeline_depth=config.pipeline_depth,
+            dispatch_table=resolve_dispatch_table(
+                config.paged_min_cache_len,
+                config.deep_kernel_min_context,
+            ),
         )
 
         self._ctx = zmq.Context.instance()
@@ -175,9 +181,15 @@ class GenerationServerWorker(worker_base.Worker):
             "fetch": reg.counter("areal_inference_fetch_seconds_total"),
             "gen_tokens": reg.counter("areal_inference_generated_tokens_total"),
             "prefill_tokens": reg.counter("areal_inference_prefill_tokens_total"),
+            "async_fetches": reg.counter(
+                "areal_inference_async_fetches_total"
+            ),
+            "fetch_ready": reg.counter("areal_inference_fetch_ready_total"),
             "inflight": reg.gauge("areal_inference_inflight_rows"),
             "pending": reg.gauge("areal_inference_pending_requests"),
             "version": reg.gauge("areal_inference_weight_version"),
+            "ring_depth": reg.gauge("areal_inference_ring_depth"),
+            "inflight_chunks": reg.gauge("areal_inference_inflight_chunks"),
         }
         self._obs_last: Dict[str, float] = {}
 
@@ -190,6 +202,8 @@ class GenerationServerWorker(worker_base.Worker):
             "fetch": eng.time_fetch_s,
             "gen_tokens": float(eng.gen_tokens_total),
             "prefill_tokens": float(eng.prefill_tokens_total),
+            "async_fetches": float(eng.async_fetches_total),
+            "fetch_ready": float(eng.fetch_ready_total),
         }
         for key, total in totals.items():
             delta = total - self._obs_last.get(key, 0.0)
@@ -199,6 +213,8 @@ class GenerationServerWorker(worker_base.Worker):
         self._obs["inflight"].set(eng.n_inflight)
         self._obs["pending"].set(eng.n_pending)
         self._obs["version"].set(eng.version)
+        self._obs["ring_depth"].set(eng.pipeline_depth)
+        self._obs["inflight_chunks"].set(eng.inflight_chunks)
 
     # -- API ---------------------------------------------------------------
 
@@ -300,6 +316,11 @@ class GenerationServerWorker(worker_base.Worker):
             "gen_tokens_total": self.engine.gen_tokens_total,
             "version": self.engine.version,
             "uptime": time.monotonic() - self._start_time,
+            # decode-pipeline ring state + async-fetch overlap counters
+            "ring_depth": self.engine.pipeline_depth,
+            "inflight_chunks": self.engine.inflight_chunks,
+            "async_fetches_total": self.engine.async_fetches_total,
+            "fetch_ready_total": self.engine.fetch_ready_total,
             # decode-loop host/device/fetch attribution (cumulative s)
             **{
                 f"time_{k}": v

@@ -38,6 +38,19 @@ unconditional (first hardware numbers); and the device probe retries with
 backoff and on final failure emits a structured JSON error record at rc=0
 (round 5's bench died to a hung `jax.devices()` on an unreachable TPU).
 
+Round 7 measures the deep-pipelined serving hot path: the generation
+section reports `engine_over_jit` (engine decode vs the isolated
+decode_chunk jit loop at the same shapes — the 0.78x gap VERDICT r5 #5
+flagged), a `ring_ab` sub-row sweeping the engine's `pipeline_depth`
+(K in-flight chunks + dispatch-time async output fetch) with the
+host/device/fetch split per K, and a `prefill_ab` section attributing the
+round-5 prefill regression (jit ceiling vs engine dense admit vs paged
+chunked admit, repeated so tunnel variance is visible as spread).  The
+decode A/B additionally derives a `PagedDispatchTable` (engine/dispatch.py)
+from its own 3-column rows, and the whole round's diffable numbers are
+duplicated into a compact top-level `summary` object so BENCH_rNN.json's
+`parsed` field carries them even when `detail` is huge.
+
 Caveats stated where measured: ONE chip, sync gen+train (the reference's
 number is 128-GPU async); 1.5B uses the true Qwen2.5-1.5B architecture
 with random weights (zero-egress image has no checkpoint; the HF importer
@@ -106,7 +119,7 @@ def bench_gen_cache_len(prompt_len, max_new):
     return -(-n // 128) * 128
 
 
-def make_engine(cfg, params, n_reqs, prompt_len, max_new, chunk=128):
+def make_engine(cfg, params, n_reqs, prompt_len, max_new, chunk=128, **kw):
     from areal_tpu.engine.inference_server import ContinuousBatchingEngine
 
     return ContinuousBatchingEngine(
@@ -115,6 +128,7 @@ def make_engine(cfg, params, n_reqs, prompt_len, max_new, chunk=128):
         max_batch=n_reqs,
         kv_cache_len=bench_gen_cache_len(prompt_len, max_new),
         chunk_size=chunk,
+        **kw,
     )
 
 
@@ -152,11 +166,89 @@ def drain(eng):
     return n
 
 
-def bench_generation(cfg, params, n_reqs, prompt_len=512, max_new=512):
+def _split_fracs(split):
+    attributed = max(
+        split["host_s"] + split["device_s"] + split["fetch_s"], 1e-9
+    )
+    return {
+        "host_s": round(split["host_s"], 4),
+        "device_s": round(split["device_s"], 4),
+        "fetch_s": round(split["fetch_s"], 4),
+        "chunks": int(split["chunks"]),
+        "host_frac": round(split["host_s"] / attributed, 3),
+        "device_frac": round(split["device_s"] / attributed, 3),
+        "fetch_frac": round(split["fetch_s"] / attributed, 3),
+    }
+
+
+def _jit_decode_rate(cfg, params, B, L, S, W=128):
+    """Isolated ``decode_chunk`` jit-loop throughput (tok/s) at the
+    engine's exact shapes and sampling — the engine-overhead-free ceiling
+    that ``engine_over_jit`` divides by (VERDICT r5 #5: the engine ran at
+    ~0.78x of this and nobody could say which overhead ate the rest)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.engine.sampling import SamplingParams, sample_logits
+    from areal_tpu.models.transformer import KVCache, decode_chunk
+
+    sp = SamplingParams()  # the engine's default sampler
+
+    def sample(logits, rng):
+        return sample_logits(logits, rng, sp)
+
+    def no_stop(toks):
+        return jnp.zeros_like(toks, bool)
+
+    dense_jit = jax.jit(
+        decode_chunk,
+        static_argnames=(
+            "cfg", "chunk_size", "sample_fn", "stop_fn", "attn_len"
+        ),
+        donate_argnums=(2,),
+    )
+    key = jax.random.PRNGKey(0)
+    kd = jax.random.normal(
+        key,
+        (cfg.n_layers, B, cfg.n_kv_heads, S, cfg.head_dim),
+        jnp.bfloat16,
+    ) * 0.05
+    cache = KVCache(k=kd, v=kd + 0.0, lengths=jnp.full((B,), L, jnp.int32))
+    cur = jnp.full((B,), 7, jnp.int32)
+    active = jnp.ones((B,), bool)
+    budgets = jnp.full((B,), 10_000, jnp.int32)
+    rng = jax.random.PRNGKey(1)
+    times, cur_h = [], cur
+    for _ in range(4):
+        t0 = time.perf_counter()
+        cache, out_t, _, _, _, _, budgets, rng = dense_jit(
+            params, cfg, cache, cur_h, active, budgets, rng,
+            chunk_size=W, sample_fn=sample, stop_fn=no_stop, attn_len=S,
+        )
+        # route the sampled tokens through the host like the engine does
+        cur_h = jnp.asarray(np.asarray(out_t[:, -1]))
+        times.append(time.perf_counter() - t0)
+    del cache, kd
+    return B * W / min(times[2:])
+
+
+def bench_generation(
+    cfg, params, n_reqs, prompt_len=512, max_new=512,
+    pipeline_depth=2, ring_ab=(), jit_ratio=False,
+):
     """Continuous-batching throughput on one chip: batched prefill tok/s
-    and sustained decode tok/s.  The engine is dropped before returning so
-    its KV cache (and its reference to ``params``) frees promptly."""
-    eng = make_engine(cfg, params, n_reqs, prompt_len, max_new)
+    and sustained decode tok/s under a ``pipeline_depth``-deep in-flight
+    chunk ring.  ``jit_ratio`` adds the isolated decode_chunk loop at the
+    same shapes and the engine/jit ratio; ``ring_ab`` sweeps pipeline
+    depths (shorter waves, compiles shared) reporting tok/s + the
+    host/device/fetch split per K — the fetch_frac column is the direct
+    readout of whether the dispatch-time async output copy is hiding the
+    tunnel RTT.  The engine is dropped before returning so its KV cache
+    (and its reference to ``params``) frees promptly."""
+    eng = make_engine(
+        cfg, params, n_reqs, prompt_len, max_new,
+        pipeline_depth=pipeline_depth,
+    )
     # warmup compiles every attention bucket the timed run touches
     submit_wave(eng, cfg, n_reqs, prompt_len, max_new, "w")
     drain(eng)
@@ -173,28 +265,58 @@ def bench_generation(cfg, params, n_reqs, prompt_len=512, max_new=512):
     n_decoded = drain(eng)
     t_decode = time.perf_counter() - t0
     split = eng.timing_split()
-    attributed = max(
-        split["host_s"] + split["device_s"] + split["fetch_s"], 1e-9
-    )
+    fetch_overlap = {
+        "async_fetches": int(eng.async_fetches_total),
+        "ready_at_harvest": int(eng.fetch_ready_total),
+    }
     del eng
-    return {
+    out = {
         "prefill_toks_per_sec": round(n_reqs * prompt_len / t_prefill, 1),
         "decode_toks_per_sec": round(n_decoded / t_decode, 1),
         "batch": n_reqs,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new,
+        "pipeline_depth": pipeline_depth,
         # decode-loop time attribution (engine-vs-jit gap): host
         # bookkeeping vs blocked-on-device vs output fetch (tunnel/PCIe)
-        "decode_split": {
-            "host_s": round(split["host_s"], 4),
-            "device_s": round(split["device_s"], 4),
-            "fetch_s": round(split["fetch_s"], 4),
-            "chunks": int(split["chunks"]),
-            "host_frac": round(split["host_s"] / attributed, 3),
-            "device_frac": round(split["device_s"] / attributed, 3),
-            "fetch_frac": round(split["fetch_s"] / attributed, 3),
-        },
+        "decode_split": _split_fracs(split),
+        "fetch_overlap": fetch_overlap,
     }
+    if jit_ratio:
+        S = bench_gen_cache_len(prompt_len, max_new)
+        jit_rate = _jit_decode_rate(cfg, params, n_reqs, prompt_len, S)
+        out["jit_decode_toks_per_sec"] = round(jit_rate, 1)
+        out["engine_over_jit"] = round(
+            out["decode_toks_per_sec"] / max(jit_rate, 1e-9), 3
+        )
+    for K in ring_ab:
+        # shorter waves; every attention bucket is already compiled by
+        # the main run (lengths pass through the same power-of-two
+        # buckets while growing), so each K pays only its own decode
+        eng = make_engine(
+            cfg, params, n_reqs, prompt_len, max_new, pipeline_depth=K
+        )
+        ab_new = max_new // 2
+        submit_wave(eng, cfg, n_reqs, prompt_len, ab_new, f"rk{K}")
+        eng._admit()
+        int(np.asarray(eng.cache.lengths)[0])
+        eng.time_host_s = eng.time_device_s = eng.time_fetch_s = 0.0
+        eng.chunks_total = 0
+        t0 = time.perf_counter()
+        n = drain(eng)
+        dt = time.perf_counter() - t0
+        ksplit = _split_fracs(eng.timing_split())
+        out.setdefault("ring_ab", {})[f"k{K}"] = {
+            "decode_toks_per_sec": round(n / dt, 1),
+            "host_frac": ksplit["host_frac"],
+            "device_frac": ksplit["device_frac"],
+            "fetch_frac": ksplit["fetch_frac"],
+            "fetch_ready_frac": round(
+                eng.fetch_ready_total / max(eng.chunks_total, 1), 3
+            ),
+        }
+        del eng
+    return out
 
 
 def bench_prefix_reuse(cfg, params, n_reqs=32, group_size=8, prompt_len=512):
@@ -262,6 +384,106 @@ def bench_prefix_reuse(cfg, params, n_reqs=32, group_size=8, prompt_len=512):
     }
 
 
+def bench_prefill_ab(cfg, params, n_reqs=32, prompt_len=512, repeats=3):
+    """Admission-path prefill A/B (VERDICT r5 #2: the in-round bench saw
+    prefill fall 35.8k -> 23.8k tok/s at b32/512/0.5B between rounds with
+    no attribution).  Three columns, each repeated ``repeats`` times:
+
+    * ``jit``: one batched ``prefill`` call at [n_reqs, prompt_len] —
+      the compute ceiling, no engine anywhere (r4 and r5 share this
+      code, so if THIS column moved, the delta is the chip/tunnel, not
+      the admission path);
+    * ``engine_dense``: the engine's ``_admit`` wave (group dedup,
+      shape bucketing, host bookkeeping, one completion fetch) with
+      max_new=1 so every row finishes at admission and the wave repeats
+      on a drained engine — the r4-equivalent admission path;
+    * ``engine_paged_chunked``: the identical wave admitted through the
+      paged fill queue in ``prefill_chunk_tokens`` chunks — the round-5
+      addition, now issuing a wave's chunks back-to-back with no host
+      round-trip between them when nothing is decoding.
+
+    Per-repeat values are reported, not just a mean: under the axon
+    tunnel a single wave can swing >1.5x run-to-run, and the jit column
+    swings with it — ``spread`` vs the column DELTAS is what separates
+    tunnel variance from a real admission-path regression."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.engine.batching import bucket_len
+    from areal_tpu.engine.inference_server import ContinuousBatchingEngine
+    from areal_tpu.models.transformer import KVCache, prefill
+
+    B, P = n_reqs, prompt_len
+    T = bucket_len(P)
+    rng = np.random.default_rng(13)
+    toks = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    )
+    lens = jnp.full((B,), P, jnp.int32)
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (B, 1))
+    seg = (positions < lens[:, None]).astype(jnp.int32)
+
+    @jax.jit
+    def jit_prefill(p, toks, positions, seg, lens):
+        cache = KVCache.zeros(cfg, B, T, dtype=jnp.bfloat16)
+        logits, _ = prefill(
+            p, cfg, toks, positions, seg, cache,
+            last_pos=jnp.maximum(lens - 1, 0),
+        )
+        return jnp.sum(logits)  # scalar fetch forces the whole call
+
+    def time_jit():
+        t0 = time.perf_counter()
+        float(jit_prefill(params, toks, positions, seg, lens))
+        return B * P / (time.perf_counter() - t0)
+
+    float(jit_prefill(params, toks, positions, seg, lens))  # compile
+    jit_rates = [round(time_jit(), 1) for _ in range(repeats)]
+
+    def engine_rates(mode):
+        kw = dict(cache_mode=mode)
+        if mode == "paged":
+            kw.update(page_size=1024, prefill_chunk_tokens=1024)
+        eng = ContinuousBatchingEngine(
+            cfg, params, max_batch=B,
+            kv_cache_len=bench_gen_cache_len(P, 4), chunk_size=128, **kw
+        )
+
+        def wave(tag):
+            # max_new=1: rows sample their first token and finish AT
+            # admission, so the wave repeats on a fully drained engine
+            submit_wave(eng, cfg, B, P, 1, tag)
+            t0 = time.perf_counter()
+            while eng.has_work:
+                eng.step()
+            dt = time.perf_counter() - t0
+            eng.drain_results()
+            return B * P / dt
+
+        wave(f"w{mode}")  # compile this mode's admission path
+        rates = [round(wave(f"t{mode}{i}"), 1) for i in range(repeats)]
+        del eng
+        return rates
+
+    dense_rates = engine_rates("dense")
+    paged_rates = engine_rates("paged")
+    return {
+        "batch": B,
+        "prompt_len": P,
+        "jit_toks_per_sec": jit_rates,
+        "engine_dense_toks_per_sec": dense_rates,
+        "engine_paged_chunked_toks_per_sec": paged_rates,
+        "best": {
+            "jit": max(jit_rates),
+            "engine_dense": max(dense_rates),
+            "engine_paged_chunked": max(paged_rates),
+        },
+        "engine_dense_over_jit": round(
+            max(dense_rates) / max(max(jit_rates), 1e-9), 3
+        ),
+    }
+
+
 def bench_interruption(cfg, params, n_reqs=32, prompt_len=256):
     """Interruptible vs drain-before-update weight swaps under a
     heterogeneous-length workload (the reference ablates this mechanism at
@@ -308,10 +530,7 @@ def bench_interruption(cfg, params, n_reqs=32, prompt_len=256):
                     # non-interruptible: hold admissions and wait for every
                     # in-flight row (the long tail stalls the swap)
                     eng.hold_admissions = True
-                    while (
-                        eng.n_inflight > 0
-                        or eng._pending_chunk is not None
-                    ):
+                    while eng.n_inflight > 0 or eng.inflight_chunks > 0:
                         n_tok += eng.step()
                 tu = time.perf_counter()
                 eng.update_weights(params, version=updates_done + 1)
@@ -539,6 +758,7 @@ def bench_decode_ab(cfg15, params15, cases=None, page=1024, chunk=64,
             raise
 
     rows = {}
+    measured = {}
     for L, B in (cases or ((2048, 16), (8192, 16), (16384, 16), (32768, 8))):
         d = safe(run_dense, L, B)
         p = safe(run_paged, L, B)
@@ -556,6 +776,15 @@ def bench_decode_ab(cfg15, params15, cases=None, page=1024, chunk=64,
             "deep_over_dense": round(pd / d, 3) if (pd and d) else None,
         }
         rows[f"ctx{L}_b{B}"] = row
+        measured[L] = {"dense": d, "paged": p, "deep": pd}
+    # turn the 3-column A/B into the thresholds cache_mode="auto" should
+    # dispatch on; recipe configs pin these once a hardware round fills
+    # them in (GenServerConfig.paged_min_cache_len / deep_kernel_min_context)
+    from areal_tpu.engine.dispatch import derive_dispatch_table
+
+    rows["derived_dispatch_table"] = derive_dispatch_table(
+        measured
+    ).as_dict()
     if capacity_case:
         # CAPACITY: the recipe regime — kv_cache_len 32768 (31k max gen
         # len), 16 concurrent rows actually holding 16k tokens.  Dense
@@ -857,9 +1086,10 @@ def main():
             remat=True,
         )
         seq_len, n_seqs, timed_steps = 2048, 16, 3
-        gen_batches = (32,)  # b64 dropped: wall budget went to the
-        # recipe-regime rows (8k effective + decode A/B); b32 + the 1.5B
-        # row keep decode coverage
+        # b64 is back (dropped in r6 for wall budget): the round-7
+        # acceptance bar is engine decode >= 0.9x the isolated jit loop
+        # AT B=64, so both batches report engine_over_jit
+        gen_batches = (32, 64)
     else:
         cfg = TransformerConfig(
             n_layers=4,
@@ -951,14 +1181,26 @@ def main():
         }
 
     # generation throughput at 0.5B, batch sweep (tiny shapes off-TPU:
-    # a CPU smoke run needs signal, not 512-token decode waves)
+    # a CPU smoke run needs signal, not 512-token decode waves).  The
+    # b32 row carries the pipeline-depth A/B (K=1 unpipelined baseline /
+    # K=2 default / K=4 deep queue for the tunnel's RTT regime).
     mark("gen 0.5B")
     gen = {}
     gen_shape = {} if on_tpu else {"prompt_len": 32, "max_new": 16}
     for B in gen_batches:
         gen[f"b{B}"] = bench_generation(
-            cfg, gen_params, n_reqs=B, **gen_shape
+            cfg, gen_params, n_reqs=B,
+            ring_ab=(1, 2, 4) if (on_tpu and B == 32) else (),
+            jit_ratio=on_tpu,
+            **gen_shape,
         )
+
+    # admission-prefill A/B: jit ceiling vs dense-engine admit vs paged
+    # chunked admit (roots the r5 prefill regression — VERDICT #2)
+    mark("prefill A/B")
+    prefill_ab = (
+        _section(bench_prefill_ab, cfg, gen_params) if on_tpu else None
+    )
 
     # interruption A/B + update-visibility latency
     mark("interruption")
@@ -1121,6 +1363,50 @@ def main():
     )
     mark("done")
 
+    # compact machine-parseable summary: the round's DIFFABLE numbers
+    # (decode split + ring A/B, prefill A/B, the paged 3-column table and
+    # the dispatch thresholds it derives) duplicated out of `detail` so
+    # the capture harness's `parsed` field carries them even when the
+    # full detail blob is huge or the tail is truncated
+    def _gen_summary(g):
+        if not isinstance(g, dict):
+            return None
+        return {
+            "prefill_toks_per_sec": g.get("prefill_toks_per_sec"),
+            "decode_toks_per_sec": g.get("decode_toks_per_sec"),
+            "engine_over_jit": g.get("engine_over_jit"),
+            "decode_split": g.get("decode_split"),
+        }
+
+    summary = {
+        "pipeline_depth": 2,
+        "decode": {
+            k: _gen_summary(v) for k, v in gen.items()
+        },
+        "ring_ab": (gen.get("b32") or {}).get("ring_ab")
+        if isinstance(gen.get("b32"), dict)
+        else None,
+        "prefill_ab": prefill_ab,
+        "paged_decode_ab": (
+            {
+                k: [
+                    row.get("dense_toks_per_sec"),
+                    row.get("paged_toks_per_sec"),
+                    row.get("paged_deep_toks_per_sec"),
+                ]
+                for k, row in decode_ab.items()
+                if isinstance(row, dict) and k.startswith("ctx")
+            }
+            if isinstance(decode_ab, dict)
+            else None
+        ),
+        "dispatch_table": (
+            decode_ab.get("derived_dispatch_table")
+            if isinstance(decode_ab, dict)
+            else None
+        ),
+    }
+
     print(
         json.dumps(
             {
@@ -1130,6 +1416,7 @@ def main():
                 "vs_baseline": round(
                     ours_per_tflop / REF_TOK_PER_SEC_PER_TFLOP, 4
                 ),
+                "summary": summary,
                 "detail": {
                     "device": getattr(dev, "device_kind", dev.platform),
                     "baseline_derivation": {
@@ -1165,6 +1452,7 @@ def main():
                     "generation_0p5b": gen,
                     "generation_qwen25_1p5b_arch": gen_15b,
                     "decode_paged_vs_dense_1p5b": decode_ab,
+                    "prefill_ab": prefill_ab,
                     "chunked_prefill": chunked_prefill,
                     "interruption": interruption,
                     "prefix_reuse": prefix_reuse,

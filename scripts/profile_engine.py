@@ -52,19 +52,19 @@ def main():
         n_steps = 0
         # monkeypatch instrumentation
         orig_dispatch = eng._dispatch_chunk
-        orig_harvest = eng._harvest
+        orig_harvest = eng._harvest_oldest
         orig_admit = eng._admit
 
-        def dispatch(extra_len):
+        def dispatch():
             nonlocal t_dispatch
             t0 = time.perf_counter()
-            orig_dispatch(extra_len)
+            orig_dispatch()
             t_dispatch += time.perf_counter() - t0
 
-        def harvest(p):
+        def harvest():
             nonlocal t_harvest
             t0 = time.perf_counter()
-            n = orig_harvest(p)
+            n = orig_harvest()
             t_harvest += time.perf_counter() - t0
             return n
 
@@ -75,7 +75,7 @@ def main():
             t_admit += time.perf_counter() - t0
 
         eng._dispatch_chunk = dispatch
-        eng._harvest = harvest
+        eng._harvest_oldest = harvest
         eng._admit = admit
 
         t0 = time.perf_counter()

@@ -326,7 +326,7 @@ def test_resume_race_with_pipelined_harvest(mode):
         if out_a is not None:
             break
     assert out_a is not None and out_a.no_eos
-    assert eng._pending_chunk is not None  # the stale-snapshot chunk
+    assert eng.inflight_chunks > 0  # the stale-snapshot chunk(s)
 
     # resume A immediately — before the stale chunk is harvested
     cur = prompt_a + list(out_a.output_ids)

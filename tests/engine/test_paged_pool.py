@@ -73,7 +73,9 @@ def test_group_sharing_uses_fewer_blocks():
     prompt = list(np.arange(33) % 50 + 6)  # 2 full blocks + 1 tail token
     for i in range(4):
         eng.submit(_req(f"g-{i}", prompt, 4))
-    eng.step()  # admit -> all four join ONE fill
+    eng._admit_paged()  # all four join ONE fill (inspect before the
+    # fill advances: with nothing decoding, step() now rips through the
+    # whole wave's chunks back-to-back inside one call)
     assert len(eng._filling) == 1 and len(eng._filling[0].targets) == 4
     run_until_done(eng)
     eng.drain_results()
