@@ -136,6 +136,20 @@ class GenServerConfig:
     page_size: int = 1024
     kv_pool_tokens: Optional[int] = None
     prefill_chunk_tokens: int = 1024
+    # cross-request radix prefix cache over the paged pool (default on
+    # for paged mode; engine/prefix_cache.py): finished/parked sequences'
+    # blocks stay indexed by token prefix so multi-turn continuations,
+    # retries, and late group members prefill only their new suffix.
+    # capacity_frac bounds the pool fraction the cache may hold
+    # references to; min_match_tokens suppresses matches too short to
+    # pay for their pin + tail copy — a tail match costs a full
+    # page_size-block COW device copy, so reusing a handful of tokens
+    # (every prompt shares a BOS/template head) costs more than the
+    # prefill it saves.  64 keeps multi-turn/retry reuse (hundreds+ of
+    # tokens) while rejecting the degenerate matches.
+    prefix_cache: bool = True
+    prefix_cache_capacity_frac: float = 0.5
+    prefix_cache_min_match_tokens: int = 64
     # decode-pipeline depth: max chunks dispatched-but-unharvested (the
     # engine's in-flight ring).  2 overlaps each chunk's output fetch
     # with the next chunk's device time; raise it when the fetch RTT
@@ -173,6 +187,20 @@ class GserverManagerConfig:
     group_size: int = 1  # sequences per rollout (staleness unit conversion)
     max_concurrent_rollouts: Optional[int] = None
     flush_request_timeout: float = 120.0
+    # cache-aware routing: a session's turns follow the server whose
+    # prefix cache is hottest for it (longest prefix served so far),
+    # UNLESS that server's estimated resident tokens exceed the least-
+    # loaded server's by more than imbalance_factor x + slack — then the
+    # affinity breaks (the new server re-prefills; latency beats a hot
+    # cache on an overloaded box).  False = the pre-cache behavior
+    # (unconditional group affinity + the configured schedule_policy).
+    cache_aware_routing: bool = True
+    affinity_imbalance_factor: float = 1.5
+    affinity_imbalance_slack_tokens: float = 4096.0
+    # per-server update_weights retries before the round is declared
+    # failed (one flaky server must not block the fleet's version bump)
+    update_weights_retries: int = 3
+    update_weights_retry_backoff_s: float = 0.5
 
 
 @dataclasses.dataclass

@@ -61,6 +61,7 @@ from areal_tpu.engine.dispatch import (
     DEFAULT_PAGED_MIN_CACHE_LEN,
     PagedDispatchTable,
 )
+from areal_tpu.engine.prefix_cache import PrefixMatch, RadixPrefixCache
 from areal_tpu.engine.sampling import SamplingParams, sample_logits
 from areal_tpu.models import paged
 from areal_tpu.models.config import TransformerConfig
@@ -315,6 +316,9 @@ class ContinuousBatchingEngine:
         prefill_chunk_tokens: int = 1024,
         pipeline_depth: int = 2,
         dispatch_table: Optional[PagedDispatchTable] = None,
+        prefix_cache: bool = True,
+        prefix_cache_capacity_frac: float = 0.5,
+        prefix_cache_min_tokens: int = 1,
     ):
         """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
         shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
@@ -344,6 +348,19 @@ class ContinuousBatchingEngine:
         dense cache could never reserve).  ``prefill_chunk_tokens`` bounds
         the prompt tokens prefetched per engine step — the decode stall
         during a long-prompt admission is one chunk, not the whole wave.
+
+        ``prefix_cache`` (paged mode only; default on) keeps a radix index
+        over finished/parked sequences' blocks so ANY new request — a
+        multi-turn continuation under a fresh qid, a retried request, a
+        group member landing late — pins the longest cached prefix and
+        prefills only its suffix (the cross-request radix-cache role of
+        the reference's SGLang server).  ``prefix_cache_capacity_frac``
+        bounds the pool fraction the cache may hold references to;
+        ``prefix_cache_min_tokens`` suppresses matches too short to pay
+        for their pin + tail copy.  Cache eviction yields to live rows
+        (it is the first reclamation tier, before parked-row eviction and
+        preemption) and the whole cache flushes on ``update_weights`` —
+        KV computed under old weights is never reused after a swap.
         """
         self.cfg = cfg
         self.device = device
@@ -352,6 +369,10 @@ class ContinuousBatchingEngine:
         assert pipeline_depth >= 1, pipeline_depth
         self.pipeline_depth = pipeline_depth
         self.dispatch_table = dispatch_table or PagedDispatchTable()
+        self._prefix_cache: Optional[RadixPrefixCache] = None
+        self._prefix_cache_enabled = bool(prefix_cache)
+        self._prefix_cache_capacity_frac = prefix_cache_capacity_frac
+        self._prefix_cache_min_tokens = prefix_cache_min_tokens
         self.paged = cache_mode == "paged" or (
             cache_mode == "auto"
             and kv_cache_len >= self.dispatch_table.paged_min_cache_len
@@ -505,6 +526,20 @@ class ContinuousBatchingEngine:
         self._filling: List[_Fill] = []
         self._preempted: List[_Row] = []
         self.preempted_total = 0
+        # cross-request radix prefix cache: trie nodes hold refcounted
+        # pool blocks (the cache speaks to the allocator only through
+        # incref/decref, so its evictions can never recycle a block a
+        # live row still pins)
+        if self._prefix_cache_enabled:
+            self._prefix_cache = RadixPrefixCache(
+                page_size=BS,
+                capacity_blocks=int(
+                    self._prefix_cache_capacity_frac * self.n_blocks
+                ),
+                acquire=self._incref_blocks,
+                release=self._free_block_list,
+                min_match_tokens=self._prefix_cache_min_tokens,
+            )
         # stable closures: paged_decode_chunk caches its jit on their ids
         sampling_ref = self.sampling
         stop_ref = self.stop_tokens
@@ -557,6 +592,95 @@ class ContinuousBatchingEngine:
     @property
     def free_pool_blocks(self) -> int:
         return len(self._free_blocks)
+
+    def _alloc_blocks_reclaiming(
+        self, n: int, keep_qids=()
+    ) -> Optional[List[int]]:
+        """``_alloc_blocks`` with tiered reclamation: prefix-cache entries
+        first (pure recompute insurance — the cache always yields to live
+        rows), then parked rows.  Returns None only when both tiers are
+        exhausted (the caller may then preempt or requeue)."""
+        blocks = self._alloc_blocks(n)
+        while blocks is None:
+            deficit = n - len(self._free_blocks)
+            if self._prefix_cache is not None and self._prefix_cache.evict(
+                deficit
+            ):
+                pass
+            elif self._evict_parked(keep_qids=keep_qids) is not None:
+                pass
+            else:
+                return None
+            blocks = self._alloc_blocks(n)
+        return blocks
+
+    # -- cross-request prefix cache ----------------------------------------
+
+    def _cache_insert(self, seq: List[int], blocks: List[int]):
+        """Register ``seq``'s KV-bearing blocks in the radix cache (full
+        blocks by reference, the partial tail by value)."""
+        if self._prefix_cache is None or not seq or not blocks:
+            return
+        self._prefix_cache.insert(
+            seq, blocks, step=self._step_seq, version=self.version
+        )
+
+    def _match_prefix(self, seq: List[int]) -> PrefixMatch:
+        # record=False: a requeued admission re-matches every engine step
+        # until the pool can serve it — hit/cached-token stats are counted
+        # in _new_fill, once, when the fill is actually built
+        if self._prefix_cache is None or len(seq) < 2:
+            return PrefixMatch()
+        return self._prefix_cache.match(
+            seq, step=self._step_seq, record=False
+        )
+
+    def _new_fill(self, seq: List[int], keep_qids=()) -> Optional[_Fill]:
+        """Build a ``_Fill`` for ``seq``, reusing the longest cached
+        prefix: matched full blocks are PINNED (shared by reference), a
+        matched partial tail is copied into an owned block (copy-on-write
+        — the donor row may still be appending to it), and ``fill_pos``
+        starts past the reused prefix so only the suffix is prefilled.
+        Returns None when the pool cannot provide the non-cached blocks
+        even after reclamation (caller requeues)."""
+        n_blocks = max(1, -(-len(seq) // self.page_size))
+        m = self._match_prefix(seq)
+        # pin everything the match returned BEFORE allocating: the
+        # allocation may evict cache entries, and an unpinned matched
+        # block could be recycled into our own allocation
+        pinned = list(m.blocks)
+        if m.tail_block is not None:
+            pinned.append(m.tail_block)
+        self._incref_blocks(pinned)
+        own_needed = n_blocks - len(m.blocks)
+        blocks = self._alloc_blocks_reclaiming(own_needed, keep_qids=keep_qids)
+        if blocks is None:
+            self._free_block_list(pinned)
+            return None
+        if self._prefix_cache is not None and len(seq) >= 2:
+            self._prefix_cache.record(m)
+        if m.tail_block is not None:
+            # COW: the partial tail's first tail_tokens are valid; copy
+            # the whole block (append-only writes beyond that point are
+            # the donor's garbage and our suffix fill overwrites them)
+            src = np.array([m.tail_block], np.int32)
+            dst = np.array([blocks[0]], np.int32)
+            self.k_pool, self.v_pool = paged.copy_blocks(
+                self.k_pool, self.v_pool, jnp.asarray(src), jnp.asarray(dst)
+            )
+            self._free_block_list([m.tail_block])  # copy taken: unpin
+        return _Fill(
+            key=tuple(seq),
+            tokens=list(seq),
+            blocks=list(m.blocks) + blocks,
+            targets=[],
+            fill_pos=m.n_tokens,
+        )
+
+    def prefix_cache_stats(self) -> Dict[str, int]:
+        if self._prefix_cache is None:
+            return RadixPrefixCache.zero_stats()
+        return self._prefix_cache.stats()
 
     # -- client API (any thread) -------------------------------------------
 
@@ -693,8 +817,17 @@ class ContinuousBatchingEngine:
         # the next decode_step writes its KV; re-prefill the rest, in ONE
         # batched call for all in-flight rows.
         if self.paged:
+            # the radix cache holds KV computed under the OLD weights:
+            # reusing any of it after the swap would silently mix weight
+            # versions in attention.  Flush drops every cached reference
+            # and version-tags the cache so a racing insert of pre-swap
+            # KV is rejected.
+            if self._prefix_cache is not None:
+                self._prefix_cache.flush(new_version=self.version)
             # chunk-filling rows hold KV computed under the OLD weights:
-            # restart their fills from scratch (their rows/blocks stay)
+            # restart their fills from scratch (their rows/blocks stay;
+            # a cache-matched fill_pos also resets — its prefix blocks
+            # are rewritten under the new weights like any others)
             for f in self._filling:
                 f.fill_pos = 0
             entries = [
@@ -943,6 +1076,9 @@ class ContinuousBatchingEngine:
             plen = len(f.tokens)
             n_full = plen // self.page_size
             has_tail = plen % self.page_size != 0
+            # the completed prompt's KV enters the radix cache NOW (a
+            # retried or sibling request arriving next step already hits)
+            self._cache_insert(f.tokens, f.blocks)
             for t_i, tgt in enumerate(f.targets):
                 if t_i == 0:
                     self._set_row_blocks(tgt.row_id, list(f.blocks))
@@ -953,7 +1089,12 @@ class ContinuousBatchingEngine:
                     if has_tail:
                         tail = self._alloc_blocks(1)
                         while tail is None:
-                            if self._evict_parked() is None:
+                            if (
+                                self._prefix_cache is not None
+                                and self._prefix_cache.evict_one()
+                            ):
+                                pass
+                            elif self._evict_parked() is None:
                                 victim = self._pick_preemption_victim(
                                     exclude=-1
                                 )
@@ -1060,37 +1201,32 @@ class ContinuousBatchingEngine:
             return evicted
 
         # preempted rows first (their pool reservation was stolen mid-
-        # decode; FIFO so none starves)
+        # decode; FIFO so none starves).  The re-prefill walks the radix
+        # cache like any admission — a preempted row whose prefix is
+        # still cached recomputes only the un-cached suffix.
         while self._preempted:
             row = self._preempted[0]
             seq = (row.prompt + row.generated)[:-1]
-            n_blocks = max(1, -(-len(seq) // self.page_size))
             rid = take_row()
             if rid is None:
                 break
-            blocks = self._alloc_blocks(n_blocks)
-            if blocks is None and self._evict_parked() is not None:
-                blocks = self._alloc_blocks(n_blocks)
-            if blocks is None:
+            with self._lock:
+                queued = {r.qid for r in self._pending}
+            fill = self._new_fill(seq, keep_qids=queued)
+            if fill is None:
                 free.insert(0, rid)
                 break
             self._preempted.pop(0)
-            self._set_row_blocks(rid, blocks)
+            self._set_row_blocks(rid, fill.blocks)
             row.filling = True
             self.rows[rid] = row
-            self._filling.append(
-                _Fill(
-                    key=tuple(seq),
-                    tokens=list(seq),
-                    blocks=blocks,
-                    targets=[
-                        _FillTarget(
-                            row_id=rid, req=row.req,
-                            max_new=row.budget_left, resume=row,
-                        )
-                    ],
+            fill.targets.append(
+                _FillTarget(
+                    row_id=rid, req=row.req,
+                    max_new=row.budget_left, resume=row,
                 )
             )
+            self._filling.append(fill)
         while True:
             with self._lock:
                 if not self._pending:
@@ -1119,20 +1255,23 @@ class ContinuousBatchingEngine:
                     self._pending.insert(0, req)
                 break
             if fill is None:
-                n_blocks = -(-len(prompt) // self.page_size)
-                blocks = self._alloc_blocks(n_blocks)
-                if blocks is None and self._evict_parked() is not None:
-                    blocks = self._alloc_blocks(n_blocks)
-                if blocks is None:
+                # radix walk first: a cached prefix (an earlier turn of
+                # this conversation, a retried request, a sibling's
+                # prompt) is pinned and skipped; only the suffix enters
+                # the fill queue.  Reclamation spares parked rows whose
+                # own continuation is still queued behind this request
+                # (evicting one trades this alloc for that row's full
+                # re-prefill — the dense path's guard, same reason)
+                with self._lock:
+                    queued = {r.qid for r in self._pending}
+                fill = self._new_fill(prompt, keep_qids=queued)
+                if fill is None:
                     free.insert(0, rid)
                     with self._lock:
                         self._pending.insert(0, req)
                     break
-                fill = _Fill(
-                    key=key, tokens=prompt, blocks=blocks, targets=[]
-                )
                 self._filling.append(fill)
-                self._set_row_blocks(rid, blocks)
+                self._set_row_blocks(rid, fill.blocks)
                 # canonical blocks live in target 0's table; refcount
                 # stays 1 until extra targets share them
             else:
@@ -1180,6 +1319,14 @@ class ContinuousBatchingEngine:
                         row_id, self._row_blocks[row_id] + blocks
                     )
                     break
+                # reclamation tiers: prefix-cache entries (recompute
+                # insurance only — always yield to a live row), then
+                # parked rows, then preemption
+                if (
+                    self._prefix_cache is not None
+                    and self._prefix_cache.evict_one()
+                ):
+                    continue
                 if self._evict_parked() is not None:
                     continue
                 victim = self._pick_preemption_victim(exclude=row_id)
@@ -1406,6 +1553,16 @@ class ContinuousBatchingEngine:
         out.version_start = row.version_start
         out.version_end = self.version
         self.gen_tokens_total += len(row.generated)
+        if started and self.paged and row_id >= 0:
+            # cached KV covers prompt + generated[:-1] (the final token is
+            # the pending cur; its KV was never written).  Inserting on
+            # BOTH park and release is what makes the next turn of a
+            # multi-turn conversation — arriving under a fresh qid, on
+            # any schedule — prefill only its new suffix.
+            self._cache_insert(
+                (row.prompt + row.generated)[:-1],
+                self._row_blocks[row_id],
+            )
         if started and park:
             # keep KV resident; the last generated token is the pending
             # cur_token (its KV was never written — see decode_chunk)

@@ -1,0 +1,456 @@
+"""Cross-request radix prefix cache over the paged KV pool.
+
+The reference's decoupled rollout cluster leans on SGLang's radix cache to
+make multi-turn agent loops affordable: every turn re-sends the whole
+growing conversation and the server recomputes only the new suffix
+(reference: realhf/system/partial_rollout.py + SGLang's RadixCache /
+cache-aware load balancing).  Our engine reproduced that role only in two
+narrow slices — same-qid continuation parking and group-prompt block
+sharing.  This module is the general mechanism: a radix/trie index over
+TOKEN-ID prefixes whose nodes hold refcounted blocks in the engine's
+existing paged pool (areal_tpu/models/paged.py), so any new request first
+walks the tree, pins the longest matched prefix's blocks, and enters the
+fill queue needing only the suffix prefilled.
+
+Design constraints, in order:
+
+* **Blocks are the unit of sharing.**  A trie node covers exactly one
+  FULL pool block (``page_size`` tokens), keyed by that block's token
+  tuple.  Full blocks are append-frozen — once a row has written past a
+  block it never writes into it again — so sharing them by reference is
+  safe while the donor row keeps decoding.  The one mutable block per
+  row (its tail) is shared only by VALUE: a node may carry a *partial
+  tail entry* (block id + the token prefix it holds), and a match on it
+  returns a copy-on-write instruction — the engine copies the block
+  (``paged.copy_blocks``) and owns the copy.  KV values depend only on
+  (token prefix, weights), so mixing blocks cached by different donor
+  rows along one trie path is exact, not approximate.
+* **The cache owns references, never blocks.**  It speaks to the
+  engine's allocator through two callbacks (``acquire``/``release`` =
+  the engine's ``_incref_blocks``/``_free_block_list``); eviction only
+  drops the cache's OWN reference, so a prefix pinned by a live row can
+  never be yanked from under it — the pool recycles a block only when
+  every holder is gone.
+* **Deterministic under SPMD lockstep.**  Multi-host serving replays one
+  command stream on every controller; all cache decisions (LRU order,
+  eviction victims, capacity trims) key on the engine's step counter and
+  a monotone node sequence — never wall time.
+* **Weight swaps invalidate.**  Cached KV is only valid under the
+  weights that computed it; ``flush()`` (called by the engine before a
+  swap's re-prefill) drops every entry and bumps ``version`` so a
+  concurrent insert of pre-swap KV is rejected.  Stale-KV reuse across
+  a swap would be a silent correctness bug — the engine's test suite
+  pins this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Result of a longest-prefix walk.
+
+    ``blocks`` are the matched FULL blocks, in sequence order — the
+    caller must pin them (its own incref) before using them.  When
+    ``tail_block`` is set, the node also held a partial tail whose first
+    ``tail_tokens`` tokens extend the match; the caller must COPY that
+    block into one it owns (copy-on-write) — the donor may still be
+    appending to it.  ``n_tokens`` is the total matched prefix length
+    (``len(blocks) * page_size + tail_tokens``)."""
+
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    n_tokens: int = 0
+    tail_block: Optional[int] = None
+    tail_tokens: int = 0
+
+
+@dataclasses.dataclass
+class _TailEntry:
+    """A partially-filled block cached by value: ``tokens`` are the block's
+    valid prefix; a longer donor with the same first token replaces it."""
+
+    block: int
+    tokens: Tuple[int, ...]
+    last_use: int = 0
+    seq: int = 0
+
+
+#: max cached partial tails per node, keyed by the tail's FIRST token.  One
+#: slot per node would let concurrent sessions shorter than ``page_size``
+#: thrash each other out (every sub-page conversation is all-tail at the
+#: root); a small per-first-token set keeps several live sessions hot while
+#: bounding the per-node candidate scan.
+TAILS_PER_NODE = 4
+
+
+class _Node:
+    """One full block of one cached sequence.  ``key`` is the block's
+    ``page_size``-token tuple; children extend the prefix by one block."""
+
+    __slots__ = ("key", "block", "children", "parent", "last_use", "seq",
+                 "tails")
+
+    def __init__(self, key, block, parent, last_use, seq):
+        self.key: Tuple[int, ...] = key
+        self.block: int = block
+        self.children: Dict[Tuple[int, ...], _Node] = {}
+        self.parent: Optional[_Node] = parent
+        self.last_use: int = last_use
+        self.seq: int = seq  # insertion order: deterministic LRU tie-break
+        # first token -> cached partial tail (bounded by TAILS_PER_NODE)
+        self.tails: Dict[int, _TailEntry] = {}
+
+
+class RadixPrefixCache:
+    """Block-granularity radix index over cached token prefixes.
+
+    ``capacity_blocks`` caps how many pool blocks the cache may hold
+    references to (the engine derives it from a pool fraction); ``0``
+    disables insertion entirely.  ``min_match_tokens`` suppresses matches
+    shorter than the configured floor — pinning and COW-copying for a
+    handful of cached tokens costs more than it saves.
+    """
+
+    def __init__(
+        self,
+        page_size: int,
+        capacity_blocks: int,
+        acquire: Callable[[List[int]], None],
+        release: Callable[[List[int]], None],
+        min_match_tokens: int = 1,
+    ):
+        assert page_size >= 1
+        self.page_size = page_size
+        self.capacity_blocks = max(0, int(capacity_blocks))
+        self.min_match_tokens = max(1, int(min_match_tokens))
+        self._acquire = acquire
+        self._release = release
+        self._root = _Node(key=(), block=-1, parent=None, last_use=0, seq=0)
+        self._seq = 0
+        self.version = 0
+        self.blocks_held = 0
+        # stats (cumulative; the engine mirrors them into the registry)
+        self.hits_total = 0
+        self.misses_total = 0
+        self.cached_tokens_total = 0
+        self.insertions_total = 0
+        self.evictions_total = 0
+        self.flushes_total = 0
+
+    # -- lookup -------------------------------------------------------------
+
+    def match(
+        self, tokens: Sequence[int], step: int, record: bool = True
+    ) -> PrefixMatch:
+        """Longest cached prefix of ``tokens``, capped at
+        ``len(tokens) - 1`` so at least one suffix token remains to
+        prefill (the engine samples the request's first output from the
+        suffix prefill's final logits).  Touches every node on the path
+        (LRU refresh).  Counts a hit iff the match clears
+        ``min_match_tokens`` — callers that may re-match the same
+        request (a requeued admission retries every engine step) pass
+        ``record=False`` and call :meth:`record` once the match is
+        actually consumed, so stats count served tokens, not attempts."""
+        BS = self.page_size
+        max_match = len(tokens) - 1
+        node = self._root
+        out = PrefixMatch()
+        depth = 0
+        while (depth + 1) * BS <= max_match:
+            key = tuple(tokens[depth * BS : (depth + 1) * BS])
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_use = step
+            out.blocks.append(child.block)
+            node = child
+            depth += 1
+        out.n_tokens = depth * BS
+        # partial extension of the deepest matched node: its cached
+        # partial tail, or the head of a FULL child block (a shorter or
+        # diverging prompt re-using part of a longer cached sequence).
+        # The longest COMMON prefix counts — the caller's copy-on-write
+        # gives it the whole block, and its suffix fill overwrites the
+        # positions past the divergence point.
+        rem = tokens[depth * BS :]
+        limit = max_match - out.n_tokens
+        if limit <= 0 or not rem:
+            if out.n_tokens < self.min_match_tokens:
+                if record:
+                    self.misses_total += 1
+                return PrefixMatch()
+            if record:
+                self.hits_total += 1
+                self.cached_tokens_total += out.n_tokens
+            return out
+        # only candidates sharing the FIRST remaining token can extend the
+        # match — the cheap pre-filter keeps this scan O(#children) single
+        # compares instead of O(#children x page_size) LCP loops (requeued
+        # admissions re-match every engine step, so this is hot under pool
+        # pressure)
+        first = rem[0]
+        cands: List[Tuple[Tuple[int, ...], int, Optional[_Node]]] = []
+        tail = node.tails.get(first)
+        if tail is not None:
+            cands.append((tail.tokens, tail.block, None))
+        for child in node.children.values():
+            if child.key[0] != first:
+                continue
+            cands.append((child.key, child.block, child))
+        best_block, best_lcp, best_node = None, 0, None
+        for t, blk, child in cands:
+            n = min(len(t), limit)
+            lcp = 0
+            while lcp < n and rem[lcp] == t[lcp]:
+                lcp += 1
+            if lcp > best_lcp:  # strict: first-best wins ties (the
+                best_block, best_lcp, best_node = blk, lcp, child
+                # candidate order is insertion order — deterministic
+                # under SPMD lockstep replay)
+        if best_lcp > 0:
+            out.tail_block = best_block
+            out.tail_tokens = best_lcp
+            out.n_tokens += best_lcp
+            if best_node is not None:
+                best_node.last_use = step
+            else:
+                tail.last_use = step
+                node.last_use = step
+        if out.n_tokens < self.min_match_tokens:
+            if record:
+                self.misses_total += 1
+            return PrefixMatch()
+        if record:
+            self.hits_total += 1
+            self.cached_tokens_total += out.n_tokens
+        return out
+
+    def record(self, m: PrefixMatch):
+        """Count a match returned by ``match(..., record=False)`` that
+        the caller actually consumed (its fill was built)."""
+        if m.n_tokens > 0:
+            self.hits_total += 1
+            self.cached_tokens_total += m.n_tokens
+        else:
+            self.misses_total += 1
+
+    # -- insertion ----------------------------------------------------------
+
+    def insert(
+        self,
+        tokens: Sequence[int],
+        blocks: Sequence[int],
+        step: int,
+        version: int,
+    ) -> int:
+        """Register a sequence's KV: ``blocks[i]`` holds tokens
+        ``[i*page_size, (i+1)*page_size)``; a trailing partial block (if
+        ``len(tokens)`` is not page-aligned) is cached as a tail entry.
+        Where a path node already exists the existing block is kept (its
+        KV is identical by construction) and only new segments acquire
+        references.  Returns the number of blocks newly referenced.
+        Inserts from a stale ``version`` (a swap raced the caller) are
+        dropped."""
+        if self.capacity_blocks <= 0 or version != self.version:
+            return 0
+        BS = self.page_size
+        n_full = len(tokens) // BS
+        tail_len = len(tokens) - n_full * BS
+        if n_full + (1 if tail_len else 0) > len(blocks):
+            n_full = min(n_full, len(blocks))
+            tail_len = 0
+        node = self._root
+        added = 0
+        for i in range(n_full):
+            key = tuple(tokens[i * BS : (i + 1) * BS])
+            # a tail cached while this block was still partial is
+            # SUBSUMED once the same prefix arrives full: drop it, or
+            # blocks_held double-counts the physical block (early
+            # capacity trims, overreported residency) and the dead
+            # entry squats in a tail slot it can never win from
+            stale = node.tails.get(key[0])
+            if stale is not None and key[: len(stale.tokens)] == stale.tokens:
+                self._release([stale.block])
+                del node.tails[key[0]]
+                self.blocks_held -= 1
+            child = node.children.get(key)
+            if child is None:
+                self._seq += 1
+                child = _Node(
+                    key=key, block=int(blocks[i]), parent=node,
+                    last_use=step, seq=self._seq,
+                )
+                self._acquire([child.block])
+                self.blocks_held += 1
+                added += 1
+                node.children[key] = child
+            else:
+                child.last_use = step
+            node = child
+        if tail_len:
+            t = tuple(tokens[n_full * BS :])
+            first = t[0]
+            cur = node.tails.get(first)
+            if cur is None or len(cur.tokens) < len(t):
+                # longer donors replace shorter SAME-FIRST-TOKEN tails
+                # (a same-length one is identical by construction: same
+                # tokens -> same KV); different first tokens coexist up
+                # to TAILS_PER_NODE so concurrent sub-page sessions
+                # don't thrash one slot
+                self._seq += 1
+                self._acquire([int(blocks[n_full])])
+                self.blocks_held += 1
+                added += 1
+                if cur is not None:
+                    self._release([cur.block])
+                    self.blocks_held -= 1
+                node.tails[first] = _TailEntry(
+                    block=int(blocks[n_full]), tokens=t,
+                    last_use=step, seq=self._seq,
+                )
+                if len(node.tails) > TAILS_PER_NODE:
+                    # deterministic LRU drop among the OTHER tails
+                    k = min(
+                        (f for f in node.tails if f != first),
+                        key=lambda f: (
+                            node.tails[f].last_use, node.tails[f].seq
+                        ),
+                    )
+                    self._release([node.tails.pop(k).block])
+                    self.blocks_held -= 1
+                    self.evictions_total += 1
+            else:
+                cur.last_use = step
+            node.last_use = step
+        if added:
+            self.insertions_total += 1
+        # capacity trim: never evict what this very call touched
+        if self.blocks_held > self.capacity_blocks:
+            self.evict(
+                self.blocks_held - self.capacity_blocks, protect_step=step
+            )
+        return added
+
+    # -- eviction -----------------------------------------------------------
+
+    def _evictable(self, protect_step: Optional[int]) -> List[_Node]:
+        """Every currently-evictable node, sorted LRU-first by
+        (last_use, seq): a LEAF (no children), or any node carrying tail
+        entries — evicting an interior node would orphan its children's
+        prefix.  A node with tails is one candidate per round (each
+        selection drops its LRU tail)."""
+        out: List[_Node] = []
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n is self._root and not n.tails:
+                continue
+            if not ((not n.children) or n.tails):
+                continue
+            if protect_step is not None and n.last_use >= protect_step:
+                continue
+            out.append(n)
+        out.sort(key=lambda n: (n.last_use, n.seq))
+        return out
+
+    def _evict_node(self, victim: _Node):
+        """Drop ONE unit from ``victim``: its LRU tail entry if any, else
+        the (leaf) node itself."""
+        if victim.tails:
+            k = min(
+                victim.tails,
+                key=lambda f: (
+                    victim.tails[f].last_use, victim.tails[f].seq
+                ),
+            )
+            self._release([victim.tails.pop(k).block])
+        else:
+            self._release([victim.block])
+            if victim.parent is not None:
+                del victim.parent.children[victim.key]
+        self.blocks_held -= 1
+        self.evictions_total += 1
+
+    def evict(self, n_blocks: int, protect_step: Optional[int] = None) -> int:
+        """Drop up to ``n_blocks`` cached units LRU-first, releasing the
+        cache's references; returns how many were freed (0 = nothing
+        evictable).  ONE trie walk serves a whole reclamation round —
+        the per-victim-DFS cost of repeated single evictions was
+        O(evicted x trie) on the admission hot path.  A round's
+        evictions can make parents newly evictable, so the walk repeats
+        only while short AND progressing.  Only the cache's own
+        reference is ever dropped: blocks pinned by live rows stay
+        resident in the pool until those rows finish — evicting a
+        pinned prefix cannot corrupt it."""
+        freed = 0
+        while freed < n_blocks:
+            cands = self._evictable(protect_step)
+            if not cands:
+                break
+            for victim in cands[: n_blocks - freed]:
+                self._evict_node(victim)
+                freed += 1
+        return freed
+
+    def evict_one(self, protect_step: Optional[int] = None) -> bool:
+        """Drop the single LRU cached unit; False when nothing is
+        evictable."""
+        return self.evict(1, protect_step=protect_step) == 1
+
+    def flush(self, new_version: Optional[int] = None):
+        """Drop every entry (weight swap: all cached KV is stale) and move
+        ``version`` (to ``new_version``, else +1) so inserts tagged with
+        the pre-swap version are rejected."""
+        blocks: List[int] = []
+        stack = list(self._root.children.values())
+        blocks.extend(t.block for t in self._root.tails.values())
+        self._root.tails.clear()
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            blocks.append(n.block)
+            blocks.extend(t.block for t in n.tails.values())
+        if blocks:
+            self._release(blocks)
+        self._root.children.clear()
+        self.blocks_held = 0
+        self.version = (
+            self.version + 1 if new_version is None else int(new_version)
+        )
+        self.flushes_total += 1
+
+    # -- introspection ------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self.blocks_held
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "hits_total": self.hits_total,
+            "misses_total": self.misses_total,
+            "cached_tokens_total": self.cached_tokens_total,
+            "insertions_total": self.insertions_total,
+            "evictions_total": self.evictions_total,
+            "flushes_total": self.flushes_total,
+            "blocks_held": self.blocks_held,
+            "version": self.version,
+        }
+
+    @staticmethod
+    def zero_stats() -> Dict[str, int]:
+        """The all-zero stats dict a cache-disabled engine reports (same
+        keys as :meth:`stats`, no throwaway cache instance needed)."""
+        return {
+            "hits_total": 0,
+            "misses_total": 0,
+            "cached_tokens_total": 0,
+            "insertions_total": 0,
+            "evictions_total": 0,
+            "flushes_total": 0,
+            "blocks_held": 0,
+            "version": 0,
+        }

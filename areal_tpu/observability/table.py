@@ -82,6 +82,34 @@ METRIC_TABLE = [
         "(its output fetch fully overlapped by newer chunks' device time)",
     ),
     MetricSpec(
+        "areal_inference_prefix_cache_hits_total",
+        "counter",
+        "Admissions whose prompt matched a cached prefix in the "
+        "cross-request radix cache (suffix-only prefill)",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_cache_misses_total",
+        "counter",
+        "Admissions that found no usable cached prefix",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_cached_tokens_total",
+        "counter",
+        "Prompt tokens served from the radix prefix cache instead of "
+        "being re-prefilled",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_cache_evictions_total",
+        "counter",
+        "Radix-cache entries dropped (LRU capacity trims + pool-pressure "
+        "reclamation yielding blocks to live rows)",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_cache_blocks",
+        "gauge",
+        "Pool blocks currently referenced by the radix prefix cache",
+    ),
+    MetricSpec(
         "areal_inference_inflight_rows",
         "gauge",
         "Rows currently decoding or chunk-filling",
@@ -145,6 +173,12 @@ METRIC_TABLE = [
         "gauge",
         "Estimated resident tokens per generation server",
         ("server",),
+    ),
+    MetricSpec(
+        "areal_gserver_affinity_escapes_total",
+        "counter",
+        "Sessions re-routed away from their prefix-hot server because "
+        "the load-imbalance escape hatch fired",
     ),
     # -- master buffer (system/buffer.py) ------------------------------------
     MetricSpec(

@@ -102,6 +102,9 @@ class GenerationServerWorker(worker_base.Worker):
                 config.paged_min_cache_len,
                 config.deep_kernel_min_context,
             ),
+            prefix_cache=config.prefix_cache,
+            prefix_cache_capacity_frac=config.prefix_cache_capacity_frac,
+            prefix_cache_min_tokens=config.prefix_cache_min_match_tokens,
         )
 
         self._ctx = zmq.Context.instance()
@@ -185,16 +188,30 @@ class GenerationServerWorker(worker_base.Worker):
                 "areal_inference_async_fetches_total"
             ),
             "fetch_ready": reg.counter("areal_inference_fetch_ready_total"),
+            "prefix_hits": reg.counter(
+                "areal_inference_prefix_cache_hits_total"
+            ),
+            "prefix_misses": reg.counter(
+                "areal_inference_prefix_cache_misses_total"
+            ),
+            "prefix_cached_tokens": reg.counter(
+                "areal_inference_prefix_cached_tokens_total"
+            ),
+            "prefix_evictions": reg.counter(
+                "areal_inference_prefix_cache_evictions_total"
+            ),
             "inflight": reg.gauge("areal_inference_inflight_rows"),
             "pending": reg.gauge("areal_inference_pending_requests"),
             "version": reg.gauge("areal_inference_weight_version"),
             "ring_depth": reg.gauge("areal_inference_ring_depth"),
             "inflight_chunks": reg.gauge("areal_inference_inflight_chunks"),
+            "prefix_blocks": reg.gauge("areal_inference_prefix_cache_blocks"),
         }
         self._obs_last: Dict[str, float] = {}
 
     def _export_engine_metrics(self):
         eng = self.engine
+        pstats = eng.prefix_cache_stats()
         totals = {
             "chunks": float(eng.chunks_total),
             "host": eng.time_host_s,
@@ -204,6 +221,10 @@ class GenerationServerWorker(worker_base.Worker):
             "prefill_tokens": float(eng.prefill_tokens_total),
             "async_fetches": float(eng.async_fetches_total),
             "fetch_ready": float(eng.fetch_ready_total),
+            "prefix_hits": float(pstats["hits_total"]),
+            "prefix_misses": float(pstats["misses_total"]),
+            "prefix_cached_tokens": float(pstats["cached_tokens_total"]),
+            "prefix_evictions": float(pstats["evictions_total"]),
         }
         for key, total in totals.items():
             delta = total - self._obs_last.get(key, 0.0)
@@ -215,6 +236,7 @@ class GenerationServerWorker(worker_base.Worker):
         self._obs["version"].set(eng.version)
         self._obs["ring_depth"].set(eng.pipeline_depth)
         self._obs["inflight_chunks"].set(eng.inflight_chunks)
+        self._obs["prefix_blocks"].set(pstats["blocks_held"])
 
     # -- API ---------------------------------------------------------------
 
@@ -321,6 +343,12 @@ class GenerationServerWorker(worker_base.Worker):
             "inflight_chunks": self.engine.inflight_chunks,
             "async_fetches_total": self.engine.async_fetches_total,
             "fetch_ready_total": self.engine.fetch_ready_total,
+            # radix prefix cache: hit rate / cached-token volume /
+            # eviction pressure / resident footprint
+            **{
+                f"prefix_cache_{k}": v
+                for k, v in self.engine.prefix_cache_stats().items()
+            },
             # decode-loop host/device/fetch attribution (cumulative s)
             **{
                 f"time_{k}": v
@@ -388,14 +416,16 @@ class GenServerClient:
             self._local.sock = s
         return self._local.sock
 
-    def call(self, cmd: str, payload) -> object:
+    def call(self, cmd: str, payload, timeout: Optional[float] = None) -> object:
         sock = self._sock()
         sock.send_multipart([b"", pickle.dumps((cmd, payload))])
         # sliced poll with an abort check: these calls run on asyncio's
         # default-executor threads, and a thread stuck in a 600s poll
         # after worker exit stalls asyncio.run's shutdown for its full
         # 300s join timeout (round-4 verdict weak #8)
-        if not _poll_abortable(sock, self.timeout, self._abort):
+        if not _poll_abortable(
+            sock, self.timeout if timeout is None else timeout, self._abort
+        ):
             # discard the socket so a late reply can't be read by (and
             # mismatched with) the next request on this thread
             sock.close(linger=0)

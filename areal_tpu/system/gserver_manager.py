@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import pickle
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import zmq
 
@@ -83,6 +83,17 @@ class GserverManager(worker_base.Worker):
         self._qid_tokens: Dict[str, float] = {}
         # rollout group key -> server (group affinity for prompt-KV dedup)
         self._group_server: Dict[str, str] = {}
+        # cache-aware routing state: per session (group key), the longest
+        # prefix each server has served — the proxy for whose radix cache
+        # is hottest for this conversation (the manager never sees token
+        # ids; prompt_len of the turns it routed there is the honest
+        # lower bound on the prefix that server has cached)
+        self._group_prefix: Dict[str, Dict[str, float]] = {}
+        # per (group, server) resident-token sums, maintained incrementally
+        # alongside _qid_tokens so the imbalance escape hatch's "own load"
+        # discount is O(1) per schedule call instead of a scan of every
+        # in-flight qid
+        self._group_tokens: Dict[str, Dict[str, float]] = {}
         self.rollout_stat = RolloutStat()
         self._model_version = 0
 
@@ -113,6 +124,9 @@ class GserverManager(worker_base.Worker):
         self._m_lag = reg.gauge("areal_gserver_version_lag")
         self._m_srv_reqs = reg.gauge("areal_gserver_server_requests")
         self._m_srv_toks = reg.gauge("areal_gserver_server_tokens")
+        self._m_affinity_escapes = reg.counter(
+            "areal_gserver_affinity_escapes_total"
+        )
 
     def _export_metrics(self):
         self._m_running.set(self.rollout_stat.running)
@@ -149,33 +163,88 @@ class GserverManager(worker_base.Worker):
                 self._server_tokens[addr] = max(
                     0.0, self._server_tokens[addr] - prev + est
                 )
+                gt = self._group_tokens.setdefault(self._group_key(qid), {})
+                gt[addr] = max(0.0, gt.get(addr, 0.0) - prev + est)
             return addr
-        # group affinity: a sibling member of this rollout already picked a
-        # server — co-locate so the engine prefills the shared prompt ONCE
-        # and scatters the KV to all members
+        # cache-aware affinity: a sibling member of this rollout already
+        # picked a server (co-locate for group-prompt KV dedup), or an
+        # earlier TURN of this conversation left its prefix hot in some
+        # server's radix cache — route to the longest-hot-prefix server
+        # unless the load-imbalance escape hatch fires
         group = self._group_key(qid)
-        sibling = self._group_server.get(group)
+        sibling, avoid = self._affine_server(group)
+        # when the escape hatch fired, `avoid` is the overloaded hot
+        # server: the fallback policy must EXCLUDE it, else a policy
+        # whose signal differs from the imbalance signal (least_requests
+        # on a few-huge-conversations server) re-picks the very server
+        # the escape meant to leave
+        pool = [a for a in self.server_addrs if a != avoid] or list(
+            self.server_addrs
+        )
         if sibling is not None:
             addr = sibling
         elif self.config.schedule_policy == "least_requests":
-            addr = min(self.server_addrs, key=lambda a: self._server_load[a])
+            addr = min(pool, key=lambda a: self._server_load[a])
         elif self.config.schedule_policy == "least_token_usage":
             # route by estimated resident tokens: prompt + 0.4x budget (the
             # reference's expected-completion discount, gserver_manager
             # :400-405) — a far better KV-pressure signal than request count
-            addr = min(
-                self.server_addrs, key=lambda a: self._server_tokens[a]
-            )
+            addr = min(pool, key=lambda a: self._server_tokens[a])
         else:  # round_robin (policy validated at _configure)
-            addr = self.server_addrs[self._round_robin % len(self.server_addrs)]
+            addr = pool[self._round_robin % len(pool)]
             self._round_robin += 1
         self._qid_server[qid] = addr
         self._group_server[group] = addr
+        if self.config.cache_aware_routing:
+            # after this turn the server's radix cache holds (at least)
+            # the turn's whole prompt — the hot-prefix estimate future
+            # turns of this session route on
+            by_srv = self._group_prefix.setdefault(group, {})
+            by_srv[addr] = max(by_srv.get(addr, 0.0), float(prompt_len))
         self._server_load[addr] += 1
         est = float(prompt_len) + 0.4 * float(new_token_budget)
         self._qid_tokens[qid] = est
         self._server_tokens[addr] += est
+        gt = self._group_tokens.setdefault(group, {})
+        gt[addr] = gt.get(addr, 0.0) + est
         return addr
+
+    def _affine_server(
+        self, group: str
+    ) -> Tuple[Optional[str], Optional[str]]:
+        """``(server, avoid)``: the server this session should stick to —
+        longest hot prefix (cache-aware) falling back to plain group
+        affinity — or, when the imbalance escape hatch fires,
+        ``(None, hot_server)`` so the caller re-routes by the configured
+        policy EXCLUDING the overloaded hot server (the new server
+        re-prefills; a hot cache on an overloaded box is slower than a
+        cold one on an idle box)."""
+        prefixes = self._group_prefix.get(group)
+        if self.config.cache_aware_routing and prefixes:
+            # deterministic argmax: ties break on server address order
+            cand = max(sorted(prefixes), key=lambda a: prefixes[a])
+        else:
+            cand = self._group_server.get(group)
+        if (
+            cand is None
+            or not self.config.cache_aware_routing
+            or len(self.server_addrs) <= 1  # nowhere to escape to
+        ):
+            return cand, None
+        # imbalance = FOREIGN load on the hot server: the session's own
+        # resident-token estimates are discounted, else a long
+        # conversation would eventually evict itself from its hot cache
+        # just by growing
+        own = self._group_tokens.get(group, {}).get(cand, 0.0)
+        foreign = self._server_tokens[cand] - own
+        least = min(self._server_tokens.values())
+        if foreign > (
+            self.config.affinity_imbalance_factor * least
+            + self.config.affinity_imbalance_slack_tokens
+        ):
+            self._m_affinity_escapes.inc()
+            return None, cand
+        return cand, None
 
     def get_training_sample_cnt(self) -> int:
         """Globally-trained sample count published by the master
@@ -242,6 +311,8 @@ class GserverManager(worker_base.Worker):
                 0.0, self._server_tokens[srv] - self._qid_tokens.pop(k, 0.0)
             )
         self._group_server.pop(qid, None)
+        self._group_prefix.pop(qid, None)
+        self._group_tokens.pop(qid, None)
 
     # -- weight updates -----------------------------------------------------
 
@@ -259,24 +330,71 @@ class GserverManager(worker_base.Worker):
             return None
         return info
 
+    def _update_one_server(self, addr: str, client, payload: Dict):
+        """Per-server ``update_weights`` with bounded-backoff retries: a
+        TRANSIENT RPC failure (timeout, connection reset, a server busy
+        draining a long chunk) on ONE server must not fail the whole
+        fleet's version bump.  A server-side rejection (the client
+        raises ``RuntimeError`` for an ``{"error": ...}`` response, e.g.
+        a bad checkpoint path) reproduces on every attempt and fails the
+        server IMMEDIATELY — these calls run while the WHOLE fleet is
+        paused, so each attempt is also capped at
+        ``flush_request_timeout`` (not the client's default 600s).
+        Returns the success response dict, or the failure (exception
+        repr / bad response) once retries are spent."""
+        retries = max(1, self.config.update_weights_retries)
+        backoff = max(0.0, self.config.update_weights_retry_backoff_s)
+        last = None
+        for attempt in range(retries):
+            if attempt:
+                time.sleep(min(backoff * (2 ** (attempt - 1)), 10.0))
+            try:
+                resp = client.call(
+                    "update_weights",
+                    payload,
+                    timeout=self.config.flush_request_timeout,
+                )
+            except (TimeoutError, ConnectionError, OSError) as e:
+                last = repr(e)
+                self.logger.warning(
+                    "update_weights attempt %d/%d on %s failed: %s",
+                    attempt + 1, retries, addr, last,
+                )
+                continue
+            except Exception as e:  # noqa: BLE001 - deterministic reject
+                last = repr(e)
+                self.logger.warning(
+                    "update_weights on %s rejected (not retried): %s",
+                    addr, last,
+                )
+                return last
+            if isinstance(resp, dict) and "num_interrupted" in resp:
+                return resp
+            # a malformed (non-error, non-success) response reproduces
+            # too: report it without burning paused-fleet time on retries
+            last = resp
+            self.logger.warning(
+                "update_weights on %s returned %r (not retried)", addr, resp
+            )
+            return last
+        return last
+
     def _flush_and_update(self, info: Dict):
         version = info["version"]
         for addr, client in self._clients.items():
             client.call("pause", {})
         n_interrupted = 0
         failed = []
+        payload = {
+            "path": info["path"],
+            "version": version,
+            # forward the checkpoint format so servers pick the
+            # sharded raw-param load path for orbax trees
+            "format": info.get("format"),
+        }
         try:
             for addr, client in self._clients.items():
-                resp = client.call(
-                    "update_weights",
-                    {
-                        "path": info["path"],
-                        "version": version,
-                        # forward the checkpoint format so servers pick the
-                        # sharded raw-param load path for orbax trees
-                        "format": info.get("format"),
-                    },
-                )
+                resp = self._update_one_server(addr, client, payload)
                 if isinstance(resp, dict) and "num_interrupted" in resp:
                     n_interrupted += resp["num_interrupted"]
                 else:

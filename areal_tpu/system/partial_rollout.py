@@ -11,7 +11,7 @@ are reassembled before replying).
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from areal_tpu.api import model_api
 from areal_tpu.base import logging_
@@ -21,17 +21,27 @@ logger = logging_.getLogger("partial_rollout")
 
 
 class PartialRolloutManager:
+    #: RPC failure classes worth retrying: scheduling/generation timeouts
+    #: and connection drops are transient (a server draining a long chunk,
+    #: a manager busy with a weight swap); server-side errors
+    #: (RuntimeError from an error response) are not — they reproduce.
+    TRANSIENT_ERRORS = (TimeoutError, ConnectionError, OSError)
+
     def __init__(
         self,
         manager_client,  # GserverManagerClient
         gconfig: model_api.GenerationHyperparameters,
         new_tokens_per_chunk: int = 1 << 30,
         request_timeout: float = 600.0,
+        max_rpc_retries: int = 3,
+        rpc_retry_backoff_s: float = 0.5,
     ):
         self.manager_client = manager_client
         self.gconfig = gconfig
         self.new_tokens_per_chunk = max(1, new_tokens_per_chunk)
         self.request_timeout = request_timeout
+        self.max_rpc_retries = max(1, max_rpc_retries)
+        self.rpc_retry_backoff_s = max(0.0, rpc_retry_backoff_s)
         self._server_clients: Dict[str, GenServerClient] = {}
 
     def _client(self, addr: str) -> GenServerClient:
@@ -40,6 +50,83 @@ class PartialRolloutManager:
                 addr, timeout=self.request_timeout
             )
         return self._server_clients[addr]
+
+    async def _gen_chunk(
+        self, qid: str, tag: int, prompt_ids: List[int], cur: List[int],
+        chunk: int,
+    ) -> Tuple[model_api.APIGenerateOutput, int]:
+        """Schedule + generate ONE chunk, retrying transient RPC failures
+        with capped exponential backoff.  A timed-out schedule or a
+        connection reset used to propagate the first exception straight
+        into the rollout worker's harvest loop, cancelling the whole
+        trajectory for a blip; the retry re-SCHEDULES each attempt (the
+        manager may route the continuation elsewhere by then).  Non-
+        transient failures still raise after the attempts are spent.
+
+        A timed-out *generate* may have left a live orphan row on the
+        server under the attempt's request id — the engine keeps decoding
+        it, and a later submission of the SAME id would collide with it
+        (clobbered result slot; the orphan's stale output could answer
+        the new request).  So each timeout permanently retires the
+        current id: the retry — and every later chunk of this sequence —
+        generates under ``{qid}#r{tag}`` (``tag`` monotone per
+        ``_gen_one``), while SCHEDULING stays keyed on the plain ``qid``
+        (server stickiness, group affinity, and the manager's token
+        accounting are per-conversation, not per-attempt).  Park-resume
+        keys on the generate id and keeps working across chunks; after a
+        retry switches ids once, the radix prefix cache serves the old
+        id's prefix.  Returns ``(output, tag)`` so the caller carries the
+        retired-id state forward."""
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.max_rpc_retries):
+            if attempt:
+                await asyncio.sleep(
+                    min(self.rpc_retry_backoff_s * 2 ** (attempt - 1), 10.0)
+                )
+            gen_qid = qid if tag == 0 else f"{qid}#r{tag}"
+            try:
+                sched = await asyncio.to_thread(
+                    self.manager_client.call,
+                    "schedule_request",
+                    {
+                        "qid": qid,
+                        # load signal for cache-aware / token-usage routing
+                        "prompt_len": len(cur),
+                        "new_token_budget": chunk,
+                    },
+                )
+            except self.TRANSIENT_ERRORS as e:
+                # scheduling never reached a generation server: no orphan
+                # row can exist, so the id is NOT retired (retiring it
+                # here would abandon a parked row the next chunk could
+                # have resumed prefill-free)
+                last_exc = e
+                logger.warning(
+                    "transient RPC failure scheduling %s (attempt %d/%d): "
+                    "%r",
+                    qid, attempt + 1, self.max_rpc_retries, e,
+                )
+                continue
+            try:
+                client = self._client(sched["url"])
+                inp = model_api.APIGenerateInput(
+                    qid=gen_qid,
+                    prompt_ids=prompt_ids,
+                    input_ids=cur,
+                    gconfig=self.gconfig.new(max_new_tokens=chunk, n=1),
+                )
+                out = await asyncio.to_thread(client.generate, inp)
+                return out, tag
+            except self.TRANSIENT_ERRORS as e:
+                last_exc = e
+                tag += 1  # gen_qid may have a live orphan row: retire it
+                logger.warning(
+                    "transient RPC failure generating %s (attempt %d/%d): "
+                    "%r",
+                    gen_qid, attempt + 1, self.max_rpc_retries, e,
+                )
+        assert last_exc is not None
+        raise last_exc
 
     async def _gen_one(
         self, qid: str, prompt_ids: List[int]
@@ -51,27 +138,11 @@ class PartialRolloutManager:
         version_start: Optional[int] = None
         version_end = -1
         no_eos = True
+        tag = 0  # bumps past ids retired by generate timeouts (see _gen_chunk)
         while remaining > 0:
             chunk = min(self.new_tokens_per_chunk, remaining)
-            sched = await asyncio.to_thread(
-                self.manager_client.call,
-                "schedule_request",
-                {
-                    "qid": qid,
-                    # load signal for least_token_usage routing
-                    "prompt_len": len(cur),
-                    "new_token_budget": chunk,
-                },
-            )
-            client = self._client(sched["url"])
-            inp = model_api.APIGenerateInput(
-                qid=qid,
-                prompt_ids=prompt_ids,
-                input_ids=cur,
-                gconfig=self.gconfig.new(max_new_tokens=chunk, n=1),
-            )
-            out: model_api.APIGenerateOutput = await asyncio.to_thread(
-                client.generate, inp
+            out, tag = await self._gen_chunk(
+                qid, tag, prompt_ids, cur, chunk
             )
             if version_start is None:
                 version_start = out.version_start

@@ -51,6 +51,16 @@ from its own 3-column rows, and the whole round's diffable numbers are
 duplicated into a compact top-level `summary` object so BENCH_rNN.json's
 `parsed` field carries them even when `detail` is huge.
 
+Round 8 measures the cross-request radix prefix cache
+(engine/prefix_cache.py): a `prefix_cache_ab` section replays multi-turn
+conversations — every turn re-sends the WHOLE growing conversation under a
+fresh qid, the shape of the reference's multi-turn agent loops over
+SGLang's radix cache — with the cache on vs off, reporting the
+cached-token fraction (prompt tokens served from cache instead of
+re-prefilled), suffix-only prefill work, and end-to-end replay tok/s.
+The section runs off-TPU too (tiny shapes) so the summary always carries
+it.
+
 Caveats stated where measured: ONE chip, sync gen+train (the reference's
 number is 128-GPU async); 1.5B uses the true Qwen2.5-1.5B architecture
 with random weights (zero-egress image has no checkpoint; the HF importer
@@ -380,6 +390,135 @@ def bench_prefix_reuse(cfg, params, n_reqs=32, group_size=8, prompt_len=512):
         "prefill_tokens_grouped": int(toks_grouped),
         "prefill_work_reduction": round(
             toks_unique / max(toks_grouped, 1), 2
+        ),
+    }
+
+
+def bench_prefix_cache_ab(
+    cfg,
+    params,
+    n_sessions=8,
+    turns=4,
+    prompt_len=512,
+    user_len=64,
+    max_new=64,
+    page=256,
+    chunk=128,
+):
+    """Multi-turn conversation replay over the cross-request radix prefix
+    cache (engine/prefix_cache.py), cache on vs off.  Every turn re-sends
+    the WHOLE growing conversation under a FRESH qid — the reference's
+    multi-turn agent shape (realhf/system/partial_rollout.py over SGLang's
+    radix cache), where same-qid continuation parking cannot help and only
+    the cross-request cache saves the prefix re-prefill.  ``n_sessions``
+    conversations replay in lockstep (one submit wave per turn, drained
+    before the next), so the decode batch matches between arms and the A/B
+    isolates the admission/prefill savings.
+
+    Reported per arm: end-to-end replay tok/s (generated tokens / wall),
+    ``cached_token_frac`` (prompt tokens served from cache / prompt tokens
+    submitted — 0 by construction with the cache off), and the suffix
+    prefill token count the cache arm actually paid."""
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+
+    import zlib
+
+    # longest prompt the replay submits + its generation
+    final_prompt = prompt_len + (turns - 1) * (max_new + user_len)
+
+    def replay(eng, tag):
+        """Returns (wall_s, generated_tokens, prompt_tokens_submitted)."""
+        rngs = [
+            np.random.default_rng(zlib.crc32(f"{tag}s{s}".encode()))
+            for s in range(n_sessions)
+        ]
+        convs = [
+            rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+            for rng in rngs
+        ]
+        gen_toks = 0
+        prompt_toks = 0
+        t0 = time.perf_counter()
+        for j in range(turns):
+            for s, conv in enumerate(convs):
+                prompt_toks += len(conv)
+                eng.submit(
+                    APIGenerateInput(
+                        qid=f"{tag}s{s}@t{j}",
+                        prompt_ids=conv,
+                        input_ids=conv,
+                        gconfig=GenerationHyperparameters(
+                            max_new_tokens=max_new, temperature=1.0
+                        ),
+                    )
+                )
+            while eng.has_work:
+                eng.step()
+            outs = eng.drain_results()
+            for s, rng in enumerate(rngs):
+                out = outs[f"{tag}s{s}@t{j}"]
+                gen_toks += len(out.output_ids)
+                convs[s] = (
+                    convs[s]
+                    + list(out.output_ids)
+                    + rng.integers(0, cfg.vocab_size, (user_len,)).tolist()
+                )
+        return time.perf_counter() - t0, gen_toks, prompt_toks
+
+    def arm(enabled, tag):
+        eng = make_engine(
+            cfg, params, n_sessions, final_prompt, max_new, chunk=chunk,
+            cache_mode="paged",
+            page_size=page,
+            # headroom so capacity trims don't dominate the A/B: the cache
+            # may keep earlier turns resident beyond the live rows' pool
+            kv_pool_tokens=2 * n_sessions
+            * bench_gen_cache_len(final_prompt, max_new),
+            prefix_cache=enabled,
+        )
+        replay(eng, f"w{tag}")  # warmup: compile every turn's buckets
+        s0 = eng.prefix_cache_stats()
+        p0 = eng.prefill_tokens_total
+        wall, gen_toks, prompt_toks = replay(eng, tag)
+        st = eng.prefix_cache_stats()
+        row = {
+            "replay_s": round(wall, 3),
+            "toks_per_sec": round(gen_toks / max(wall, 1e-9), 1),
+            "generated_tokens": int(gen_toks),
+            "prompt_tokens_submitted": int(prompt_toks),
+            "cached_token_frac": round(
+                (st["cached_tokens_total"] - s0["cached_tokens_total"])
+                / max(prompt_toks, 1),
+                3,
+            ),
+            "prefill_tokens": int(eng.prefill_tokens_total - p0),
+            "cache_hits": int(st["hits_total"] - s0["hits_total"]),
+            "cache_evictions": int(
+                st["evictions_total"] - s0["evictions_total"]
+            ),
+        }
+        del eng
+        return row
+
+    on = arm(True, "on")
+    off = arm(False, "off")
+    return {
+        "sessions": n_sessions,
+        "turns": turns,
+        "prompt_len": prompt_len,
+        "user_len": user_len,
+        "max_new": max_new,
+        "page_size": page,
+        "cache_on": on,
+        "cache_off": off,
+        "replay_wall_speedup": round(
+            off["replay_s"] / max(on["replay_s"], 1e-9), 2
+        ),
+        "prefill_work_reduction": round(
+            off["prefill_tokens"] / max(on["prefill_tokens"], 1), 2
         ),
     }
 
@@ -1214,6 +1353,24 @@ def main():
         _section(bench_prefix_reuse, cfg, gen_params) if on_tpu else None
     )
 
+    # cross-request radix prefix cache: multi-turn conversation replay,
+    # cache on vs off (cached-token fraction + replay tok/s).  Runs
+    # off-TPU too — tiny shapes — so the summary always carries it.
+    mark("prefix cache A/B")
+    prefix_cache_ab = _section(
+        bench_prefix_cache_ab,
+        cfg,
+        gen_params,
+        **(
+            {}
+            if on_tpu
+            else dict(
+                n_sessions=2, turns=3, prompt_len=32, user_len=8,
+                max_new=8, page=16, chunk=32,
+            )
+        ),
+    )
+
     # train->generation weight publish (sharded raw-param checkpoint,
     # inference dtype; reference budget <3 s)
     mark("publish")
@@ -1387,6 +1544,7 @@ def main():
         if isinstance(gen.get("b32"), dict)
         else None,
         "prefill_ab": prefill_ab,
+        "prefix_cache_ab": prefix_cache_ab,
         "paged_decode_ab": (
             {
                 k: [
@@ -1456,6 +1614,7 @@ def main():
                     "chunked_prefill": chunked_prefill,
                     "interruption": interruption,
                     "prefix_reuse": prefix_reuse,
+                    "prefix_cache_ab": prefix_cache_ab,
                 },
             }
         )

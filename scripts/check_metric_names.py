@@ -9,6 +9,10 @@ formatting/aliasing of the registry object doesn't matter, and dynamically
 computed names are rejected by construction — metric names must be
 literals or the scrape vocabulary becomes unauditable).
 
+The human-facing metric table in ``docs/observability.md`` is diffed
+against the canonical table too (both directions): docs cannot silently
+drift when a metric is added, renamed, or retired.
+
 Exit code 0 = clean; 1 = violations (each printed, one per line).  Run in
 tier-1 via tests/observability/test_metric_names_lint.py.
 """
@@ -17,8 +21,9 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
-from typing import Dict, List, Tuple
+from typing import Dict, List, Set, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -76,6 +81,29 @@ def collect_emitted_names() -> Dict[str, List[Tuple[str, int]]]:
     return emitted
 
 
+DOCS_TABLE = os.path.join(REPO_ROOT, "docs", "observability.md")
+
+#: a documented metric: a backticked `areal_*` name inside a markdown
+#: table row.  Rows may document several names at once
+#: ("| `areal_host_load1` / `areal_host_load5` | ...") — every backticked
+#: name on the row counts.
+_DOC_NAME_RE = re.compile(r"`(areal_[a-z0-9_]+)`")
+
+
+def collect_documented_names(path: str = DOCS_TABLE) -> Set[str]:
+    """Names documented in docs/observability.md's metric table (markdown
+    rows whose first cell is a backticked ``areal_*`` name)."""
+    out: Set[str] = set()
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            if not line.lstrip().startswith("| `areal_"):
+                continue
+            out.update(_DOC_NAME_RE.findall(line))
+    return out
+
+
 def run_lint() -> List[str]:
     """Returns a list of violation messages (empty = clean)."""
     sys.path.insert(0, REPO_ROOT)
@@ -116,6 +144,21 @@ def run_lint() -> List[str]:
             f"table entry {name} is never emitted anywhere under "
             "areal_tpu/ or bench.py (dead vocabulary — remove it or wire "
             "the instrument)"
+        )
+
+    # docs table drift: the markdown table in docs/observability.md must
+    # document exactly the canonical vocabulary
+    documented = collect_documented_names()
+    for name in sorted(set(counts) - documented):
+        problems.append(
+            f"metric {name} is in METRIC_TABLE but missing from the "
+            "docs/observability.md metric table"
+        )
+    for name in sorted(documented - set(counts)):
+        problems.append(
+            f"docs/observability.md documents {name}, which is not in "
+            "areal_tpu/observability/table.py METRIC_TABLE (stale doc "
+            "row — remove it or add the table entry)"
         )
     return problems
 

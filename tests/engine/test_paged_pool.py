@@ -62,6 +62,28 @@ def test_all_blocks_freed_after_drain():
     eng.step()
     eng.step()
     assert eng.n_parked == 0
+    # every non-free block is accounted for by the radix prefix cache
+    # (finished sequences stay indexed for cross-request reuse) ...
+    held = eng._prefix_cache.blocks_held
+    assert eng.free_pool_blocks == eng.n_blocks - held
+    # ... and flushing the cache returns the pool to pristine: no block
+    # leaks across a full admit/park/evict cycle
+    eng._prefix_cache.flush()
+    assert eng.free_pool_blocks == eng.n_blocks
+    assert (np.asarray(eng._block_ref) == 0).all()
+
+
+def test_all_blocks_freed_after_drain_cache_off():
+    """With the prefix cache disabled the old invariant holds verbatim."""
+    eng, *_ = make_engine(prefix_cache=False)
+    eng.park_ttl_steps = 0
+    for i in range(6):
+        eng.submit(_req(f"q{i}", [i + 7, i + 8, i + 9], 6))
+    run_until_done(eng)
+    eng.drain_results()
+    eng.step()
+    eng.step()
+    assert eng.n_parked == 0
     assert eng.free_pool_blocks == eng.n_blocks
     assert (np.asarray(eng._block_ref) == 0).all()
 

@@ -10,6 +10,8 @@ from areal_tpu.system.gserver_manager import GserverManager
 
 
 def _manager(policy="least_requests", **cfg_kwargs):
+    from areal_tpu.base import logging_
+
     m = GserverManager.__new__(GserverManager)
     m.config = GserverManagerConfig(
         schedule_policy=policy,
@@ -17,12 +19,15 @@ def _manager(policy="least_requests", **cfg_kwargs):
         **cfg_kwargs,
     )
     m.server_addrs = ["s0", "s1", "s2"]
+    m.logger = logging_.getLogger("test-gm")
     m._round_robin = 0
     m._qid_server = {}
     m._server_load = {a: 0 for a in m.server_addrs}
     m._server_tokens = {a: 0.0 for a in m.server_addrs}
     m._qid_tokens = {}
     m._group_server = {}
+    m._group_prefix = {}
+    m._group_tokens = {}
     m.rollout_stat = RolloutStat()
     m._model_version = 0
     m._expr, m._trial = "test-exp", "test-trial"
@@ -205,3 +210,157 @@ def test_group_affinity_with_uuid_dashes():
     base = "f305140d-4fda-4442-a873-8cfc54bb2a4e#0"
     s = {m._schedule(f"{base}-{i}") for i in range(4)}
     assert len(s) == 1
+
+
+# -- cache-aware routing ------------------------------------------------------
+
+
+def test_multi_turn_follows_prefix_hot_server():
+    """Every turn of one conversation ('{qid}@t{j}-{i}') lands on the
+    server whose radix cache holds the longest prefix, even when another
+    server is mildly less loaded — re-prefilling a 5k-token conversation
+    costs more than a small load delta."""
+    m = _manager(policy="least_token_usage")
+    t0 = m._schedule("c1@t0-0", prompt_len=1000, new_token_budget=200)
+    # make the affine server mildly busier than the others
+    m._server_tokens[t0] += 2000.0
+    t1 = m._schedule("c1@t1-0", prompt_len=1400, new_token_budget=200)
+    assert t1 == t0  # pure least-tokens would have moved it
+    t2 = m._schedule("c1@t2-0", prompt_len=1800, new_token_budget=200)
+    assert t2 == t0
+
+
+def test_imbalance_escape_hatch_breaks_affinity():
+    """When the prefix-hot server's resident tokens exceed the least-
+    loaded server's by factor x + slack, the session re-routes (and the
+    escape is counted)."""
+    m = _manager(
+        policy="least_token_usage",
+        affinity_imbalance_factor=1.5,
+        affinity_imbalance_slack_tokens=100.0,
+    )
+    t0 = m._schedule("c2@t0-0", prompt_len=500, new_token_budget=100)
+    base_escapes = m._m_affinity_escapes.value()
+    m._server_tokens[t0] += 50_000.0  # way past 1.5x least + 100
+    t1 = m._schedule("c2@t1-0", prompt_len=900, new_token_budget=100)
+    assert t1 != t0
+    assert m._m_affinity_escapes.value() == base_escapes + 1
+    # the new server becomes the (longer-) prefix-hot one: later turns
+    # follow IT while the balance holds
+    t2 = m._schedule("c2@t2-0", prompt_len=1300, new_token_budget=100)
+    assert t2 == t1
+
+
+def test_escape_excludes_hot_server_under_least_requests():
+    """The escape hatch fires on resident TOKENS; a hot server with few
+    huge conversations can still have the fewest REQUESTS, so the
+    fallback policy must exclude it or the 'escape' re-picks the very
+    server it meant to leave (and the counter lies)."""
+    m = _manager(
+        policy="least_requests",
+        affinity_imbalance_factor=1.5,
+        affinity_imbalance_slack_tokens=100.0,
+    )
+    t0 = m._schedule("c5@t0-0", prompt_len=500, new_token_budget=100)
+    m._server_tokens[t0] += 50_000.0  # token-overloaded...
+    for other in m.server_addrs:
+        if other != t0:  # ...but request-light vs everyone else
+            m._server_load[other] += 5
+    t1 = m._schedule("c5@t1-0", prompt_len=900, new_token_budget=100)
+    assert t1 != t0  # least_requests alone would have re-picked t0
+
+
+def test_cache_aware_off_keeps_unconditional_affinity():
+    m = _manager(policy="least_token_usage", cache_aware_routing=False)
+    t0 = m._schedule("c3@t0-0", prompt_len=500, new_token_budget=100)
+    m._server_tokens[t0] += 50_000.0
+    assert m._schedule("c3@t1-0", prompt_len=900) == t0  # never escapes
+
+
+def test_finish_clears_prefix_affinity():
+    m = _manager(policy="least_token_usage")
+    m._schedule("c4@t0-0", prompt_len=500, new_token_budget=100)
+    assert "c4" in m._group_prefix
+    m._finish_rollout("c4", accepted=True)
+    assert "c4" not in m._group_prefix and "c4" not in m._group_server
+
+
+# -- weight-update failure handling ------------------------------------------
+
+
+class _FakeClient:
+    """Records calls; update_weights can raise transiently or reply with
+    an error response."""
+
+    def __init__(self, raise_n=0, always_error=False):
+        self.calls = []
+        self.raise_n = raise_n
+        self.always_error = always_error
+
+    def n_updates(self):
+        return sum(1 for c, _ in self.calls if c == "update_weights")
+
+    def call(self, cmd, payload, timeout=None):
+        self.calls.append((cmd, payload))
+        if cmd != "update_weights":
+            return "ok"
+        if self.always_error:
+            # the real GenServerClient raises RuntimeError for an
+            # {"error": ...} server response
+            raise RuntimeError("server error: load failed")
+        if self.n_updates() <= self.raise_n:
+            raise TimeoutError("transient RPC failure")
+        return {"num_interrupted": 2}
+
+    def cmds(self):
+        return [c for c, _ in self.calls]
+
+
+def _update_info(version=5):
+    return {"version": version, "path": "/tmp/ckpt", "format": "params"}
+
+
+def test_update_failure_resumes_all_and_keeps_version():
+    """A server that REJECTS update_weights (deterministic server error,
+    not a transient blip) must not leave ANY server paused, must not be
+    retried (the whole fleet is paused while attempts run), and
+    _model_version must stay unchanged so the poll loop retries the
+    published version (gserver_manager.py finally-resume path —
+    previously untested)."""
+    m = _manager(
+        update_weights_retries=3, update_weights_retry_backoff_s=0.0
+    )
+    good, bad = _FakeClient(), _FakeClient(always_error=True)
+    m._clients = {"s0": good, "s1": bad}
+    m._flush_and_update(_update_info(version=5))
+    assert m._model_version == 0  # version bump withheld
+    for c in (good, bad):
+        assert c.cmds()[0] == "pause" and c.cmds()[-1] == "resume"
+    assert bad.n_updates() == 1  # server rejection: fail fast, no retry
+
+
+def test_update_transient_failure_retried_to_success():
+    """One flaky server no longer blocks the fleet's version bump: the
+    per-server bounded-backoff retry absorbs a transient failure."""
+    m = _manager(
+        update_weights_retries=3, update_weights_retry_backoff_s=0.0
+    )
+    flaky = _FakeClient(raise_n=1)
+    m._clients = {"s0": _FakeClient(), "s1": flaky}
+    m._flush_and_update(_update_info(version=7))
+    assert m._model_version == 7
+    assert flaky.n_updates() == 2  # failed once, succeeded on retry
+    for c in m._clients.values():
+        assert c.cmds()[-1] == "resume"
+
+
+def test_update_exception_exhausting_retries_keeps_version():
+    m = _manager(
+        update_weights_retries=2, update_weights_retry_backoff_s=0.0
+    )
+    dead = _FakeClient(raise_n=10)  # raises forever
+    m._clients = {"s0": dead}
+    m._flush_and_update(_update_info(version=9))
+    assert m._model_version == 0
+    assert dead.n_updates() == 2
+    assert dead.cmds()[-1] == "resume"

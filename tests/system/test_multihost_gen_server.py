@@ -11,6 +11,8 @@ import time
 
 import pytest
 
+from tests.helpers.capabilities import requires_multiprocess_cpu_mesh
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 RUNNER = os.path.join(REPO_ROOT, "tests", "helpers", "run_gen_server.py")
 
@@ -85,6 +87,7 @@ def _dump_on_failure(procs):
     return "\n=====\n".join(o or "" for o in outs)
 
 
+@requires_multiprocess_cpu_mesh
 def test_multihost_tp_generation(cluster):
     from areal_tpu.api.model_api import (
         APIGenerateInput,

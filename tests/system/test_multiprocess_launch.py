@@ -16,6 +16,7 @@ from tests.fixtures import (  # noqa: F401
     tokenizer,
     tokenizer_path,
 )
+from tests.helpers.capabilities import requires_multiprocess_cpu_mesh
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 
@@ -61,6 +62,7 @@ def _read_master_stats(tmp_path, experiment_name, trial_name):
     ]
 
 
+@requires_multiprocess_cpu_mesh
 def test_multiprocess_sync_ppo(dataset_path, tokenizer_path, tmp_path, launch_env):
     from areal_tpu.apps.main import launch_experiment
     from tests.system.exp_factories import make_sync_ppo_exp
@@ -82,6 +84,7 @@ def test_multiprocess_sync_ppo(dataset_path, tokenizer_path, tmp_path, launch_en
     assert steps[-1]["actor_train/tflops"] > 0
 
 
+@requires_multiprocess_cpu_mesh
 def test_multiprocess_async_ppo(dataset_path, tokenizer_path, tmp_path, launch_env):
     """Full decoupled fleet as 6 processes: master, model worker, gen
     server, gserver manager, rollout worker (+ launcher monitoring)."""
@@ -104,6 +107,7 @@ def test_multiprocess_async_ppo(dataset_path, tokenizer_path, tmp_path, launch_e
     assert np.isfinite(steps[-1]["actor_train/loss"])
 
 
+@requires_multiprocess_cpu_mesh
 def test_multiprocess_sync_ppo_server_backend(
     dataset_path, tokenizer_path, tmp_path, launch_env, monkeypatch
 ):

@@ -104,3 +104,90 @@ def test_group_members_get_distinct_qids_and_reassemble():
     # packed logprob layout: len(seq) - 1 per member
     for seq, lps in zip(bundle.seqs, bundle.logprobs):
         assert len(lps) == len(seq) - 1
+
+
+class FlakyGenClient(StubGenClient):
+    """Raises a transient error on scripted call indices (0-based, counts
+    every generate attempt including failures)."""
+
+    def __init__(self, fail_on=(), exc=TimeoutError, **kw):
+        super().__init__(**kw)
+        self.fail_on = set(fail_on)
+        self.exc = exc
+        self.attempts = 0
+
+    def generate(self, inp):
+        i = self.attempts
+        self.attempts += 1
+        if i in self.fail_on:
+            self.calls.append(inp)
+            raise self.exc(f"transient failure on attempt {i}")
+        return super().generate(inp)
+
+
+def test_transient_generate_failure_retried_with_retired_qid():
+    """A generate timeout may leave a live orphan row on the server under
+    the attempt's request id: the retry (and every later chunk) must use
+    a fresh '#rN' id so it can never collide with the orphan, while the
+    MANAGER keeps seeing the plain qid (routing stickiness)."""
+    gen = FlakyGenClient(fail_on=(0,), tokens_per_chunk=4)
+    prm = _manager(gen, max_new=8, chunk=4)
+    prm.rpc_retry_backoff_s = 0.0
+    bundle = asyncio.run(prm.generate_group("qf", [1, 2], 1))
+    # attempt 0 (plain id) failed -> retry and BOTH chunks under #r1
+    assert [c.qid for c in gen.calls] == ["qf-0", "qf-0#r1", "qf-0#r1"]
+    assert bundle.seqs[0] == [1, 2] + [100 + j for j in range(8)]
+    # scheduling stayed keyed on the member qid for every attempt
+    sched_qids = {p["qid"] for c, p in prm.manager_client.calls}
+    assert sched_qids == {"qf-0"}
+
+
+class FlakyManagerClient(StubManagerClient):
+    """schedule_request raises transiently on scripted call indices."""
+
+    def __init__(self, fail_on=()):
+        super().__init__()
+        self.fail_on = set(fail_on)
+        self.attempts = 0
+
+    def call(self, cmd, payload):
+        i = self.attempts
+        self.attempts += 1
+        if i in self.fail_on:
+            raise TimeoutError(f"manager busy (attempt {i})")
+        return super().call(cmd, payload)
+
+
+def test_schedule_failure_does_not_retire_generate_id():
+    """A schedule_request timeout never reached a generation server, so
+    no orphan row can exist: the generate id must NOT be retired (a
+    retired id abandons the server-side parked row the next chunk could
+    have resumed prefill-free)."""
+    gen = StubGenClient(tokens_per_chunk=4)
+    prm = _manager(gen, max_new=8, chunk=4)
+    prm.manager_client = FlakyManagerClient(fail_on=(0,))
+    prm.rpc_retry_backoff_s = 0.0
+    bundle = asyncio.run(prm.generate_group("qs", [1, 2], 1))
+    # both chunks generated under the PLAIN member qid despite the
+    # schedule blip
+    assert [c.qid for c in gen.calls] == ["qs-0", "qs-0"]
+    assert bundle.seqs[0] == [1, 2] + [100 + j for j in range(8)]
+
+
+def test_retries_exhausted_propagates_last_error():
+    gen = FlakyGenClient(fail_on=(0, 1, 2), tokens_per_chunk=4)
+    prm = _manager(gen, max_new=8, chunk=4)
+    prm.rpc_retry_backoff_s = 0.0
+    prm.max_rpc_retries = 3
+    with pytest.raises(TimeoutError):
+        asyncio.run(prm._gen_one("qx", [1]))
+    assert gen.attempts == 3
+
+
+def test_non_transient_error_not_retried():
+    gen = FlakyGenClient(fail_on=(0,), exc=RuntimeError, tokens_per_chunk=4)
+    prm = _manager(gen, max_new=4, chunk=4)
+    prm.rpc_retry_backoff_s = 0.0
+    with pytest.raises(RuntimeError):
+        asyncio.run(prm._gen_one("qn", [1]))
+    assert gen.attempts == 1  # server-side errors reproduce: no retry
