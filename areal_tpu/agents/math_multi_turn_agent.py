@@ -144,7 +144,13 @@ class MathMultiTurnAgent(agent_api.Agent):
                         ),
                         "birth_time": np.asarray([now], np.float64),
                     },
-                    metadata={"birth_time": [now]},
+                    # birth_time orders master-buffer dequeues;
+                    # version_end rides along for the buffer-age
+                    # stall watchdog (flight recorder)
+                    metadata={
+                        "birth_time": [now],
+                        "version_end": [int(bundle.version_end[0])],
+                    },
                 )
             )
         return samples

@@ -113,8 +113,13 @@ class MathSingleStepAgent(agent_api.Agent):
                         ),
                         "birth_time": np.asarray([now], np.float64),
                     },
-                    # the master buffer orders dequeues by metadata birth_time
-                    metadata={"birth_time": [now]},
+                    # birth_time orders master-buffer dequeues;
+                    # version_end rides along for the buffer-age
+                    # stall watchdog (flight recorder)
+                    metadata={
+                        "birth_time": [now],
+                        "version_end": [int(bundle.version_end[j])],
+                    },
                 )
             )
         return samples

@@ -24,6 +24,7 @@ from areal_tpu.api.config import (
 from areal_tpu.api.dfg import MFCDef, build_graph
 from areal_tpu.api.model_api import GenerationHyperparameters
 from areal_tpu.base.topology import MeshSpec
+from areal_tpu.observability.tracing import TraceConfig
 
 
 @dataclasses.dataclass
@@ -74,6 +75,8 @@ class ModelWorkerConfig:
     use_stream_dataset: bool = False  # async mode: data arrives by push
     stream_group_size: int = 1  # trajectories per prompt (epoch accounting)
     seed: int = 1
+    # flight-recorder knobs (None = ambient process defaults)
+    trace: Optional[TraceConfig] = None
 
 
 @dataclasses.dataclass
@@ -91,6 +94,7 @@ class MasterWorkerConfig:
     # the MFC whose n_seqs defines one train iteration
     train_rpc_name: str = ""
     seed: int = 1
+    trace: Optional[TraceConfig] = None
 
 
 @dataclasses.dataclass
@@ -109,6 +113,7 @@ class RolloutWorkerConfig:
     dataset_seed: int = 1
     rollout_request_timeout: float = 600.0
     new_tokens_per_chunk: int = 1 << 30  # interruptible-generation chunking
+    trace: Optional[TraceConfig] = None
 
 
 @dataclasses.dataclass
@@ -175,6 +180,7 @@ class GenServerConfig:
     coordinator: str = ""  # jax.distributed coordinator host:port
     num_processes: int = 1
     process_id: int = 0
+    trace: Optional[TraceConfig] = None
 
 
 @dataclasses.dataclass
@@ -201,6 +207,7 @@ class GserverManagerConfig:
     # failed (one flaky server must not block the fleet's version bump)
     update_weights_retries: int = 3
     update_weights_retry_backoff_s: float = 0.5
+    trace: Optional[TraceConfig] = None
 
 
 @dataclasses.dataclass
@@ -240,11 +247,21 @@ class ExperimentConfig:
     )
     gserver_manager: Optional[GserverManagerConfig] = None
     evaluator: Optional[EvaluatorConfig] = None
+    # experiment-wide flight-recorder config, propagated to every worker
+    # that does not set its own (None = leave workers on ambient defaults)
+    trace: Optional[TraceConfig] = None
 
     def lazy_init(self):
         """Build the MFC graph and sanity-check worker wiring
         (reference: system_api.py ExperimentConfig.lazy_init :190)."""
         build_graph(self.master.model_rpcs)
+        if self.trace is not None:
+            workers = [self.master, self.gserver_manager]
+            workers += self.model_workers + self.rollout_workers
+            workers += self.gen_servers
+            for w in workers:
+                if w is not None and w.trace is None:
+                    w.trace = self.trace
         self.master.model_worker_names = [
             w.worker_name for w in self.model_workers
         ]

@@ -66,6 +66,7 @@ from areal_tpu.engine.sampling import SamplingParams, sample_logits
 from areal_tpu.models import paged
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import KVCache, decode_step, prefill
+from areal_tpu.observability.tracing import get_tracer
 
 #: back-compat alias: the auto dense/paged crossover now lives in the
 #: (config-overridable, bench-derivable) dispatch table — see
@@ -443,6 +444,11 @@ class ContinuousBatchingEngine:
             self.budgets = jnp.zeros((max_batch,), jnp.int32)
             self.rng = jax.random.PRNGKey(seed)
 
+        # flight recorder: per-request lifecycle events (admit/resume/
+        # fill/chunk/park/preempt/recompute) under the request's trace
+        # root.  The tracer no-ops for unsampled roots (one memoized
+        # dict lookup), keeping the decode hot loop unburdened.
+        self.tracer = get_tracer()
         self.rows: List[Optional[_Row]] = [None] * max_batch
         self._pending: List[model_api.APIGenerateInput] = []
         self._results: Dict[str, model_api.APIGenerateOutput] = {}
@@ -835,6 +841,11 @@ class ContinuousBatchingEngine:
                 for row_id, row in enumerate(self.rows)
                 if row is not None and not row.filling
             ]
+            for rid, _ in entries:
+                self.tracer.event(
+                    self.rows[rid].req.qid, "engine.recompute",
+                    version=self.version,
+                )
             if entries:
                 # existing blocks are overwritten in place; the pending
                 # cur_tokens are untouched (no resampling to discard)
@@ -845,6 +856,11 @@ class ContinuousBatchingEngine:
                 for row_id, row in enumerate(self.rows)
                 if row is not None
             ]
+            for rid, _ in entries:
+                self.tracer.event(
+                    self.rows[rid].req.qid, "engine.recompute",
+                    version=self.version,
+                )
             if entries:
                 self._prefill_rows(entries)
                 # keep the already-sampled pending tokens, discard the
@@ -949,6 +965,7 @@ class ContinuousBatchingEngine:
             self.active = self.active.at[rid].set(True)
             self.budgets = self.budgets.at[rid].set(max_new)
             self.resumed_total += 1
+            self.tracer.event(req.qid, "engine.resume", row=row_id)
             return True
         return False
 
@@ -1014,6 +1031,12 @@ class ContinuousBatchingEngine:
         completed, idxs = [], []
         for i, (f, take) in enumerate(batch):
             f.fill_pos += take
+            if f.targets:  # weight-swap refills (no targets) trace as
+                # engine.recompute, not per-chunk fill events
+                self.tracer.event(
+                    f.targets[0].req.qid, "engine.fill_chunk",
+                    tokens=take, fill_pos=f.fill_pos,
+                )
             if f.fill_pos == len(f.tokens):
                 completed.append(f)
                 idxs.append(i)
@@ -1220,6 +1243,11 @@ class ContinuousBatchingEngine:
             self._set_row_blocks(rid, fill.blocks)
             row.filling = True
             self.rows[rid] = row
+            self.tracer.event(
+                row.req.qid, "engine.admit", row=rid,
+                prompt_len=len(seq), cached_tokens=fill.fill_pos,
+                shared=False, preempt_readmit=True,
+            )
             fill.targets.append(
                 _FillTarget(
                     row_id=rid, req=row.req,
@@ -1274,10 +1302,19 @@ class ContinuousBatchingEngine:
                 self._set_row_blocks(rid, fill.blocks)
                 # canonical blocks live in target 0's table; refcount
                 # stays 1 until extra targets share them
+                self.tracer.event(
+                    req.qid, "engine.admit", row=rid,
+                    prompt_len=len(prompt), cached_tokens=fill.fill_pos,
+                    shared=False,
+                )
             else:
                 # group member joins the in-flight fill: ZERO extra
                 # prefill work (block-reference prompt sharing)
-                pass
+                self.tracer.event(
+                    req.qid, "engine.admit", row=rid,
+                    prompt_len=len(prompt), cached_tokens=fill.fill_pos,
+                    shared=True,
+                )
             fill.targets.append(
                 _FillTarget(row_id=rid, req=req, max_new=max_new)
             )
@@ -1383,6 +1420,10 @@ class ContinuousBatchingEngine:
         self._release_row(row_id)
         self._preempted.append(row)
         self.preempted_total += 1
+        self.tracer.event(
+            row.req.qid, "engine.preempt", row=row_id,
+            cached_tokens=len(row.prompt) + len(row.generated),
+        )
         logger.info(
             "preempted row %d (qid=%s, %d cached tokens) under pool "
             "pressure",
@@ -1507,6 +1548,11 @@ class ContinuousBatchingEngine:
             to_admit.append((free.pop(0), req, prompt, max_new))
         if not to_admit:
             return
+        for rid, req, prompt, _ in to_admit:
+            self.tracer.event(
+                req.qid, "engine.admit", row=rid,
+                prompt_len=len(prompt), cached_tokens=0, shared=False,
+            )
         toks, logps = self._prefill_rows(
             [(rid, prompt) for rid, _, prompt, _ in to_admit]
         )
@@ -1573,6 +1619,11 @@ class ContinuousBatchingEngine:
         elif started:
             self._release_row(row_id)
             self.active = self.active.at[row_id].set(False)
+        self.tracer.event(
+            row.req.qid, "engine.finish",
+            park=bool(started and park), n_tokens=len(row.generated),
+            version_start=row.version_start, version_end=self.version,
+        )
         with self._lock:
             self._results[row.req.qid] = out
             ev = self._result_events.get(row.req.qid)
@@ -1709,6 +1760,11 @@ class ContinuousBatchingEngine:
             row.logprobs.extend(lps)
             row.budget_left -= len(toks)
             n_tokens += len(toks)
+            if toks:
+                self.tracer.event(
+                    row.req.qid, "engine.chunk", row=row_id,
+                    epoch=epoch, n_tokens=len(toks), step=self._step_seq,
+                )
             if not active[row_id]:
                 last = row.generated[-1] if row.generated else -1
                 row.no_eos = last not in self.stop_tokens

@@ -12,10 +12,15 @@ that plane as a real subsystem:
   codebase emits must appear exactly once here
   (``scripts/check_metric_names.py`` lints it, run in tier-1).
 * :mod:`prom_text` — Prometheus text-format renderer + strict parser.
-* :mod:`server` — per-worker HTTP ``/metrics`` endpoint, registered in
-  name_resolve under the ``base/names.py`` metric-server keys.
+* :mod:`server` — per-worker HTTP ``/metrics`` + ``/trace`` endpoint,
+  registered in name_resolve under the ``base/names.py`` metric-server
+  keys.
 * :mod:`aggregator` — master-side discovery + scrape + jsonl snapshot,
   feeding the existing ``base/metrics.py`` sinks.
+* :mod:`tracing` / :mod:`trace_collector` — the distributed flight
+  recorder: per-sample span/event rings on every worker, harvested by a
+  master-owned collector into ``traces.jsonl`` + a Perfetto export, with
+  a stall watchdog (see ``docs/observability.md`` § Tracing).
 """
 
 from areal_tpu.observability.registry import (  # noqa: F401
@@ -26,4 +31,15 @@ from areal_tpu.observability.registry import (  # noqa: F401
     get_registry,
     set_registry,
 )
-from areal_tpu.observability.table import METRIC_TABLE, MetricSpec  # noqa: F401
+from areal_tpu.observability.table import (  # noqa: F401
+    METRIC_TABLE,
+    TRACE_TABLE,
+    MetricSpec,
+    TraceSpec,
+)
+from areal_tpu.observability.tracing import (  # noqa: F401
+    TraceConfig,
+    Tracer,
+    get_tracer,
+    set_tracer,
+)

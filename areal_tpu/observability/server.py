@@ -9,19 +9,25 @@ real Prometheus with a file_sd bridge) can discover it.
 
 Routes:
   ``/metrics``  Prometheus text exposition of the worker's registry
+  ``/trace``    JSON flight-recorder harvest (``?since=<seq>`` cursor);
+                the worker half of the distributed trace plane — same
+                discovery key, same server, zero extra threads
   ``/healthz``  200 "ok" (cheap liveness probe for ops tooling)
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from areal_tpu.base import logging_, name_resolve, names, network
 from areal_tpu.observability.registry import MetricsRegistry, get_registry
+from areal_tpu.observability.tracing import Tracer, get_tracer
 
 logger = logging_.getLogger("metrics_server")
 
@@ -48,17 +54,36 @@ class MetricsServer:
         registry: Optional[MetricsRegistry] = None,
         port: int = 0,
         host: str = "0.0.0.0",
+        tracer: Optional[Tracer] = None,
     ):
         self.registry = registry or get_registry()
+        self.tracer = tracer or get_tracer()
         reg = self.registry
+        trc = self.tracer
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     body = reg.render().encode("utf-8")
                     self.send_response(200)
                     self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/trace":
+                    try:
+                        since = int(
+                            urllib.parse.parse_qs(query)
+                            .get("since", ["0"])[0]
+                        )
+                    except ValueError:
+                        since = 0
+                    body = json.dumps(
+                        trc.snapshot(since), default=str
+                    ).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

@@ -1,13 +1,14 @@
-"""Canonical metric name table.
+"""Canonical metric AND trace name tables.
 
-Single source of truth for every Prometheus series the system emits.  The
-registry resolves HELP text from here, ``docs/observability.md`` renders
-from here, and ``scripts/check_metric_names.py`` (run in tier-1) asserts
-that every name emitted anywhere in the codebase appears EXACTLY once in
-this table — so a typo'd or renamed metric fails CI instead of silently
-forking a series.
+Single source of truth for every Prometheus series the system emits and
+every flight-recorder span/event name it records.  The registry resolves
+HELP text from here, ``docs/observability.md`` renders from here, and
+``scripts/check_metric_names.py`` (run in tier-1) asserts that every name
+emitted anywhere in the codebase appears EXACTLY once in its table — so a
+typo'd or renamed metric/span fails CI instead of silently forking a
+series (or leaving an undocumented trace name nobody can query for).
 
-The table is a *list* (not a dict) precisely so an accidental duplicate
+The tables are *lists* (not dicts) precisely so an accidental duplicate
 entry is representable and the lint can catch it.
 """
 
@@ -286,7 +287,166 @@ METRIC_TABLE = [
         "Failed /metrics scrapes, by endpoint key",
         ("endpoint",),
     ),
+    # -- flight recorder (observability/tracing.py + trace_collector.py) -----
+    MetricSpec(
+        "areal_trace_stall_total",
+        "counter",
+        "Open trace spans flagged by the stall watchdog, by kind "
+        "(span_deadline | buffer_age); each stalled span counts once",
+        ("kind",),
+    ),
+    MetricSpec(
+        "areal_trace_harvest_errors_total",
+        "counter",
+        "Failed /trace harvests, by endpoint key (skip-and-count: a dead "
+        "or garbage endpoint never fails a master step)",
+        ("endpoint",),
+    ),
+    MetricSpec(
+        "areal_trace_events_total",
+        "counter",
+        "Flight-recorder events harvested into traces.jsonl",
+    ),
+    MetricSpec(
+        "areal_train_sample_staleness",
+        "histogram",
+        "Per-trained-sample weight-version lag: current version minus "
+        "the version the sample finished generating under",
+        ("model",),
+    ),
 ]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """One canonical flight-recorder span/event name.  ``kind`` is
+    "span" (recorded via span_begin/span_end/span — a duration) or
+    "event" (instant)."""
+
+    name: str
+    kind: str  # "span" | "event"
+    help: str
+
+
+TRACE_TABLE = [
+    # -- rollout worker / partial rollout ------------------------------------
+    TraceSpec(
+        "rollout.episode",
+        "span",
+        "One rollout episode on the rollout worker: allocate -> agent/env "
+        "loop -> push -> finish (attrs: accepted, pushed)",
+    ),
+    TraceSpec(
+        "rollout.alloc_reject",
+        "event",
+        "allocate_rollout denial observed worker-side (attrs: reason)",
+    ),
+    TraceSpec(
+        "rollout.generate",
+        "span",
+        "One group member's full generation across all chunked "
+        "continuations (attrs: chunks, retries, version_start/end)",
+    ),
+    TraceSpec(
+        "rollout.chunk",
+        "span",
+        "One schedule+generate chunk attempt from the partial-rollout "
+        "client (attrs: attempt, gen_qid, server)",
+    ),
+    TraceSpec(
+        "rollout.retry",
+        "event",
+        "Transient RPC failure during schedule/generate; the trace root "
+        "is force-sampled from here on (attrs: stage, attempt, error)",
+    ),
+    # -- gserver manager -----------------------------------------------------
+    TraceSpec(
+        "gserver.allocate",
+        "event",
+        "Staleness/capacity gate decision for a rollout (attrs: ok, "
+        "reason, version_lag)",
+    ),
+    TraceSpec(
+        "gserver.schedule",
+        "event",
+        "Routing decision for a request (attrs: server, sticky, "
+        "prompt_len, version)",
+    ),
+    TraceSpec(
+        "gserver.finish",
+        "event",
+        "Rollout slot released at the manager (attrs: accepted)",
+    ),
+    # -- generation engine ---------------------------------------------------
+    TraceSpec(
+        "engine.admit",
+        "event",
+        "Request admitted into a cache row (attrs: row, cached_tokens "
+        "from the radix prefix cache, prompt_len)",
+    ),
+    TraceSpec(
+        "engine.resume",
+        "event",
+        "Parked row resumed for a chunked continuation with zero "
+        "prefill (attrs: row)",
+    ),
+    TraceSpec(
+        "engine.fill_chunk",
+        "event",
+        "One chunked-prefill batch advanced this request's fill "
+        "(attrs: tokens, fill_pos)",
+    ),
+    TraceSpec(
+        "engine.chunk",
+        "event",
+        "One harvested decode chunk's tokens folded into this row "
+        "(attrs: row, epoch, n_tokens, step)",
+    ),
+    TraceSpec(
+        "engine.finish",
+        "event",
+        "Row finished or parked; the request's result is ready "
+        "(attrs: park, n_tokens, version_start, version_end)",
+    ),
+    TraceSpec(
+        "engine.preempt",
+        "event",
+        "Row preempted under pool pressure (recompute-on-readmit; "
+        "attrs: row, cached_tokens)",
+    ),
+    TraceSpec(
+        "engine.recompute",
+        "event",
+        "In-flight row's KV re-prefilled under freshly swapped weights "
+        "(attrs: version)",
+    ),
+    # -- master buffer / train -----------------------------------------------
+    TraceSpec(
+        "buffer.resident",
+        "span",
+        "Sample resident in the master sequence buffer, push to final "
+        "consumption (attrs: version = version_end at push)",
+    ),
+    TraceSpec(
+        "buffer.consume",
+        "event",
+        "Sample handed to an MFC from the buffer (attrs: rpc)",
+    ),
+    TraceSpec(
+        "train.consume",
+        "event",
+        "Sample consumed by a train step (attrs: step, staleness, model)",
+    ),
+]
+
+
+def trace_table_index() -> Dict[str, TraceSpec]:
+    out: Dict[str, TraceSpec] = {}
+    for spec in TRACE_TABLE:
+        if spec.name in out:
+            raise ValueError(f"duplicate trace table entry: {spec.name}")
+        out[spec.name] = spec
+    return out
 
 
 def table_index() -> Dict[str, MetricSpec]:

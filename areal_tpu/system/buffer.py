@@ -42,10 +42,15 @@ class AsyncIOSequenceBuffer:
         self._lock = asyncio.Lock()
         self._cond = asyncio.Condition(self._lock)
         from areal_tpu.observability import get_registry
+        from areal_tpu.observability.tracing import get_tracer
 
         reg = get_registry()
         self._m_size = reg.gauge("areal_buffer_size")
         self._m_age = reg.gauge("areal_buffer_oldest_sample_age_seconds")
+        # flight recorder: each sample's residency is an open span from
+        # push to final consumption — the stall watchdog's buffer-age
+        # check reads the version attr recorded at push
+        self._tracer = get_tracer()
 
     def _export_metrics(self):
         """Refresh the scrape gauges (called on every mutation, under the
@@ -81,6 +86,15 @@ class AsyncIOSequenceBuffer:
                         sample=one, birth_time=birth, keys=set(one.keys)
                     )
                     self._id_to_idx[sid] = idx
+                    ver = -1
+                    if one.metadata and "version_end" in one.metadata:
+                        try:
+                            ver = int(one.metadata["version_end"][0])
+                        except (TypeError, ValueError, IndexError):
+                            ver = -1
+                    self._tracer.span_begin(
+                        str(sid), "buffer.resident", version=ver
+                    )
             self._export_metrics()
             self._cond.notify_all()
 
@@ -131,6 +145,10 @@ class AsyncIOSequenceBuffer:
             chosen = ready[:n_seqs]
             for i in chosen:
                 self._slots[i].consumed_by.add(rpc_name)
+                self._tracer.event(
+                    str(self._slots[i].sample.ids[0]), "buffer.consume",
+                    rpc=rpc_name,
+                )
             gathered = SequenceSample.gather(
                 [self._slots[i].sample for i in chosen]
             )
@@ -139,6 +157,9 @@ class AsyncIOSequenceBuffer:
                     sid = self._slots[i].sample.ids[0]
                     del self._id_to_idx[sid]
                     del self._slots[i]
+                    self._tracer.span_end(
+                        str(sid), "buffer.resident", consumed_by=rpc_name
+                    )
             self._export_metrics()
             return chosen, gathered
 
@@ -153,5 +174,9 @@ class AsyncIOSequenceBuffer:
                     done_ids.append(slot.sample.ids[0])
                     del self._id_to_idx[slot.sample.ids[0]]
                     del self._slots[idx]
+                    self._tracer.span_end(
+                        str(slot.sample.ids[0]), "buffer.resident",
+                        consumed_by="*",
+                    )
             self._export_metrics()
         return done_ids

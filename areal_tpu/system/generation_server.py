@@ -41,6 +41,13 @@ class GenerationServerWorker(worker_base.Worker):
         from areal_tpu.engine.dispatch import resolve_dispatch_table
         from areal_tpu.engine.inference_server import ContinuousBatchingEngine
         from areal_tpu.engine.sampling import SamplingParams
+        from areal_tpu.observability import tracing
+
+        # configure BEFORE the engine is built: the engine binds the
+        # process tracer at construction
+        tracing.configure(
+            getattr(config, "trace", None), worker=config.worker_name
+        )
 
         tokenizer = None
         if config.tokenizer_path:

@@ -113,9 +113,15 @@ class GserverManager(worker_base.Worker):
     def _init_metrics(self):
         """Observability: the staleness gate's whole state becomes
         scrapeable (the paper's §2.4 knobs — queue depth, version lag,
-        rejections)."""
+        rejections), and every gate/routing decision lands in the
+        flight recorder under the rollout's trace root."""
         from areal_tpu.observability import get_registry
+        from areal_tpu.observability import tracing
 
+        self._tracer = tracing.configure(
+            getattr(self.config, "trace", None),
+            worker=getattr(self, "worker_name", "gserver_manager"),
+        )
         reg = get_registry()
         self._m_rejects = reg.counter("areal_gserver_alloc_rejections_total")
         self._m_running = reg.gauge("areal_gserver_running_rollouts")
@@ -143,11 +149,28 @@ class GserverManager(worker_base.Worker):
         """Rollout-level key of a member qid: '{qid}-{i}' group members and
         '{qid}@t{j}-{i}' multi-turn members share their rollout's key, so
         the whole group lands on ONE server and the engine's group-prompt
-        KV dedup fires (one prefill per group instead of per member)."""
-        base = qid.rsplit("-", 1)[0] if "-" in qid else qid
-        return base.split("@", 1)[0]
+        KV dedup fires (one prefill per group instead of per member).
+        Delegates to the flight recorder's trace-root derivation — the
+        two MUST agree, or trace assembly and routing affinity group
+        members differently (the manager never sees ``#r`` retry ids;
+        the extra strip is a no-op here)."""
+        from areal_tpu.observability.tracing import member_root
+
+        return member_root(qid)
 
     def _schedule(
+        self, qid: str, prompt_len: int = 0, new_token_budget: int = 0
+    ) -> str:
+        sticky = qid in self._qid_server  # before _inner registers it
+        addr = self._schedule_inner(qid, prompt_len, new_token_budget)
+        self._tracer.event(
+            qid, "gserver.schedule", root=self._group_key(qid),
+            server=addr, sticky=sticky,
+            prompt_len=prompt_len, version=self._model_version,
+        )
+        return addr
+
+    def _schedule_inner(
         self, qid: str, prompt_len: int = 0, new_token_budget: int = 0
     ) -> str:
         if qid in self._qid_server:  # sticky: KV reuse on continuation
@@ -281,6 +304,18 @@ class GserverManager(worker_base.Worker):
         return self.version_lag() > self.config.max_head_offpolicyness
 
     def _allocate_rollout(self, qid: str) -> Dict:
+        resp = self._allocate_rollout_inner(qid)
+        # qid here is the ROLLOUT id (its own trace root); the gate
+        # decision — including the version-lag headroom it judged — is
+        # the first event of a sampled rollout's timeline
+        self._tracer.event(
+            qid, "gserver.allocate", root=qid,
+            ok=resp["ok"], reason=resp["reason"],
+            version_lag=self.version_lag(),
+        )
+        return resp
+
+    def _allocate_rollout_inner(self, qid: str) -> Dict:
         cap = self.config.max_concurrent_rollouts or 10**9
         if self.rollout_stat.running >= cap:
             self._m_rejects.inc(reason="capacity")
@@ -293,6 +328,9 @@ class GserverManager(worker_base.Worker):
         return {"ok": True, "reason": ""}
 
     def _finish_rollout(self, qid: str, accepted: bool):
+        self._tracer.event(
+            qid, "gserver.finish", root=qid, accepted=accepted
+        )
         self.rollout_stat.running = max(0, self.rollout_stat.running - 1)
         if accepted:
             self.rollout_stat.accepted += 1

@@ -100,6 +100,20 @@ class MasterWorker(worker_base.AsyncWorker):
                 constants.get_log_path(), "cluster_metrics.jsonl"
             ),
         )
+        # flight recorder: the master owns the trace collector — one
+        # harvest cycle per train step over the same discovery plane as
+        # the metrics scrape, writing traces.jsonl (+ a Perfetto export
+        # at close) and running the stall watchdog
+        from areal_tpu.observability import tracing
+        from areal_tpu.observability.trace_collector import TraceCollector
+
+        tracing.configure(config.trace, worker=config.worker_name)
+        self._trace_collector = TraceCollector(
+            constants.experiment_name(),
+            constants.trial_name(),
+            out_dir=constants.get_log_path(),
+            config=config.trace,
+        )
 
     async def _lazy_init(self):
         cfg = self.config
@@ -317,6 +331,10 @@ class MasterWorker(worker_base.AsyncWorker):
             cluster = self._cluster_agg.step(step.global_step)
         except Exception:  # noqa: BLE001 - scraping never fails a step
             self.logger.exception("cluster metrics scrape failed")
+        try:
+            self._trace_collector.step(step.global_step)
+        except Exception:  # noqa: BLE001 - tracing never fails a step
+            self.logger.exception("trace harvest failed")
         self._metrics.log({**stats, **cluster}, step.global_step)
         self.logger.info(
             "step %d (epoch %d, %.2fs): %s",
@@ -384,3 +402,11 @@ class MasterWorker(worker_base.AsyncWorker):
             self._util_monitor.stop()
         if hasattr(self, "_cluster_agg"):
             self._cluster_agg.close()
+        if hasattr(self, "_trace_collector"):
+            # final harvest so the tail of the run is in traces.jsonl,
+            # then close (which writes the Perfetto export)
+            try:
+                self._trace_collector.step(self._step_info.global_step)
+            except Exception:  # noqa: BLE001 - best-effort tail harvest
+                pass
+            self._trace_collector.close()

@@ -62,6 +62,11 @@ class ModelWorker(worker_base.Worker):
         self.logger = logging_.getLogger(self.worker_name)
         seeding.set_random_seed(config.seed, self.worker_name)
 
+        from areal_tpu.observability import tracing
+
+        self._tracer = tracing.configure(
+            config.trace, worker=self.worker_name
+        )
         self._stream = WorkerRequestReplyStream(
             constants.experiment_name(),
             constants.trial_name(),
@@ -472,6 +477,7 @@ class ModelWorker(worker_base.Worker):
         try:
             if handle == "train_step":
                 res = interface.train_step(model, data, mb_spec)
+                self._trace_train_consumption(model_name, model, ids)
             elif handle == "inference":
                 res = interface.inference(model, data, mb_spec)
             elif handle == "generate":
@@ -492,6 +498,34 @@ class ModelWorker(worker_base.Worker):
         elif isinstance(res, dict):
             reply["stats"] = res
         return reply
+
+    def _trace_train_consumption(self, model_name: str, model, ids):
+        """Flight recorder: which train step consumed which qids, with
+        per-sample weight-version staleness (current engine version minus
+        the sample's ``version_end``) — the off-policyness the paper's
+        staleness gate bounds, finally measurable per sample."""
+        from areal_tpu.observability.tracing import record_train_consumption
+
+        try:
+            version = int(model.version.global_step)
+            vends = None
+            try:
+                vsample = self._data_manager.get_batch(
+                    list(ids), ["version_end"]
+                )
+                import numpy as _np
+
+                vends = _np.asarray(
+                    vsample.data["version_end"]
+                ).reshape(-1).tolist()
+            except Exception:  # noqa: BLE001 - SFT/DPO have no versions
+                vends = None
+            record_train_consumption(
+                ids, version, vends, version,
+                model=model_name, tracer=self._tracer,
+            )
+        except Exception:  # noqa: BLE001 - tracing never fails a train step
+            self.logger.debug("train consumption trace failed", exc_info=True)
 
     def _mfc_flops_stats(self, model, handle: str, data, res) -> Dict:
         """Analytic FLOPs + token count for the master's throughput logs

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from areal_tpu.api import model_api
 from areal_tpu.base import logging_
+from areal_tpu.observability.tracing import get_tracer
 from areal_tpu.system.generation_server import GenServerClient
 
 logger = logging_.getLogger("partial_rollout")
@@ -43,6 +44,7 @@ class PartialRolloutManager:
         self.max_rpc_retries = max(1, max_rpc_retries)
         self.rpc_retry_backoff_s = max(0.0, rpc_retry_backoff_s)
         self._server_clients: Dict[str, GenServerClient] = {}
+        self._tracer = get_tracer()
 
     def _client(self, addr: str) -> GenServerClient:
         if addr not in self._server_clients:
@@ -53,7 +55,7 @@ class PartialRolloutManager:
 
     async def _gen_chunk(
         self, qid: str, tag: int, prompt_ids: List[int], cur: List[int],
-        chunk: int,
+        chunk: int, root: Optional[str] = None,
     ) -> Tuple[model_api.APIGenerateOutput, int]:
         """Schedule + generate ONE chunk, retrying transient RPC failures
         with capped exponential backoff.  A timed-out schedule or a
@@ -84,6 +86,10 @@ class PartialRolloutManager:
                     min(self.rpc_retry_backoff_s * 2 ** (attempt - 1), 10.0)
                 )
             gen_qid = qid if tag == 0 else f"{qid}#r{tag}"
+            self._tracer.span_begin(
+                qid, "rollout.chunk", root=root,
+                attempt=attempt, gen_qid=gen_qid,
+            )
             try:
                 sched = await asyncio.to_thread(
                     self.manager_client.call,
@@ -101,6 +107,7 @@ class PartialRolloutManager:
                 # here would abandon a parked row the next chunk could
                 # have resumed prefill-free)
                 last_exc = e
+                self._trace_retry(qid, root, "schedule", attempt, e)
                 logger.warning(
                     "transient RPC failure scheduling %s (attempt %d/%d): "
                     "%r",
@@ -116,10 +123,14 @@ class PartialRolloutManager:
                     gconfig=self.gconfig.new(max_new_tokens=chunk, n=1),
                 )
                 out = await asyncio.to_thread(client.generate, inp)
+                self._tracer.span_end(
+                    qid, "rollout.chunk", root=root, server=sched["url"],
+                )
                 return out, tag
             except self.TRANSIENT_ERRORS as e:
                 last_exc = e
                 tag += 1  # gen_qid may have a live orphan row: retire it
+                self._trace_retry(qid, root, "generate", attempt, e)
                 logger.warning(
                     "transient RPC failure generating %s (attempt %d/%d): "
                     "%r",
@@ -128,8 +139,22 @@ class PartialRolloutManager:
         assert last_exc is not None
         raise last_exc
 
+    def _trace_retry(self, qid, root, stage, attempt, exc):
+        """A retry is exactly the lifetime worth attributing: force the
+        whole trace root into the sample set, close the failed chunk
+        span, and record the retry event."""
+        r = root if root is not None else qid
+        self._tracer.force(r)
+        self._tracer.span_end(
+            qid, "rollout.chunk", root=root, failed=stage,
+        )
+        self._tracer.event(
+            qid, "rollout.retry", root=root,
+            stage=stage, attempt=attempt, error=repr(exc),
+        )
+
     async def _gen_one(
-        self, qid: str, prompt_ids: List[int]
+        self, qid: str, prompt_ids: List[int], root: Optional[str] = None
     ) -> model_api.APIGenerateOutput:
         remaining = self.gconfig.max_new_tokens
         cur = list(prompt_ids)
@@ -139,11 +164,14 @@ class PartialRolloutManager:
         version_end = -1
         no_eos = True
         tag = 0  # bumps past ids retired by generate timeouts (see _gen_chunk)
+        n_chunks = 0
+        self._tracer.span_begin(qid, "rollout.generate", root=root)
         while remaining > 0:
             chunk = min(self.new_tokens_per_chunk, remaining)
             out, tag = await self._gen_chunk(
-                qid, tag, prompt_ids, cur, chunk
+                qid, tag, prompt_ids, cur, chunk, root=root
             )
+            n_chunks += 1
             if version_start is None:
                 version_start = out.version_start
             version_end = out.version_end
@@ -154,6 +182,12 @@ class PartialRolloutManager:
             no_eos = out.no_eos
             if not out.no_eos or not out.output_ids:
                 break
+        self._tracer.span_end(
+            qid, "rollout.generate", root=root,
+            chunks=n_chunks, retries=tag, n_tokens=len(out_ids),
+            version_start=version_start if version_start is not None else -1,
+            version_end=version_end,
+        )
         return model_api.APIGenerateOutput(
             qid=qid,
             prompt_ids=list(prompt_ids),
@@ -168,9 +202,12 @@ class PartialRolloutManager:
     async def generate_group(
         self, qid: str, prompt_ids: List[int], group_size: int
     ) -> model_api.BundledGenerationOutputs:
+        # qid is rollout-level ("{rollout}" or "{rollout}@t{j}"): the
+        # trace root is the rollout qid, shared by every member/attempt
+        root = qid.split("@", 1)[0]
         outs = await asyncio.gather(
             *(
-                self._gen_one(f"{qid}-{i}", prompt_ids)
+                self._gen_one(f"{qid}-{i}", prompt_ids, root=root)
                 for i in range(group_size)
             )
         )

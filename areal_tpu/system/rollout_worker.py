@@ -71,11 +71,17 @@ class RolloutWorker(worker_base.AsyncWorker):
         self._alloc_counter = 0
 
         from areal_tpu.observability import get_registry
+        from areal_tpu.observability import tracing
 
         reg = get_registry()
         self._m_episodes = reg.counter("areal_rollout_episodes_total")
         self._m_pushed = reg.counter("areal_rollout_pushed_total")
         self._m_rejected = reg.counter("areal_rollout_alloc_rejected_total")
+        # flight recorder: this worker opens each sampled rollout's
+        # episode span; the PartialRolloutManager below traces the
+        # per-member generation path under the same trace root (the
+        # rollout qid)
+        self._tracer = tracing.configure(config.trace, worker=self.worker_name)
 
     async def _rollout_task(self, qid: str, prompt_sample):
         obs_q: asyncio.Queue = asyncio.Queue()
@@ -95,6 +101,8 @@ class RolloutWorker(worker_base.AsyncWorker):
         self._gen_tasks.add(pump)
         pump.add_done_callback(self._gen_tasks.discard)
         accepted = False
+        pushed = 0
+        self._tracer.span_begin(qid, "rollout.episode", root=qid)
         agent_task = asyncio.create_task(
             self.agent.collect_trajectory(prompt_sample, self.env, obs_q, act_q)
         )
@@ -116,18 +124,28 @@ class RolloutWorker(worker_base.AsyncWorker):
             if accepted:
                 self.pusher.push([t.as_json_compatible() for t in trajs])
                 self.push_count += len(trajs)
+                pushed = len(trajs)
                 self._m_pushed.inc(len(trajs))
         finally:
             if not pump.done():
                 pump.cancel()
-            # always release the manager's rollout slot
-            await asyncio.to_thread(
-                self.manager_client.call,
-                "finish_rollout",
-                {"qid": qid, "accepted": accepted},
-            )
+            # always release the manager's rollout slot; on exit the
+            # client is aborted and the slot dies with the manager
+            try:
+                await asyncio.to_thread(
+                    self.manager_client.call,
+                    "finish_rollout",
+                    {"qid": qid, "accepted": accepted},
+                )
+            except (TimeoutError, ConnectionError, OSError):
+                if not self.exit_requested:
+                    raise
             self.rollout_count += 1
             self._m_episodes.inc()
+            self._tracer.span_end(
+                qid, "rollout.episode", root=qid,
+                accepted=accepted, pushed=pushed,
+            )
 
     async def _poll_async(self) -> worker_base.PollResult:
         # harvest finished tasks (exceptions propagate)
@@ -144,16 +162,43 @@ class RolloutWorker(worker_base.AsyncWorker):
         qid = f"{prompt_sample.ids[0]}#{self.config.dataset_shard[0]}-{self._alloc_counter}"
         self._alloc_counter += 1
         prompt_sample.ids = [qid]
-        resp = await asyncio.to_thread(
-            self.manager_client.call, "allocate_rollout", {"qid": qid}
-        )
+        try:
+            resp = await asyncio.to_thread(
+                self.manager_client.call, "allocate_rollout", {"qid": qid}
+            )
+        except (TimeoutError, ConnectionError, OSError):
+            if self.exit_requested:
+                # exit() aborted the client mid-call so this loop could
+                # observe the flag at all — not a failure
+                return worker_base.PollResult(sample_count=0)
+            raise
         if not resp["ok"]:
             self._m_rejected.inc(reason=resp.get("reason") or "unknown")
+            self._tracer.event(
+                qid, "rollout.alloc_reject", root=qid,
+                reason=resp.get("reason") or "unknown",
+            )
             await asyncio.sleep(0.05)
             return worker_base.PollResult(sample_count=0)
         task = asyncio.create_task(self._rollout_task(qid, prompt_sample))
         self._tasks.add(task)
         return worker_base.PollResult(sample_count=1)
+
+    def exit(self, status=worker_base.WorkerServerStatus.COMPLETED):
+        """Abort in-flight RPC clients at exit-REQUEST time, not exit-hook
+        time: the poll loop itself may be parked inside a client call
+        (allocate_rollout to a gone manager: 60s; a generate to a gone
+        server: up to rollout_request_timeout), and it can only observe
+        the exit flag once that call returns.  Un-aborted, the worker
+        thread lingers for the full RPC timeout after the experiment
+        ends, and ``concurrent.futures``' atexit hook then joins the
+        executor threads those calls run on — the e2e teardown used to
+        pay up to ~600 s of interpreter-shutdown linger for this."""
+        super().exit(status)
+        if hasattr(self, "manager_client"):
+            self.manager_client.close()
+        if hasattr(self, "prm"):
+            self.prm.close()
 
     def _exit_hook(self):
         if hasattr(self, "prm"):

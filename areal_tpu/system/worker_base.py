@@ -75,14 +75,18 @@ class WorkerServer:
             replace=True,
         )
         # observability plane: every worker type serves Prometheus text at
-        # /metrics, discovered via the names.metric_server keys (reference:
-        # the per-group metric servers realhf/system/controller.py:41-74)
+        # /metrics (and the flight-recorder harvest at /trace), discovered
+        # via the names.metric_server keys (reference: the per-group metric
+        # servers realhf/system/controller.py:41-74)
         from areal_tpu.observability import get_registry
         from areal_tpu.observability.server import (
             start_worker_metrics_server,
             worker_group,
         )
+        from areal_tpu.observability.tracing import get_tracer
 
+        self.tracer = get_tracer()
+        self.tracer.worker = worker_name
         self.metrics_registry = get_registry()
         self.metrics_registry.gauge("areal_worker_info").set(
             1, worker=worker_name, group=worker_group(worker_name)
@@ -162,6 +166,11 @@ class WorkerServer:
 
     def close(self):
         self._beat_stop.set()
+        # bounded joins: worker shutdown must not hang on observability
+        # threads (the beat loop wakes within HEARTBEAT_INTERVAL; the
+        # metrics/trace HTTP server's serve_forever poll is 0.25s and its
+        # request handlers are daemons) — e2e teardown budget, not a leak
+        self._beat_thread.join(timeout=HEARTBEAT_INTERVAL + 1)
         self._sock.close(linger=0)
         if self.metrics_server is not None:
             self.metrics_server.stop()
@@ -314,7 +323,9 @@ class Worker:
         return "paused"
 
     def _on_exit(self):
-        self.__exiting = True
+        # route through exit() so subclass overrides (e.g. the rollout
+        # worker aborting in-flight RPCs) fire on the command path too
+        self.exit()
         return "exiting"
 
     # -- subclass contract --------------------------------------------------
@@ -338,6 +349,12 @@ class Worker:
         if self._server:
             self._server.set_status(WorkerServerStatus.IDLE)
         self.logger.debug("%s configured", self.worker_name)
+
+    @property
+    def exit_requested(self) -> bool:
+        """True once exit() was called (poll loops use this to tell an
+        exit-induced RPC abort from a real failure)."""
+        return self.__exiting
 
     def exit(self, status: WorkerServerStatus = WorkerServerStatus.COMPLETED):
         self.__exiting = True

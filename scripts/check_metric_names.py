@@ -1,17 +1,24 @@
 #!/usr/bin/env python3
 """Lint: every emitted metric name appears exactly once in the canonical
-metric name table (areal_tpu/observability/table.py).
+metric name table, and every recorded trace span/event name appears
+exactly once in the canonical trace table (areal_tpu/observability/
+table.py).
 
 "Emitted" = any string literal passed as the first argument of a
-``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")`` call
-anywhere under ``areal_tpu/`` or in ``bench.py``, found by AST walk (so
-formatting/aliasing of the registry object doesn't matter, and dynamically
-computed names are rejected by construction — metric names must be
-literals or the scrape vocabulary becomes unauditable).
+``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")`` call, or
+as the SECOND argument (the first is the trace id) of a
+``.event(tid, "...")`` / ``.span_begin(...)`` / ``.span_end(...)`` /
+``.span(...)`` call, anywhere under ``areal_tpu/`` or in ``bench.py`` /
+``__graft_entry__.py`` — found by AST walk (so formatting/aliasing of
+the registry/tracer object doesn't matter, and dynamically computed
+names are rejected by construction: names must be literals or the
+scrape/trace vocabulary becomes unauditable).
 
-The human-facing metric table in ``docs/observability.md`` is diffed
-against the canonical table too (both directions): docs cannot silently
-drift when a metric is added, renamed, or retired.
+The human-facing tables in ``docs/observability.md`` are diffed against
+the canonical tables too (both directions): docs cannot silently drift
+when a metric or span is added, renamed, or retired.  Metric names are
+``areal_*`` identifiers; trace names are dotted ``layer.name`` pairs —
+disjoint vocabularies, one doc page.
 
 Exit code 0 = clean; 1 = violations (each printed, one per line).  Run in
 tier-1 via tests/observability/test_metric_names_lint.py.
@@ -28,6 +35,9 @@ from typing import Dict, List, Set, Tuple
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _REGISTRY_METHODS = ("counter", "gauge", "histogram")
+#: tracer recording methods: first arg is the trace id, SECOND is the
+#: canonical span/event name
+_TRACER_METHODS = ("event", "span_begin", "span_end", "span")
 
 #: files whose registry-shaped calls are not metric emissions; currently
 #: none — even registry.py's own set_stats emission (areal_stats) is real
@@ -35,7 +45,10 @@ _SKIP_FILES: Tuple[str, ...] = ()
 
 
 def _iter_source_files() -> List[str]:
-    out = [os.path.join(REPO_ROOT, "bench.py")]
+    out = [
+        os.path.join(REPO_ROOT, "bench.py"),
+        os.path.join(REPO_ROOT, "__graft_entry__.py"),
+    ]
     for dirpath, _, filenames in os.walk(
         os.path.join(REPO_ROOT, "areal_tpu")
     ):
@@ -45,9 +58,10 @@ def _iter_source_files() -> List[str]:
     return sorted(out)
 
 
-def collect_emitted_names() -> Dict[str, List[Tuple[str, int]]]:
-    """{metric_name: [(rel_path, lineno), ...]} plus non-literal call sites
-    recorded under the sentinel key ``<non-literal>``."""
+def _collect(methods: Tuple[str, ...], arg_idx: int) -> Dict[str, List[Tuple[str, int]]]:
+    """{name: [(rel_path, lineno), ...]} of string literals at position
+    ``arg_idx`` of ``.method(...)`` calls, plus non-literal call sites
+    under the sentinel key ``<non-literal>``."""
     emitted: Dict[str, List[Tuple[str, int]]] = {}
     for path in _iter_source_files():
         rel = os.path.relpath(path, REPO_ROOT)
@@ -67,11 +81,11 @@ def collect_emitted_names() -> Dict[str, List[Tuple[str, int]]]:
             fn = node.func
             if (
                 not isinstance(fn, ast.Attribute)
-                or fn.attr not in _REGISTRY_METHODS
-                or not node.args
+                or fn.attr not in methods
+                or len(node.args) <= arg_idx
             ):
                 continue
-            arg = node.args[0]
+            arg = node.args[arg_idx]
             if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
                 emitted.setdefault(arg.value, []).append((rel, node.lineno))
             else:
@@ -81,6 +95,16 @@ def collect_emitted_names() -> Dict[str, List[Tuple[str, int]]]:
     return emitted
 
 
+def collect_emitted_names() -> Dict[str, List[Tuple[str, int]]]:
+    return _collect(_REGISTRY_METHODS, 0)
+
+
+def collect_trace_names() -> Dict[str, List[Tuple[str, int]]]:
+    """Span/event name literals recorded through the tracer API (second
+    positional argument — the first is the trace id)."""
+    return _collect(_TRACER_METHODS, 1)
+
+
 DOCS_TABLE = os.path.join(REPO_ROOT, "docs", "observability.md")
 
 #: a documented metric: a backticked `areal_*` name inside a markdown
@@ -88,6 +112,11 @@ DOCS_TABLE = os.path.join(REPO_ROOT, "docs", "observability.md")
 #: ("| `areal_host_load1` / `areal_host_load5` | ...") — every backticked
 #: name on the row counts.
 _DOC_NAME_RE = re.compile(r"`(areal_[a-z0-9_]+)`")
+
+#: a documented trace span/event: a backticked dotted `layer.name` inside
+#: a markdown table row (trace names always contain exactly one dot;
+#: metric names never do, so the vocabularies cannot collide)
+_DOC_TRACE_RE = re.compile(r"`([a-z_]+\.[a-z_]+)`")
 
 
 def collect_documented_names(path: str = DOCS_TABLE) -> Set[str]:
@@ -101,6 +130,25 @@ def collect_documented_names(path: str = DOCS_TABLE) -> Set[str]:
             if not line.lstrip().startswith("| `areal_"):
                 continue
             out.update(_DOC_NAME_RE.findall(line))
+    return out
+
+
+def collect_documented_trace_names(path: str = DOCS_TABLE) -> Set[str]:
+    """Trace names documented in docs/observability.md: markdown table
+    rows whose first cell is EXACTLY one backticked dotted name (prose
+    cells that merely mention a dotted identifier don't count)."""
+    out: Set[str] = set()
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            stripped = line.lstrip()
+            if not stripped.startswith("| `"):
+                continue
+            cell = stripped.split("|")[1].strip()
+            m = _DOC_TRACE_RE.fullmatch(cell)
+            if m:
+                out.add(m.group(1))
     return out
 
 
@@ -159,6 +207,55 @@ def run_lint() -> List[str]:
             f"docs/observability.md documents {name}, which is not in "
             "areal_tpu/observability/table.py METRIC_TABLE (stale doc "
             "row — remove it or add the table entry)"
+        )
+
+    # -- trace span/event vocabulary (same discipline, second table) --------
+    from areal_tpu.observability.table import TRACE_TABLE
+
+    tcounts: Dict[str, int] = {}
+    for spec in TRACE_TABLE:
+        tcounts[spec.name] = tcounts.get(spec.name, 0) + 1
+    for name, n in sorted(tcounts.items()):
+        if n != 1:
+            problems.append(
+                f"trace table: {name} appears {n} times in TRACE_TABLE "
+                "(must be exactly once)"
+            )
+    traced = collect_trace_names()
+    for name, sites in sorted(traced.items()):
+        where = ", ".join(f"{p}:{ln}" for p, ln in sites)
+        if name == "<non-literal>":
+            problems.append(
+                f"non-literal trace span/event name at {where} — trace "
+                "names must be string literals so the table lint can see "
+                "them"
+            )
+            continue
+        if name == "<syntax-error>":
+            continue  # already reported by the metric pass
+        if tcounts.get(name, 0) == 0:
+            problems.append(
+                f"recorded trace name {name} ({where}) is missing from "
+                "areal_tpu/observability/table.py TRACE_TABLE"
+            )
+    traced_names = set(traced) - {"<non-literal>", "<syntax-error>"}
+    for name in sorted(set(tcounts) - traced_names):
+        problems.append(
+            f"trace table entry {name} is never recorded anywhere under "
+            "areal_tpu/, bench.py, or __graft_entry__.py (dead "
+            "vocabulary — remove it or wire the instrument)"
+        )
+    tdocumented = collect_documented_trace_names()
+    for name in sorted(set(tcounts) - tdocumented):
+        problems.append(
+            f"trace name {name} is in TRACE_TABLE but missing from the "
+            "docs/observability.md trace table"
+        )
+    for name in sorted(tdocumented - set(tcounts)):
+        problems.append(
+            f"docs/observability.md documents trace name {name}, which "
+            "is not in TRACE_TABLE (stale doc row — remove it or add "
+            "the table entry)"
         )
     return problems
 

@@ -114,6 +114,95 @@ def test_dead_endpoint_counted_not_fatal(three_live_workers):
     assert errs.value(endpoint="gserver_manager") == 1.0
 
 
+def test_worker_appearing_mid_run(three_live_workers):
+    """A worker that registers AFTER the aggregator's first cycle (late
+    join, restart onto a new port) is picked up by the next cycle's
+    re-discovery — no aggregator restart, no stale endpoint list."""
+    agg = ClusterMetricsAggregator(EXPR, TRIAL)
+    assert len(agg.scrape()) == 3
+    late = MetricsRegistry()
+    late.gauge("areal_buffer_size").set(17)
+    srv = MetricsServer(registry=late).start()
+    srv.register(EXPR, TRIAL, "model_worker_9")
+    try:
+        scraped = agg.scrape()
+        assert "model_worker_9" in scraped
+        flat = agg.flatten(scraped)
+        assert flat["cluster/model_worker_9/areal_buffer_size"] == 17.0
+    finally:
+        srv.stop()
+
+
+def test_worker_disappearing_between_discovery_and_get(three_live_workers):
+    """The subtree scan and the per-key get are not atomic: a key that
+    vanishes in between (worker exiting cleanly deletes its key) must be
+    skipped silently — not an error, not a crash."""
+    from areal_tpu.base import names
+
+    agg = ClusterMetricsAggregator(EXPR, TRIAL)
+    real_get = name_resolve.get
+    victim = names.metric_server(
+        EXPR, TRIAL, "gserver_manager", "gserver_manager"
+    )
+
+    def racing_get(key, **kw):
+        if key == victim:
+            # deleted between find_subtree and get
+            raise name_resolve.NameEntryNotFoundError(key)
+        return real_get(key, **kw)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(name_resolve, "get", racing_get):
+        discovered = agg.discover()
+    assert "gserver_manager" not in discovered
+    assert sorted(discovered) == ["gen_server_0", "model_worker_0"]
+    # and the next (healed) cycle sees it again
+    assert "gserver_manager" in agg.discover()
+
+
+def test_truncated_page_rejected(three_live_workers):
+    """A page cut off mid-line (worker died mid-write, proxy truncation)
+    must fail the strict parse and count as a scrape error — never land
+    half a snapshot."""
+    import http.server
+    import threading
+
+    class Truncated(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = (
+                b"# TYPE areal_buffer_size gauge\n"
+                b"areal_buffer_size 12\n"
+                b"areal_buffer_oldest_sample_age_se"  # cut mid-name
+            )
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Truncated)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        from areal_tpu.base import names
+
+        name_resolve.add(
+            names.metric_server(EXPR, TRIAL, "trunc", "trunc_worker"),
+            f"127.0.0.1:{httpd.server_address[1]}",
+            replace=True,
+        )
+        agg = ClusterMetricsAggregator(EXPR, TRIAL, scrape_timeout=2.0)
+        scraped = agg.scrape()
+        assert "trunc_worker" not in scraped
+        assert len(scraped) == 3
+        errs = agg._registry.counter("areal_aggregator_scrape_errors_total")
+        assert errs.value(endpoint="trunc_worker") == 1.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
 def test_malformed_page_rejected_by_strict_parser(three_live_workers):
     """A worker serving junk (partial write, wrong handler) is an error,
     not silently-wrong numbers."""

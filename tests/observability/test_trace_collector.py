@@ -1,0 +1,310 @@
+"""Master-side trace collector: harvest over the shared /trace endpoints
+(cursor semantics, skip-and-count on dead/garbage workers, workers
+appearing mid-run), traces.jsonl + Perfetto export, timeline
+reconstruction, and the stall watchdog (open-span deadline, buffer-age,
+and the closed-just-in-time false-positive case)."""
+
+import http.server
+import json
+import os
+import threading
+
+import pytest
+
+from areal_tpu.base import constants, name_resolve, names
+from areal_tpu.observability.registry import MetricsRegistry
+from areal_tpu.observability.server import MetricsServer
+from areal_tpu.observability.trace_collector import (
+    StallWatchdog,
+    TraceCollector,
+    load_traces_jsonl,
+    timeline,
+)
+from areal_tpu.observability.tracing import TraceConfig, Tracer
+
+EXPR, TRIAL = "tracetest", "t0"
+
+
+@pytest.fixture(autouse=True)
+def _names():
+    name_resolve.reconfigure("memory")
+    constants.set_experiment_trial_names(EXPR, TRIAL)
+    yield
+
+
+def _worker(wname):
+    """A live worker endpoint: its own tracer + registry behind one HTTP
+    server, registered under the canonical metric-server key."""
+    tracer = Tracer(TraceConfig(sample_rate=1.0), worker=wname)
+    srv = MetricsServer(registry=MetricsRegistry(), tracer=tracer).start()
+    srv.register(EXPR, TRIAL, wname)
+    return tracer, srv
+
+
+def _collector(tmp_path, **kw):
+    kw.setdefault("config", TraceConfig(sample_rate=1.0))
+    kw.setdefault("registry", MetricsRegistry())
+    return TraceCollector(EXPR, TRIAL, out_dir=str(tmp_path), **kw)
+
+
+class TestHarvest:
+    def test_harvest_two_workers_and_cursor(self, tmp_path):
+        ta, sa = _worker("rollout_worker_0")
+        tb, sb = _worker("gen_server_0")
+        try:
+            ta.span_begin("q#0-1", "rollout.episode", root="q#0-1")
+            tb.event("q#0-1-0", "engine.chunk", n_tokens=4)
+            col = _collector(tmp_path)
+            assert col.step(1) == 1  # one CLOSED event; the span is open
+            # second cycle harvests only NEW events (cursor advanced)
+            tb.event("q#0-1-0", "engine.chunk", n_tokens=2)
+            ta.span_end("q#0-1", "rollout.episode", root="q#0-1")
+            assert col.step(2) == 2
+            col.close()
+            events = load_traces_jsonl(str(tmp_path / "traces.jsonl"))
+            assert len(events) == 3
+            # worker identity rides every event
+            assert {e["w"] for e in events} == {
+                "rollout_worker_0", "gen_server_0",
+            }
+            tl = timeline(events, "q#0-1")
+            assert [e["name"] for e in tl] == [
+                "engine.chunk", "engine.chunk", "rollout.episode",
+            ] or len(tl) == 3
+            # perfetto export written at close and schema-valid
+            pf = tmp_path / "trace_perfetto.json"
+            assert pf.exists()
+            from areal_tpu.observability.tracing import validate_trace_events
+
+            assert validate_trace_events(json.loads(pf.read_text())) == []
+        finally:
+            sa.stop()
+            sb.stop()
+
+    def test_worker_appearing_mid_run(self, tmp_path):
+        ta, sa = _worker("rollout_worker_0")
+        servers = [sa]
+        try:
+            ta.event("q#0-1-0", "engine.chunk", n_tokens=1)
+            col = _collector(tmp_path)
+            assert col.step(1) == 1
+            # a new worker registers AFTER the collector started: the
+            # per-cycle re-discovery must pick it up with no restart
+            tb, sb = _worker("gen_server_7")
+            servers.append(sb)
+            tb.event("q#0-1-0", "engine.admit", row=3)
+            assert col.step(2) == 1
+            assert "gen_server_7" in col._cursors
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_worker_disappearing_between_discovery_and_harvest(
+        self, tmp_path
+    ):
+        """The registration outlives the worker (no TTL): the harvest
+        must skip-and-count, never crash the master, and the healthy
+        worker's events still land."""
+        ta, sa = _worker("rollout_worker_0")
+        tb, sb = _worker("gen_server_0")
+        ta.event("q#0-1-0", "engine.chunk", n_tokens=1)
+        # kill gen_server_0 but leave its name-resolve key behind
+        sb._registered_key = None
+        sb.stop()
+        try:
+            reg = MetricsRegistry()
+            col = _collector(tmp_path, registry=reg, harvest_timeout=0.5)
+            assert col.step(1) == 1  # healthy worker harvested
+            errs = reg.counter("areal_trace_harvest_errors_total")
+            assert errs.value(endpoint="gen_server_0") == 1.0
+        finally:
+            sa.stop()
+
+    def test_garbage_payload_skip_and_count(self, tmp_path):
+        """An endpoint serving truncated/garbage bytes where JSON should
+        be is an error increment, not a master crash; its cursor stays
+        put so nothing is lost once it heals."""
+
+        class Garbage(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b'{"worker": "x", "events": [{"truncat'  # cut off
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Garbage)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        ta, sa = _worker("rollout_worker_0")
+        ta.event("q#0-1-0", "engine.chunk", n_tokens=1)
+        name_resolve.add(
+            names.metric_server(EXPR, TRIAL, "junk", "junk_worker"),
+            f"127.0.0.1:{httpd.server_address[1]}",
+            replace=True,
+        )
+        try:
+            reg = MetricsRegistry()
+            col = _collector(tmp_path, registry=reg, harvest_timeout=1.0)
+            assert col.step(1) == 1
+            errs = reg.counter("areal_trace_harvest_errors_total")
+            assert errs.value(endpoint="junk_worker") == 1.0
+            assert "junk_worker" not in col._cursors
+        finally:
+            sa.stop()
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_wellformed_json_wrong_shape_rejected(self, tmp_path):
+        """Parses-but-not-ours payloads (a list, a dict without events)
+        count as garbage too."""
+
+        class WrongShape(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps([1, 2, 3]).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), WrongShape)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        name_resolve.add(
+            names.metric_server(EXPR, TRIAL, "junk", "junk2"),
+            f"127.0.0.1:{httpd.server_address[1]}",
+            replace=True,
+        )
+        try:
+            reg = MetricsRegistry()
+            col = _collector(tmp_path, registry=reg, harvest_timeout=1.0)
+            col.step(1)
+            errs = reg.counter("areal_trace_harvest_errors_total")
+            assert errs.value(endpoint="junk2") == 1.0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_ingest_local(self, tmp_path):
+        t = Tracer(TraceConfig(sample_rate=1.0), worker="dryrun")
+        t.event("q#0-1-0", "engine.chunk", n_tokens=4)
+        col = _collector(tmp_path)
+        assert col.ingest_local(t) == 1
+        assert col.ingest_local(t) == 0  # cursor advanced
+        col.close()
+        assert len(load_traces_jsonl(str(tmp_path / "traces.jsonl"))) == 1
+
+
+class TestStallWatchdog:
+    def _wd(self, reg=None, now=0.0, **cfg_kw):
+        cfg_kw.setdefault("stall_span_timeout_s", 10.0)
+        cfg_kw.setdefault("stall_buffer_versions", 4)
+        reg = reg or MetricsRegistry()
+        clock = lambda: now  # noqa: E731
+        return StallWatchdog(TraceConfig(**cfg_kw), registry=reg), reg
+
+    def _span(self, name="rollout.generate", tid="q-0", ts=0.0,
+              last=None, **attrs):
+        return {
+            "tid": tid, "root": "q", "name": name, "ts": ts,
+            "last_ts": ts if last is None else last, "w": "w0",
+            "attrs": attrs,
+        }
+
+    def test_open_span_past_deadline_flagged_once(self):
+        wd, reg = self._wd()
+        span = self._span()
+        stalls = wd.check([span], now=11.0)
+        assert [s["stall_kind"] for s in stalls] == ["span_deadline"]
+        c = reg.counter("areal_trace_stall_total")
+        assert c.value(kind="span_deadline") == 1.0
+        # same span next cycle: already flagged, not re-counted
+        assert wd.check([span], now=20.0) == []
+        assert c.value(kind="span_deadline") == 1.0
+
+    def test_activity_defers_the_deadline(self):
+        # a decoding qid with recent chunk events is NOT stalled even if
+        # the span has been open far longer than the deadline
+        wd, reg = self._wd()
+        span = self._span(ts=0.0, last=95.0)
+        assert wd.check([span], now=100.0) == []
+
+    def test_closed_just_in_time_never_counted(self):
+        # the false-positive case: the span closes (disappears from the
+        # open set) before it ever crosses the deadline
+        wd, reg = self._wd()
+        span = self._span()
+        assert wd.check([span], now=9.9) == []  # not yet stalled
+        assert wd.check([], now=50.0) == []  # closed: gone from open set
+        c = reg.counter("areal_trace_stall_total")
+        assert c.value(kind="span_deadline") == 0.0
+
+    def test_reopened_span_rearms(self):
+        wd, reg = self._wd()
+        span = self._span()
+        wd.check([span], now=11.0)  # flagged
+        wd.check([], now=12.0)  # closed: flag cleared
+        span2 = self._span(ts=20.0)  # same (tid, name), new incarnation
+        stalls = wd.check([span2], now=40.0)
+        assert len(stalls) == 1
+        c = reg.counter("areal_trace_stall_total")
+        assert c.value(kind="span_deadline") == 2.0
+
+    def test_buffer_age_flagged(self):
+        wd, reg = self._wd()
+        fresh = self._span(
+            name="buffer.resident", tid="q-1", ts=0.0, last=0.0, version=9
+        )
+        stale = self._span(
+            name="buffer.resident", tid="q-2", ts=0.0, last=0.0, version=2
+        )
+        stalls = wd.check([fresh, stale], current_version=10, now=1.0)
+        assert [s["tid"] for s in stalls] == ["q-2"]
+        assert stalls[0]["stall_kind"] == "buffer_age"
+        c = reg.counter("areal_trace_stall_total")
+        assert c.value(kind="buffer_age") == 1.0
+
+    def test_buffer_age_needs_known_versions(self):
+        # version -1 (sample carried none) and unknown current version
+        # must never false-positive
+        wd, reg = self._wd()
+        unversioned = self._span(
+            name="buffer.resident", tid="q-3", version=-1
+        )
+        assert wd.check([unversioned], current_version=100, now=1.0) == []
+        versioned = self._span(
+            name="buffer.resident", tid="q-4", version=0
+        )
+        assert wd.check([versioned], current_version=None, now=1.0) == []
+
+    def test_collector_step_runs_watchdog(self, tmp_path):
+        clock_now = [0.0]
+        tracer = Tracer(
+            TraceConfig(sample_rate=1.0), worker="w0",
+            clock=lambda: clock_now[0],
+        )
+        srv = MetricsServer(
+            registry=MetricsRegistry(), tracer=tracer
+        ).start()
+        srv.register(EXPR, TRIAL, "rollout_worker_0")
+        try:
+            reg = MetricsRegistry()
+            col = TraceCollector(
+                EXPR, TRIAL, out_dir=str(tmp_path),
+                config=TraceConfig(
+                    sample_rate=1.0, stall_span_timeout_s=10.0
+                ),
+                registry=reg,
+                clock=lambda: clock_now[0],
+            )
+            tracer.span_begin("q#0-1", "rollout.episode", root="q#0-1")
+            col.step(1)
+            c = reg.counter("areal_trace_stall_total")
+            assert c.value(kind="span_deadline") == 0.0
+            clock_now[0] = 100.0  # span silent for 100s > 10s deadline
+            col.step(2)
+            assert c.value(kind="span_deadline") == 1.0
+        finally:
+            srv.stop()

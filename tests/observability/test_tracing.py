@@ -1,0 +1,181 @@
+"""Flight-recorder tracer semantics: deterministic qid sampling, trace-root
+derivation for every derived-id shape, ring bounding, span/event recording,
+cursor-based snapshots, and the Perfetto trace_event conversion + schema
+validator (the same validator the multichip dryrun gate runs)."""
+
+import json
+import threading
+
+from areal_tpu.observability.tracing import (
+    TraceConfig,
+    Tracer,
+    member_root,
+    strip_retry,
+    to_trace_events,
+    validate_trace_events,
+)
+
+
+def _tracer(**kw):
+    kw.setdefault("sample_rate", 1.0)
+    return Tracer(TraceConfig(**kw), worker="w0")
+
+
+class TestRoots:
+    def test_member_root_shapes(self):
+        # every derived-id shape maps back to the rollout qid
+        assert member_root("ab12#0-5-0") == "ab12#0-5"  # group member
+        assert member_root("ab12#0-5@t3-1") == "ab12#0-5"  # turn member
+        assert member_root("ab12#0-5-0#r2") == "ab12#0-5"  # retry id
+        assert member_root("ab12#0-5-t2") == "ab12#0-5"  # trajectory id
+        assert strip_retry("q-0#r10") == "q-0"
+        assert strip_retry("q-0") == "q-0"
+
+    def test_sampling_deterministic_across_tracers(self):
+        # two tracers (two processes) agree on every root with zero
+        # coordination — the property that assembles cross-worker traces
+        a = Tracer(TraceConfig(sample_rate=0.5), worker="a")
+        b = Tracer(TraceConfig(sample_rate=0.5), worker="b")
+        roots = [f"q{i}#0-{i}" for i in range(200)]
+        da = [a.sampled(r + "-0") for r in roots]
+        db = [b.sampled(r + "-1") for r in roots]  # different members
+        assert da == db
+        assert 20 < sum(da) < 180  # actually a slice, not all/none
+
+    def test_retry_ids_always_sample(self):
+        t = Tracer(TraceConfig(sample_rate=0.0))
+        assert not t.sampled("q#0-1-0")
+        assert t.sampled("q#0-1-0#r1")  # retry-retired id: forced
+
+    def test_force(self):
+        t = Tracer(TraceConfig(sample_rate=0.0))
+        assert not t.sampled("q#0-1-0", "q#0-1")
+        t.force("q#0-1")
+        assert t.sampled("q#0-1-0", "q#0-1")
+
+    def test_disabled(self):
+        t = Tracer(TraceConfig(enabled=False))
+        t.event("q-0", "engine.chunk", n_tokens=1)
+        assert t.snapshot()["events"] == []
+
+
+class TestRecording:
+    def test_span_records_duration_and_attrs(self):
+        clock = iter([10.0, 13.5]).__next__
+        t = Tracer(TraceConfig(sample_rate=1.0), worker="w0", clock=clock)
+        t.span_begin("q-0", "rollout.generate", root="q", chunks=0)
+        t.span_end("q-0", "rollout.generate", root="q", chunks=3)
+        (e,) = t.snapshot()["events"]
+        assert e["ph"] == "X" and e["ts"] == 10.0 and e["dur"] == 3.5
+        assert e["attrs"]["chunks"] == 3  # end attrs override begin's
+        assert e["root"] == "q" and e["w"] == "w0"
+
+    def test_event_touches_open_spans(self):
+        # activity on a trace keeps its open spans fresh — the signal the
+        # stall watchdog's span-deadline check reads
+        times = iter([0.0, 100.0]).__next__
+        t = Tracer(TraceConfig(sample_rate=1.0), clock=times)
+        t.span_begin("q-0", "rollout.generate", root="q")
+        t.event("q-0", "engine.chunk", n_tokens=4)
+        (span,) = t.open_spans()
+        assert span["ts"] == 0.0 and span["last_ts"] == 100.0
+
+    def test_ring_bounded_drops_counted(self):
+        t = _tracer(ring_size=16)
+        for i in range(50):
+            t.event("q-0", "engine.chunk", i=i)
+        snap = t.snapshot()
+        assert len(snap["events"]) == 16
+        assert snap["dropped"] == 34
+        # the survivors are the NEWEST events
+        assert snap["events"][-1]["attrs"]["i"] == 49
+
+    def test_snapshot_cursor_is_read_only(self):
+        t = _tracer()
+        for i in range(5):
+            t.event("q-0", "engine.chunk", i=i)
+        s1 = t.snapshot(0)
+        assert len(s1["events"]) == 5
+        # same cursor -> same events (a restarted collector loses nothing)
+        assert len(t.snapshot(0)["events"]) == 5
+        t.event("q-0", "engine.chunk", i=5)
+        s2 = t.snapshot(s1["seq"])
+        assert [e["attrs"]["i"] for e in s2["events"]] == [5]
+
+    def test_span_context_manager(self):
+        t = _tracer()
+        with t.span("q-0", "rollout.generate", root="q"):
+            t.event("q-0", "engine.chunk")
+        names = [e["name"] for e in t.snapshot()["events"]]
+        assert names == ["engine.chunk", "rollout.generate"]
+        assert t.open_spans() == []
+
+    def test_thread_safety(self):
+        t = _tracer(ring_size=100000)
+
+        def work(k):
+            for i in range(500):
+                t.event(f"q-{k}", "engine.chunk", i=i)
+
+        threads = [threading.Thread(target=work, args=(k,)) for k in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        snap = t.snapshot()
+        assert len(snap["events"]) == 4000
+        assert snap["dropped"] == 0
+        seqs = [e["seq"] for e in snap["events"]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 4000
+
+
+class TestPerfetto:
+    def _events(self):
+        t = _tracer()
+        t.span_begin("q-0", "rollout.generate", root="q")
+        t.event("q-0", "engine.chunk", n_tokens=4)
+        t.event("q-1", "engine.chunk", n_tokens=2)
+        t.span_end("q-0", "rollout.generate", root="q")
+        return t.snapshot()["events"]
+
+    def test_round_trips_valid_trace_event_json(self):
+        obj = to_trace_events(self._events())
+        assert validate_trace_events(obj) == []
+        # survives a JSON round trip (what the file on disk holds)
+        obj2 = json.loads(json.dumps(obj))
+        assert validate_trace_events(obj2) == []
+        evs = [e for e in obj2["traceEvents"] if e["ph"] != "M"]
+        assert any(e["ph"] == "X" and "dur" in e for e in evs)
+        # lanes: q-0 and q-1 are separate threads of the same process
+        lanes = {(e["pid"], e["tid"]) for e in evs}
+        pids = {p for p, _ in lanes}
+        assert len(pids) == 1 and len(lanes) == 2
+        # metadata names the process after the trace root
+        metas = [e for e in obj2["traceEvents"] if e["ph"] == "M"]
+        assert any(
+            e["name"] == "process_name"
+            and e["args"]["name"] == "trace:q"
+            for e in metas
+        )
+
+    def test_validator_rejects_bad_schemas(self):
+        assert validate_trace_events([]) != []
+        assert validate_trace_events({"nope": 1}) != []
+        assert validate_trace_events({"traceEvents": [{"ph": "Z"}]}) != []
+        # X event without dur
+        bad = {
+            "traceEvents": [
+                {"name": "a", "ph": "X", "pid": 1, "tid": 1, "ts": 0.0}
+            ]
+        }
+        assert any("dur" in p for p in validate_trace_events(bad))
+        # non-int pid
+        bad2 = {
+            "traceEvents": [
+                {
+                    "name": "a", "ph": "i", "pid": "w0", "tid": 1,
+                    "ts": 0.0, "s": "t",
+                }
+            ]
+        }
+        assert validate_trace_events(bad2) != []
