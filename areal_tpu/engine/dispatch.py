@@ -44,6 +44,26 @@ DEFAULT_PAGED_MIN_CACHE_LEN = 2048
 #: assumed (VERDICT r5 #3).
 DISPATCH_NEVER = 1 << 30
 
+#: speculative decoding's per-row spec-on/spec-off threshold: the
+#: acceptance-rate EMA below which a row's drafts are judged not worth
+#: verifying and the row falls back to plain chunked decode.  Like the
+#: other thresholds in this module it should come from a measurement —
+#: bench.py's ``spec_decode_ab`` derives the break-even rate from its
+#: own off/on A/B (:func:`spec_break_even_accept_rate`) — and this
+#: builtin default is deliberately conservative: at k=8 drafts it only
+#: ejects rows whose windows verify ~2 tokens or fewer per pass.
+DEFAULT_SPEC_MIN_ACCEPT_RATE = 0.2
+
+#: measured cost of one speculative verify pass, in plain-decode-step
+#: units (``c`` in :func:`spec_break_even_accept_rate`).  The per-step
+#: batch vote dispatches a verify instead of a decode chunk only when
+#: the EMA-expected emitted tokens per pass exceed ``c x live rows`` —
+#: i.e. the pass out-emits the decode steps it displaces.  A window
+#: runs at prefill arithmetic intensity, so on TPU ``c`` sits near 1-2;
+#: bench.py's ``spec_decode_ab`` reports the measured value per chip so
+#: recipe configs can pin it.
+DEFAULT_SPEC_VERIFY_COST = 2.0
+
 
 @dataclasses.dataclass(frozen=True)
 class PagedDispatchTable:
@@ -93,6 +113,27 @@ def resolve_dispatch_table(
         ),
         source="config",
     )
+
+
+def spec_break_even_accept_rate(
+    verify_cost_over_decode_step: float, max_draft_tokens: int
+) -> float:
+    """Acceptance rate at which speculative decoding stops paying.
+
+    A verify pass over a ``k+1``-token window emits ``a*k + 1`` tokens
+    in expectation (``a`` = acceptance rate) and costs ``c`` plain
+    decode steps' worth of device time (``c`` is a hardware measurement:
+    the window runs at prefill arithmetic intensity, so ``c`` is near 1
+    when decode is weight-read-bound and grows where it is not).  Spec
+    wins iff ``(a*k + 1) / c > 1``, i.e. ``a > (c - 1) / k`` — the
+    threshold the per-row EMA fallback should sit at.  bench.py's
+    ``spec_decode_ab`` reports the measured ``c`` and this derived rate
+    so recipe configs can pin ``spec_decode.min_accept_rate`` to what
+    the chip actually showed.
+    """
+    k = max(int(max_draft_tokens), 1)
+    rate = (float(verify_cost_over_decode_step) - 1.0) / k
+    return min(max(rate, 0.0), 1.0)
 
 
 def derive_dispatch_table(

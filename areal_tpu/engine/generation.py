@@ -26,7 +26,7 @@ from areal_tpu.api import model_api
 from areal_tpu.api.data import SequenceSample
 from areal_tpu.base import jax_compat, logging_
 from areal_tpu.engine.batching import bucket_len
-from areal_tpu.engine.sampling import SamplingParams, sample_logits
+from areal_tpu.engine.sampling import SamplingParams, sample_logits_keyed
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import KVCache, decode_step, prefill
 
@@ -118,10 +118,15 @@ def generate_loop(
             banned[s] = True
         return ~allow & jnp.asarray(banned)[None, :]
 
-    rng, sub = jax.random.split(rng)
+    # sampling is keyed on (row, absolute position of the sampled token):
+    # the random stream is a pure function of (rng, row, position), never
+    # of how many sampling calls preceded it — the same contract as the
+    # serving engine's, so chunking/speculation cannot perturb streams
+    rows = jnp.arange(B, dtype=jnp.int32)
     n_prev0 = jnp.zeros((B,), jnp.int32)
-    first_tok, first_logp = sample_logits(
-        last_logits, sub, sampling, ban_mask=stop_ban_mask(n_prev0)
+    first_tok, first_logp = sample_logits_keyed(
+        last_logits, rng, rows, prompt_lens, sampling,
+        ban_mask=stop_ban_mask(n_prev0),
     )
 
     out_tokens = jnp.zeros((B, max_new_tokens), jnp.int32)
@@ -155,10 +160,13 @@ def generate_loop(
         logits, cache = decode_step(
             params, cfg, s.cur_tokens, s.cache, active=s.active
         )
-        rng, sub = jax.random.split(s.rng)
-        tok, logp = sample_logits(
+        rng = s.rng
+        # post-step cache.lengths IS the sampled token's absolute position
+        tok, logp = sample_logits_keyed(
             logits.astype(jnp.float32),
-            sub,
+            rng,
+            rows,
+            cache.lengths,
             sampling,
             ban_mask=stop_ban_mask(s.n_generated),
         )

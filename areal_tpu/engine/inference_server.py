@@ -28,6 +28,16 @@ Design:
   pause -> load -> resume semantics).  ``version_start``/``version_end``
   record the weight versions a request sampled under (decoupled PPO's
   staleness bookkeeping).
+* ``spec_decode_params`` (paged + greedy) turns on SELF-SPECULATIVE
+  decoding: rows draft their own continuations by n-gram lookup over
+  their token history and one batched paged-prefill VERIFY pass scores
+  up to ``max_draft_tokens`` drafts per step (engine/spec_decode.py) —
+  token-identical to plain greedy decode, with a measured per-step
+  batch vote and per-row acceptance-EMA fallback bounding the worst
+  case at the plain chunked path.  Sampling randomness is keyed on
+  (request seed, absolute position) from a fixed base key, so
+  chunking / row placement /
+  pipelining / acceptance length can never perturb sampled streams.
 * ``cache_mode="paged"`` (auto at >= 2k context) replaces the dense rows
   with a shared BLOCK POOL + per-row block tables
   (areal_tpu/models/paged.py — the paged/radix-cache role of the
@@ -46,6 +56,7 @@ import dataclasses
 import threading
 import time
 import uuid
+import zlib
 from collections import deque
 from functools import partial
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
@@ -56,13 +67,14 @@ import numpy as np
 
 from areal_tpu.api import model_api
 from areal_tpu.base import jax_compat, logging_
-from areal_tpu.engine.batching import bucket_len
+from areal_tpu.engine import spec_decode
+from areal_tpu.engine.batching import bucket_len, spec_window_bucket
 from areal_tpu.engine.dispatch import (
     DEFAULT_PAGED_MIN_CACHE_LEN,
     PagedDispatchTable,
 )
 from areal_tpu.engine.prefix_cache import PrefixMatch, RadixPrefixCache
-from areal_tpu.engine.sampling import SamplingParams, sample_logits
+from areal_tpu.engine.sampling import SamplingParams, sample_logits_keyed
 from areal_tpu.models import paged
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import KVCache, decode_step, prefill
@@ -78,17 +90,30 @@ PAGED_MIN_CACHE_LEN = DEFAULT_PAGED_MIN_CACHE_LEN
 def _sample_rows(
     logits: jax.Array,  # [F, V]
     src: jax.Array,  # [n] which logits row each target samples from
-    rng: jax.Array,
+    seeds: jax.Array,  # [n] per-REQUEST sampler key identity
+    positions: jax.Array,  # [n] absolute position of the sampled token
+    rng: jax.Array,  # the engine's FIXED sampling base key
     sampling: SamplingParams,
 ):
     """First-token sampling for fill targets (each group member draws its
-    own independent token from the shared prompt's final logits)."""
-    tok, logp = sample_logits(
-        logits[src].astype(jnp.float32), rng, sampling
+    own independent token from the shared prompt's final logits).  Keyed
+    on (request seed, position) so the draw matches what a decode step
+    for the same request at the same position would have drawn —
+    chunking- and placement-invariant streams."""
+    tok, logp = sample_logits_keyed(
+        logits[src].astype(jnp.float32), rng, seeds, positions, sampling
     )
     return tok, logp
 
 logger = logging_.getLogger("inference_server")
+
+
+def _qid_seed(qid: str) -> int:
+    """Per-request sampler-key identity: deterministic across processes
+    (SPMD controllers replay identical streams) and unique per request,
+    so a freed-and-reused cache row never hands a later same-prompt
+    request its predecessor's random draws."""
+    return zlib.crc32(qid.encode()) & 0x7FFFFFFF
 
 
 class _nullctx:
@@ -129,6 +154,10 @@ class _Row:
     # freed-and-reused between dispatch and harvest (park->resume, or
     # finish->new admission) carries a different epoch and is skipped
     epoch: int = 0
+    # speculative decoding: the row's n-gram draft index + acceptance EMA
+    # (lazily created; survives park/resume/preempt — history never
+    # rewrites).  None until the row first drafts.
+    spec: Optional[spec_decode.SpecRowState] = None
 
 
 @dataclasses.dataclass
@@ -172,10 +201,17 @@ class _InflightChunk:
     it.  ``snapshot`` is the dispatch-time ``(row_id, epoch)`` occupancy:
     the harvest folds outputs ONLY into rows whose epoch still matches
     (a slot freed-and-reused mid-ring carries a different epoch and is
-    skipped — the harvest-identity invariant)."""
+    skipped — the harvest-identity invariant).
+
+    ``spec_meta`` marks a speculative VERIFY chunk: ``{row_id: (qid,
+    n_drafted)}`` for its participants.  Verify chunks share the decode
+    chunks' output signature/semantics, so the harvest folds them in
+    identically — the meta only drives acceptance bookkeeping (EMA,
+    counters, the ``decode.verify`` span)."""
 
     arrs: Tuple[Any, ...]
     snapshot: List[Tuple[int, int]]
+    spec_meta: Optional[Dict[int, Tuple[str, int]]] = None
 
 
 @partial(jax.jit, static_argnames=("cfg", "sampling"), donate_argnums=(2,))
@@ -187,6 +223,7 @@ def _admit_rows(
     lengths: jax.Array,  # [m]
     rows: jax.Array,  # [n] target cache rows; >= B entries are dropped
     src: jax.Array,  # [n] which unique prompt each target row copies
+    seeds: jax.Array,  # [n] per-request sampler key identity
     rng: jax.Array,
     sampling: SamplingParams,
 ) -> Tuple[KVCache, jax.Array, jax.Array]:
@@ -211,8 +248,12 @@ def _admit_rows(
     v = cache.v.at[:, rows, :, :T].set(mini.v[:, src], mode="drop")
     new_lengths = cache.lengths.at[rows].set(lengths[src], mode="drop")
     last = logits[:, 0]  # [m, V]
-    tok, logp = sample_logits(
-        last[src].astype(jnp.float32), rng, sampling
+    # keyed on (request seed, prompt length): the first generated
+    # token's draw is a pure function of the engine seed and the
+    # request's (identity, position), like every later token's —
+    # admission batching cannot perturb streams
+    tok, logp = sample_logits_keyed(
+        last[src].astype(jnp.float32), rng, seeds, lengths[src], sampling
     )
     return KVCache(k=k, v=v, lengths=new_lengths), tok, logp
 
@@ -229,6 +270,7 @@ def _decode_chunk(
     cur_tokens: jax.Array,  # [B]
     active: jax.Array,  # [B] bool
     budgets: jax.Array,  # [B] remaining new tokens (incl. pending cur)
+    row_seeds: jax.Array,  # [B] per-request sampler key identity
     rng: jax.Array,
     chunk_size: int,
     stop_tokens: Tuple[int, ...],
@@ -252,6 +294,14 @@ def _decode_chunk(
             stop |= tok == s
         return stop
 
+    # position-keyed sampling: ``rng`` is the engine's FIXED base key and
+    # each draw is keyed on (request seed, absolute position), so the
+    # random stream never depends on how many chunk dispatches produced
+    # a position (pipeline depth / chunk size / speculative tail steps)
+    # nor on which cache row the request landed in
+    def keyed_sample(logits, _sub, positions, seeds):
+        return sample_logits_keyed(logits, rng, seeds, positions, sampling)
+
     if cfg.sliding_window is None or chunk_size <= cfg.sliding_window:
         from areal_tpu.models.transformer import decode_chunk
 
@@ -264,17 +314,19 @@ def _decode_chunk(
             budgets,
             rng,
             chunk_size,
-            lambda logits, sub: sample_logits(logits, sub, sampling),
+            keyed_sample,
             is_stop,
             attn_len=attn_len,
+            row_seeds=row_seeds,
         )
 
     def body(i, state):
         cache, cur, active, budgets, out_t, out_l, emitted, rng = state
         logits, new_cache = decode_step(params, cfg, cur, cache, active=active)
         rng, sub = jax.random.split(rng)
-        tok, logp = sample_logits(
-            logits.astype(jnp.float32), sub, sampling
+        # post-step lengths IS the sampled token's absolute position
+        tok, logp = keyed_sample(
+            logits.astype(jnp.float32), sub, new_cache.lengths, row_seeds
         )
         tok = jnp.where(active, tok, 0)
         out_t = out_t.at[:, i].set(tok)
@@ -320,6 +372,7 @@ class ContinuousBatchingEngine:
         prefix_cache: bool = True,
         prefix_cache_capacity_frac: float = 0.5,
         prefix_cache_min_tokens: int = 1,
+        spec_decode_params: Optional[spec_decode.SpecDecodeParams] = None,
     ):
         """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
         shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
@@ -341,9 +394,21 @@ class ContinuousBatchingEngine:
         chunk's fetch with the next chunk's device time; K>=3 keeps the
         device fed even when the output-fetch RTT exceeds a chunk's own
         device time (high-latency tunnels).  Token streams are identical
-        across K under greedy sampling; under temperature sampling the
-        rng SPLIT SEQUENCE depends on how many speculative tail chunks
-        get dispatched, so distributions match but streams may not.
+        across K under ANY sampling mode: every draw is keyed on
+        (request seed, absolute position) from a fixed base key
+        (sampling.py
+        ``sample_logits_keyed``), so the stream is a pure function of
+        the seed — how many chunk/speculative dispatches produced a
+        position cannot perturb it.
+
+        ``spec_decode_params`` (paged + greedy only) enables
+        self-speculative decoding: rows draft their own continuations by
+        n-gram lookup over their token history and a batched paged
+        verify pass (engine/spec_decode.py) scores up to
+        ``max_draft_tokens`` drafts per step at prefill cost — output is
+        token-identical to plain greedy decode, and rows whose
+        acceptance EMA drops below the dispatch threshold fall back to
+        plain chunked decode.
         ``kv_pool_tokens`` sizes the paged pool (default: dense-equivalent
         ``max_batch * kv_cache_len``; set smaller to serve long contexts a
         dense cache could never reserve).  ``prefill_chunk_tokens`` bounds
@@ -425,7 +490,44 @@ class ContinuousBatchingEngine:
         self.stop_tokens = tuple(sorted(stop))
         self.version = 0
 
+        # speculative decoding: paged-path + greedy-exactness gates
+        self._spec: Optional[spec_decode.SpecDecodeParams] = None
+        if spec_decode_params is not None and spec_decode_params.enabled:
+            if not self.paged:
+                logger.warning(
+                    "spec_decode requested but cache_mode resolved to "
+                    "dense; speculative decoding runs on the paged path "
+                    "only — disabled"
+                )
+            elif not self.sampling.greedy:
+                logger.warning(
+                    "spec_decode requested with non-greedy sampling; "
+                    "draft verification is exact under greedy decode "
+                    "only — disabled"
+                )
+            else:
+                self._spec = spec_decode_params
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
+        self.spec_rejected_total = 0
+        self.spec_verify_chunks_total = 0
+        self.spec_fallback_rows_total = 0
+        # (row, verify) participations WITH drafts — the denominator for
+        # per-row emitted-tokens-per-pass (a verify chunk batches many
+        # rows, so verify_chunks_total is the wrong unit for that)
+        self.spec_draft_row_passes_total = 0
+        # recent per-verify acceptance fractions, drained by the worker
+        # into the areal_inference_spec_accept_rate histogram
+        self._spec_accept_samples: Deque[float] = deque(maxlen=1024)
+
         with jax.default_device(device) if device is not None else _nullctx():
+            # ONE fixed base key for every sampling draw: draws are keyed
+            # on (request seed, position) from it, so streams are
+            # invariant to
+            # chunking / pipeline depth / speculative acceptance length
+            self._sample_base_rng = jax.random.fold_in(
+                jax.random.PRNGKey(seed), 1
+            )
             if self.paged:
                 self._init_paged_state(
                     page_size, kv_pool_tokens, prefill_chunk_tokens
@@ -442,6 +544,12 @@ class ContinuousBatchingEngine:
             self.cur_tokens = jnp.zeros((max_batch,), jnp.int32)
             self.active = jnp.zeros((max_batch,), bool)
             self.budgets = jnp.zeros((max_batch,), jnp.int32)
+            # per-request sampler key identity of each row's occupant
+            # (crc32 of the qid, set at admit/resume/fill-activation)
+            self.row_seeds = jnp.zeros((max_batch,), jnp.int32)
+            # legacy split-chain key: no sampler reads it anymore (every
+            # draw is position-keyed off _sample_base_rng), kept only so
+            # external probes of engine state keep working
             self.rng = jax.random.PRNGKey(seed)
 
         # flight recorder: per-request lifecycle events (admit/resume/
@@ -479,6 +587,11 @@ class ContinuousBatchingEngine:
         self.hold_admissions = False
         self._step_seq = 0  # deterministic clock (one tick per step())
         self._epoch_counter = 0  # admission/resume stamp source
+        # lifetime tokens folded in by harvests; step() reports its own
+        # delta of this so tokens harvested by MID-STEP ring drains
+        # (speculative re-drafting, weight swaps, preemption flushes)
+        # are never lost from the step's return value
+        self._tokens_harvested_total = 0
         # the in-flight chunk ring: dispatched-but-unharvested decode
         # chunks, FIFO, at most ``pipeline_depth`` deep
         self._ring: Deque[_InflightChunk] = deque()
@@ -549,9 +662,14 @@ class ContinuousBatchingEngine:
         # stable closures: paged_decode_chunk caches its jit on their ids
         sampling_ref = self.sampling
         stop_ref = self.stop_tokens
+        base_rng_ref = self._sample_base_rng
 
-        def _sample(logits, sub):
-            return sample_logits(logits, sub, sampling_ref)
+        def _sample(logits, _sub, positions, seeds):
+            # position-keyed: the draw for (request seed, position) is a
+            # pure function of the engine seed (see sample_logits_keyed)
+            return sample_logits_keyed(
+                logits, base_rng_ref, seeds, positions, sampling_ref
+            )
 
         def _stop(tok):
             stop = jnp.zeros_like(tok, dtype=bool)
@@ -877,14 +995,26 @@ class ContinuousBatchingEngine:
             self.n_inflight,
         )
 
-    def _prefill_rows(self, entries: List[Tuple[int, List[int]]]):
+    def _prefill_rows(
+        self,
+        entries: List[Tuple[int, List[int]]],
+        seeds: Optional[List[int]] = None,
+    ):
         """Batched prefill of ``(row_id, token_seq)`` entries; returns the
         per-entry sampled next token and its logprob (np arrays).
 
         Entries sharing an identical token sequence (a sampling group's n
         copies of one prompt) are deduplicated: the model runs each unique
-        sequence once and the KV is scattered to every target row."""
+        sequence once and the KV is scattered to every target row.
+
+        ``seeds`` are the per-entry request sampler keys; None derives
+        them from the resident rows (the weight-swap re-prefill, whose
+        resamples are discarded anyway)."""
         n = len(entries)
+        if seeds is None:
+            seeds = [
+                _qid_seed(self.rows[rid].req.qid) for rid, _ in entries
+            ]
         uniq: Dict[Tuple[int, ...], int] = {}
         src_idx = []
         for _, seq in entries:
@@ -903,10 +1033,11 @@ class ContinuousBatchingEngine:
             lens[i] = len(key)
         rows = np.full((n_pad,), self.max_batch, np.int32)  # OOB -> dropped
         src = np.zeros((n_pad,), np.int32)
+        seed_arr = np.zeros((n_pad,), np.int32)
         for i, (rid, _) in enumerate(entries):
             rows[i] = rid
             src[i] = src_idx[i]
-        self.rng, sub = jax.random.split(self.rng)
+            seed_arr[i] = seeds[i]
         self.cache, tok, logp = _admit_rows(
             self.params,
             self.cfg,
@@ -915,7 +1046,8 @@ class ContinuousBatchingEngine:
             jnp.asarray(lens),
             jnp.asarray(rows),
             jnp.asarray(src),
-            sub,
+            jnp.asarray(seed_arr),
+            self._sample_base_rng,
             self.sampling,
         )
         self.prefill_calls += 1
@@ -964,6 +1096,9 @@ class ContinuousBatchingEngine:
             self.cur_tokens = self.cur_tokens.at[rid].set(row.cur_token)
             self.active = self.active.at[rid].set(True)
             self.budgets = self.budgets.at[rid].set(max_new)
+            self.row_seeds = self.row_seeds.at[rid].set(
+                _qid_seed(req.qid)
+            )
             self.resumed_total += 1
             self.tracer.event(req.qid, "engine.resume", row=row_id)
             return True
@@ -1157,11 +1292,19 @@ class ContinuousBatchingEngine:
             n = len(sample_targets)
             n_pad = 1 << (n - 1).bit_length()
             src_idx = np.zeros((n_pad,), np.int32)
-            for i, (_, _, li) in enumerate(sample_targets):
+            tgt_seeds = np.zeros((n_pad,), np.int32)
+            tgt_pos = np.zeros((n_pad,), np.int32)
+            for i, (f_i, tgt_i, li) in enumerate(sample_targets):
                 src_idx[i] = li
-            self.rng, sub = jax.random.split(self.rng)
+                tgt_seeds[i] = _qid_seed(tgt_i.req.qid)
+                tgt_pos[i] = len(f_i.tokens)
             toks, logps = _sample_rows(
-                logits, jnp.asarray(src_idx), sub, self.sampling
+                logits,
+                jnp.asarray(src_idx),
+                jnp.asarray(tgt_seeds),
+                jnp.asarray(tgt_pos),
+                self._sample_base_rng,
+                self.sampling,
             )
             toks = np.asarray(toks)[:n]
             logps = np.asarray(logps)[:n]
@@ -1200,10 +1343,14 @@ class ContinuousBatchingEngine:
             curs = np.array([a[1] for a in activation], np.int32)
             buds = np.array([a[2] for a in activation], np.int32)
             lens = np.array([a[3] for a in activation], np.int32)
+            seeds = np.array(
+                [_qid_seed(a[4].req.qid) for a in activation], np.int32
+            )
             self.cur_tokens = self.cur_tokens.at[ids].set(curs)
             self.active = self.active.at[ids].set(True)
             self.budgets = self.budgets.at[ids].set(buds)
             self.kv_lengths = self.kv_lengths.at[ids].set(lens)
+            self.row_seeds = self.row_seeds.at[ids].set(seeds)
 
     def _admit_paged(self):
         if self.hold_admissions:
@@ -1330,6 +1477,11 @@ class ContinuousBatchingEngine:
         active rows (recompute-on-readmit, the deterministic analogue of
         vLLM's recompute preemption)."""
         W = self.chunk_size
+        if self._spec is not None:
+            # a speculative verify window may write up to max_draft + 1
+            # slots in one dispatch; coverage must hold for whichever
+            # chunk kind this step dispatches
+            W = max(W, self._spec.max_draft_tokens + 1)
         # every un-harvested chunk that snapshot a row may advance it by
         # up to W more tokens the host has not folded in yet (row_id
         # match only: the device does not know epochs — any chunk
@@ -1458,7 +1610,6 @@ class ContinuousBatchingEngine:
         if self._tables_dirty:
             self._tables = jnp.asarray(self._tables_np)
             self._tables_dirty = False
-        self.rng, sub = jax.random.split(self.rng)
         (
             self.k_pool,
             self.v_pool,
@@ -1469,7 +1620,7 @@ class ContinuousBatchingEngine:
             cur,
             self.active,
             self.budgets,
-            self.rng,
+            _,
         ) = paged.paged_decode_chunk(
             self.params,
             self.k_pool,
@@ -1480,7 +1631,9 @@ class ContinuousBatchingEngine:
             self.cur_tokens,
             self.active,
             self.budgets,
-            sub,
+            # FIXED base key: the engine's sampler keys each draw on
+            # (request seed, position) from it — dispatch-count invariant
+            self._sample_base_rng,
             self.chunk_size,
             self._paged_sample_fn,
             self._paged_stop_fn,
@@ -1489,11 +1642,180 @@ class ContinuousBatchingEngine:
             mesh=self.mesh,
             kv_axis=getattr(self, "_kv_axis", None),
             deep_kernel=self._use_deep_kernel(),
+            row_seeds=self.row_seeds,
         )
         self.cur_tokens = cur
         self._enqueue_chunk(
             out_t, out_l, emitted, self.active, self.cur_tokens, snapshot
         )
+
+    # -- speculative decoding (paged path) -----------------------------------
+
+    def _spec_row_state(self, row: _Row) -> spec_decode.SpecRowState:
+        if row.spec is None:
+            row.spec = spec_decode.SpecRowState()
+        return row.spec
+
+    def _dispatch_spec_step(self) -> bool:
+        """One speculative dispatch round, decided by a per-step BATCH
+        VOTE: either every live row rides ONE verify window (rows with
+        drafts verify them; draftless/fallback rows ride along with a
+        0-length draft, whose position-0 correction IS a plain decode
+        step), or every live row takes a plain decode chunk — never a
+        mix, because a mixed step serializes a full W-step chunk with
+        each verify pass and fragments the batch both dispatches live
+        on.  The vote is measured-dispatch logic (engine/dispatch.py):
+        a verify pass costs ``verify_cost_over_decode_step`` plain
+        steps, so it wins iff the EMA-expected emission beats that per
+        live row.  Rows that keep missing are excluded by the per-row
+        EMA fallback and draft-miss cooldowns, so a non-repetitive wave
+        quickly votes plain every step and keeps the spec-off pipeline
+        (including its full ring depth — the quiesce below only fires
+        when a row actually wants to draft).  Returns True if anything
+        was dispatched."""
+        assert self._spec is not None
+        spec = self._spec
+        candidates = {
+            rid for rid, r in enumerate(self.rows)
+            if r is not None and not r.parked and not r.filling
+            and self._spec_row_state(r).wants_draft(self._step_seq)
+        }
+        # drafting reads the exact host history: fold in any un-harvested
+        # chunk covering a row that is about to draft
+        while self._ring and any(
+            rid in candidates
+            for ch in self._ring
+            for rid, _ in ch.snapshot
+        ):
+            self._harvest_oldest()
+        live: List[int] = []
+        drafts: Dict[int, List[int]] = {}
+        attempted: List[int] = []
+        expected = 0.0
+        for rid, row in enumerate(self.rows):
+            if row is None or row.parked or row.filling:
+                continue
+            live.append(rid)
+            st = self._spec_row_state(row)
+            if rid in candidates:
+                attempted.append(rid)
+                d = st.draft(row.prompt + row.generated, spec)
+                if d:
+                    drafts[rid] = d
+                    expected += 1.0 + st.ema * len(d)
+                    continue
+            expected += 1.0
+        if not live:
+            return False
+        spec_won = bool(drafts) and (
+            expected >= spec.verify_cost_over_decode_step * len(live)
+        )
+        # a draft attempt was "productive" only if it hit AND the batch
+        # voted spec: misses and vote losses both cool the row down, so
+        # a lone drafter in a spec-hostile batch stops forcing the ring
+        # quiesce every step (the pipeline keeps its depth)
+        for rid in attempted:
+            self.rows[rid].spec.note_draft_result(
+                spec_won and rid in drafts, self._step_seq
+            )
+        if spec_won:
+            self._dispatch_verify_chunk(live, drafts)
+        else:
+            self._dispatch_chunk_paged()
+        return True
+
+    def _dispatch_verify_chunk(
+        self, live_rows: List[int], drafts: Dict[int, List[int]]
+    ):
+        """Dispatch ONE batched verify window over every live row
+        (engine/spec_decode.paged_verify_chunk): rows in ``drafts``
+        verify their proposals; the rest ride with a 0-length draft
+        (their correction token is exactly one plain decode step, so
+        nobody stalls).  The window width buckets to the longest draft
+        this step, the outputs enter the ring as an ordinary chunk
+        (async fetch started at dispatch), and acceptance bookkeeping
+        happens at harvest."""
+        snapshot = [(i, self.rows[i].epoch) for i in live_rows]
+        C = spec_window_bucket(
+            1 + max(len(d) for d in drafts.values())
+        )
+        draft_arr = np.zeros((self.max_batch, C - 1), np.int32)
+        draft_lens = np.zeros((self.max_batch,), np.int32)
+        parts = np.zeros((self.max_batch,), bool)
+        meta: Dict[int, Tuple[str, int]] = {}
+        for rid in live_rows:
+            parts[rid] = True
+            d = drafts.get(rid)
+            if not d:
+                continue
+            d = d[: C - 1]
+            draft_arr[rid, : len(d)] = d
+            draft_lens[rid] = len(d)
+            qid = self.rows[rid].req.qid
+            meta[rid] = (qid, len(d))
+            self.tracer.event(qid, "decode.draft", row=rid, tokens=len(d))
+            self.tracer.span_begin(
+                qid, "decode.verify", row=rid, drafted=len(d)
+            )
+        if self._tables_dirty:
+            self._tables = jnp.asarray(self._tables_np)
+            self._tables_dirty = False
+        (
+            self.k_pool,
+            self.v_pool,
+            self.kv_lengths,
+            out_t,
+            out_l,
+            emitted,
+            cur,
+            self.active,
+            self.budgets,
+        ) = spec_decode.paged_verify_chunk(
+            self.params,
+            self.k_pool,
+            self.v_pool,
+            self.cfg,
+            self._tables,
+            self.kv_lengths,
+            self.cur_tokens,
+            jnp.asarray(draft_arr),
+            jnp.asarray(draft_lens),
+            jnp.asarray(parts),
+            self.active,
+            self.budgets,
+            max_draft=C - 1,
+            stop_tokens=self.stop_tokens,
+            sampling=self.sampling,
+            use_kernel=self._use_paged_kernel,
+            max_len=self.kv_cache_len,
+            mesh=self.mesh,
+            kv_axis=getattr(self, "_kv_axis", None),
+        )
+        self.cur_tokens = cur
+        self.spec_verify_chunks_total += 1
+        self.spec_drafted_total += int(draft_lens.sum())
+        self._enqueue_chunk(
+            out_t, out_l, emitted, self.active, self.cur_tokens, snapshot,
+            spec_meta=meta,
+        )
+
+    def spec_stats(self) -> Dict[str, int]:
+        """Cumulative speculative-decoding counters (worker scrape)."""
+        return {
+            "drafted_total": self.spec_drafted_total,
+            "accepted_total": self.spec_accepted_total,
+            "rejected_total": self.spec_rejected_total,
+            "verify_chunks_total": self.spec_verify_chunks_total,
+            "draft_row_passes_total": self.spec_draft_row_passes_total,
+            "fallback_rows_total": self.spec_fallback_rows_total,
+        }
+
+    def drain_spec_accept_samples(self) -> List[float]:
+        """Pop the recent per-verify acceptance fractions (the worker
+        feeds them to the acceptance-rate histogram)."""
+        out = list(self._spec_accept_samples)
+        self._spec_accept_samples.clear()
+        return out
 
     def _admit(self):
         if self.hold_admissions:
@@ -1554,9 +1876,11 @@ class ContinuousBatchingEngine:
                 prompt_len=len(prompt), cached_tokens=0, shared=False,
             )
         toks, logps = self._prefill_rows(
-            [(rid, prompt) for rid, _, prompt, _ in to_admit]
+            [(rid, prompt) for rid, _, prompt, _ in to_admit],
+            seeds=[_qid_seed(req.qid) for _, req, _, _ in to_admit],
         )
         started_ids, started_curs, started_budgets = [], [], []
+        started_seeds = []
         for (row_id, req, prompt, max_new), tok_i, logp in zip(
             to_admit, toks.tolist(), logps.tolist()
         ):
@@ -1579,6 +1903,7 @@ class ContinuousBatchingEngine:
             started_ids.append(row_id)
             started_curs.append(tok_i)
             started_budgets.append(max_new - 1)
+            started_seeds.append(_qid_seed(req.qid))
         if started_ids:
             ids = np.array(started_ids, np.int32)
             self.cur_tokens = self.cur_tokens.at[ids].set(
@@ -1587,6 +1912,9 @@ class ContinuousBatchingEngine:
             self.active = self.active.at[ids].set(True)
             self.budgets = self.budgets.at[ids].set(
                 np.array(started_budgets, np.int32)
+            )
+            self.row_seeds = self.row_seeds.at[ids].set(
+                np.array(started_seeds, np.int32)
             )
 
     def _finish(
@@ -1658,7 +1986,6 @@ class ContinuousBatchingEngine:
             (i, r.epoch) for i, r in enumerate(self.rows)
             if r is not None and not r.parked
         ]
-        self.rng, sub = jax.random.split(self.rng)
         (
             self.cache,
             out_t,
@@ -1667,7 +1994,7 @@ class ContinuousBatchingEngine:
             self.cur_tokens,
             self.active,
             self.budgets,
-            self.rng,
+            _,
         ) = _decode_chunk(
             self.params,
             self.cfg,
@@ -1675,7 +2002,10 @@ class ContinuousBatchingEngine:
             self.cur_tokens,
             self.active,
             self.budgets,
-            sub,
+            self.row_seeds,
+            # the FIXED base key: draws are keyed on (request seed,
+            # position) inside — dispatch-count invariant
+            self._sample_base_rng,
             self.chunk_size,
             self.stop_tokens,
             self.sampling,
@@ -1688,7 +2018,8 @@ class ContinuousBatchingEngine:
         )
 
     def _enqueue_chunk(
-        self, out_t, out_l, emitted, active_dev, cur_dev, snapshot
+        self, out_t, out_l, emitted, active_dev, cur_dev, snapshot,
+        spec_meta=None,
     ):
         """Append a dispatched chunk to the in-flight ring and START its
         device->host output copy.  The copy rides under the device time
@@ -1705,7 +2036,9 @@ class ContinuousBatchingEngine:
         )
         if jax_compat.start_host_copies(arrs):
             self.async_fetches_total += 1
-        self._ring.append(_InflightChunk(arrs=arrs, snapshot=snapshot))
+        self._ring.append(
+            _InflightChunk(arrs=arrs, snapshot=snapshot, spec_meta=spec_meta)
+        )
 
     def _drain_ring(self) -> int:
         """Harvest EVERY in-flight chunk, oldest first (pipeline flush:
@@ -1747,11 +2080,15 @@ class ContinuousBatchingEngine:
         self.time_fetch_s += t_fetched - t_ready
         self.chunks_total += 1
         n_tokens = 0
+        spec_meta = chunk.spec_meta
         for row_id, epoch in snapshot:
             row = self.rows[row_id]
             # skip freed-and-reused slots: the dispatch-time occupant is
             # gone and this chunk says nothing about the new one
             if row is None or row.parked or row.epoch != epoch:
+                if spec_meta is not None and row_id in spec_meta:
+                    qid, _ = spec_meta[row_id]
+                    self.tracer.span_end(qid, "decode.verify", emitted=0)
                 continue
             cols = emitted[row_id]
             toks = out_t[row_id][cols].tolist()
@@ -1760,6 +2097,23 @@ class ContinuousBatchingEngine:
             row.logprobs.extend(lps)
             row.budget_left -= len(toks)
             n_tokens += len(toks)
+            if spec_meta is not None and row_id in spec_meta:
+                qid, drafted = spec_meta[row_id]
+                # every emitted token but the last is a confirmed draft;
+                # the last is the verifier's own (correction or bonus)
+                n_acc = max(0, len(toks) - 1)
+                self.spec_draft_row_passes_total += 1
+                self.spec_accepted_total += n_acc
+                self.spec_rejected_total += max(0, drafted - n_acc)
+                self._spec_accept_samples.append(n_acc / max(drafted, 1))
+                if row.spec is not None and row.spec.observe(
+                    n_acc, drafted, self._spec
+                ):
+                    self.spec_fallback_rows_total += 1
+                self.tracer.span_end(
+                    qid, "decode.verify",
+                    accepted=n_acc, emitted=len(toks),
+                )
             if toks:
                 self.tracer.event(
                     row.req.qid, "engine.chunk", row=row_id,
@@ -1778,6 +2132,7 @@ class ContinuousBatchingEngine:
                 self._finish(row_id, row, park=park)
             else:
                 row.cur_token = int(cur[row_id])
+        self._tokens_harvested_total += n_tokens
         return n_tokens
 
     def _worth_dispatching(self) -> bool:
@@ -1827,9 +2182,12 @@ class ContinuousBatchingEngine:
         policy is dispatch-count-based only (ring full, or nothing left
         to dispatch) — never readiness probes, so SPMD follower
         controllers replaying the command stream take identical branches.
-        Returns the number of tokens emitted (from the harvested chunk;
-        0 on ring-filling warm-up steps)."""
+        Returns the number of tokens emitted — every token any harvest
+        folded in during this step, including mid-step ring drains
+        (speculative re-drafting, weight swaps, preemption flushes); 0
+        on ring-filling warm-up steps."""
         self._step_seq += 1
+        h0 = self._tokens_harvested_total
         if self._paused.is_set():
             # drain the whole ring so pause means quiesced (untimed: the
             # idle-pause sleep would otherwise read as host overhead)
@@ -1854,8 +2212,11 @@ class ContinuousBatchingEngine:
                     and len(self._ring) < self.pipeline_depth
                     and self._worth_dispatching()
                 ):
-                    self._dispatch_chunk_paged()
-                    dispatched = True
+                    if self._spec is not None:
+                        dispatched = self._dispatch_spec_step()
+                    else:
+                        self._dispatch_chunk_paged()
+                        dispatched = True
             else:
                 self._admit()
                 dispatched = False
@@ -1869,8 +2230,8 @@ class ContinuousBatchingEngine:
             if len(self._ring) >= self.pipeline_depth or (
                 not dispatched and self._ring
             ):
-                return self._harvest_oldest()
-            return 0
+                self._harvest_oldest()
+            return self._tokens_harvested_total - h0
         finally:
             dt = time.perf_counter() - tik
             self.time_host_s += max(

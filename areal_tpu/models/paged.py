@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from areal_tpu.engine.sampling import call_sample_fn
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import (
     Params,
@@ -133,41 +134,38 @@ def _prefix_partials(
     return reference_paged_partials(q, kl, vl, tables, lengths)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "use_kernel", "mesh", "kv_axis"),
-    donate_argnums=(1, 2),
-)
-def paged_fill_chunk(
+def paged_window_forward(
     params: Params,
     k_pool: jax.Array,  # [L, NB, Hkv, BS, hd]
     v_pool: jax.Array,
     cfg: TransformerConfig,
-    tokens: jax.Array,  # [F, C] this chunk's tokens (right-padded)
-    starts: jax.Array,  # [F] tokens already cached per row (chunk offset)
-    chunk_lens: jax.Array,  # [F] valid tokens in this chunk
+    tokens: jax.Array,  # [F, C] window tokens (right-padded)
+    starts: jax.Array,  # [F] tokens already cached per row (window offset)
+    valid: jax.Array,  # [F, C] bool: positions to compute + scatter
     tables: jax.Array,  # [F, MB] pool block ids
     use_kernel: bool,
     mesh=None,
     kv_axis=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One prefill chunk for F filling rows.
+    """Forward a short token WINDOW for F rows over their cached paged
+    prefixes: in-window causal self-attention merged online with the
+    paged kernel's partials over ``[0, start)``, window KV scattered into
+    the rows' pool blocks (invalid positions dropped).  Shared core of
+    chunked prefill (:func:`paged_fill_chunk`) and the speculative-decode
+    verify step (engine/spec_decode.py) — verify IS a batched paged
+    prefill of the draft window, so both paths ride the same attention
+    math and the same pool scatter.  Returns ``(x [F, C, D], k_pool,
+    v_pool)`` with ``x`` the final hidden states (pre-head).
 
-    Each row's chunk tokens attend causally within the chunk AND over the
-    row's already-cached prefix ``[0, start)`` via paged partials — an
-    exact continuation of the row's prefill no matter how the prompt was
-    split into chunks.  Chunk KV is scattered into the rows' pool blocks
-    (the engine pre-allocated blocks covering ``start + chunk_len``).
-
-    Returns ``(last_logits [F, V], k_pool, v_pool)`` — logits at each
-    row's LAST valid chunk position (only meaningful on a row's final
-    chunk, where the engine samples the first generated token).
-    """
+    Callers jit this (it is not jitted itself); the pools thread through
+    donated args of the enclosing jit."""
     F, C = tokens.shape
     L, NB, Hkv, BS, hd = k_pool.shape
     r = cfg.n_q_heads // Hkv
     positions = starts[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
-    valid = jnp.arange(C)[None, :] < chunk_lens[:, None]  # [F, C]
+    # masked rows must stream zero prefix blocks (their ``starts`` may be
+    # any live length — e.g. non-participant rows of a verify window)
+    read_lens = jnp.where(valid[:, 0], starts, 0)
     x = _embed(params, cfg, tokens, positions)
     rope_cs = (
         None
@@ -194,7 +192,7 @@ def paged_fill_chunk(
         h = _norm(x, lp["attn_norm"], cfg)
         q, k, v = _attn_qkv(cfg, lp, h, positions, rope_cs)
         acc_p, m_p, l_p = _prefix_partials(
-            q, k_pool, v_pool, tables, starts, l, use_kernel,
+            q, k_pool, v_pool, tables, read_lens, l, use_kernel,
             mesh=mesh, kv_axis=kv_axis,
         )
         # in-chunk causal scores (C <= ~1k keeps [F,Hq,C,C] small)
@@ -243,6 +241,45 @@ def paged_fill_chunk(
         (x, k_pool, v_pool),
         (params["layers"], jnp.arange(L)),
     )
+    return x, k_pool, v_pool
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "use_kernel", "mesh", "kv_axis"),
+    donate_argnums=(1, 2),
+)
+def paged_fill_chunk(
+    params: Params,
+    k_pool: jax.Array,  # [L, NB, Hkv, BS, hd]
+    v_pool: jax.Array,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [F, C] this chunk's tokens (right-padded)
+    starts: jax.Array,  # [F] tokens already cached per row (chunk offset)
+    chunk_lens: jax.Array,  # [F] valid tokens in this chunk
+    tables: jax.Array,  # [F, MB] pool block ids
+    use_kernel: bool,
+    mesh=None,
+    kv_axis=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One prefill chunk for F filling rows.
+
+    Each row's chunk tokens attend causally within the chunk AND over the
+    row's already-cached prefix ``[0, start)`` via paged partials — an
+    exact continuation of the row's prefill no matter how the prompt was
+    split into chunks.  Chunk KV is scattered into the rows' pool blocks
+    (the engine pre-allocated blocks covering ``start + chunk_len``).
+
+    Returns ``(last_logits [F, V], k_pool, v_pool)`` — logits at each
+    row's LAST valid chunk position (only meaningful on a row's final
+    chunk, where the engine samples the first generated token).
+    """
+    C = tokens.shape[1]
+    valid = jnp.arange(C)[None, :] < chunk_lens[:, None]  # [F, C]
+    x, k_pool, v_pool = paged_window_forward(
+        params, k_pool, v_pool, cfg, tokens, starts, valid, tables,
+        use_kernel=use_kernel, mesh=mesh, kv_axis=kv_axis,
+    )
     last_idx = jnp.maximum(chunk_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)
     logits = _head(params, cfg, x_last)[:, 0]  # [F, V]
@@ -269,13 +306,14 @@ def paged_decode_chunk(
     budgets: jax.Array,  # [B] remaining new tokens (incl. pending cur)
     rng: jax.Array,
     chunk_size: int,
-    sample_fn,  # (logits_f32 [B,V], rng) -> (tokens [B] i32, logps [B] f32)
+    sample_fn,  # (logits_f32 [B,V], rng[, positions[, row_seeds]])
     stop_fn,  # (tokens [B]) -> [B] bool
     use_kernel: bool,
     max_len: int,
     mesh=None,
     kv_axis=None,
     deep_kernel: bool = False,
+    row_seeds: Optional[jax.Array] = None,  # [B] per-request sampler keys
 ):
     """Generate up to ``chunk_size`` tokens for all active rows device-side
     over the paged pool (the paged twin of ``transformer.decode_chunk``).
@@ -373,7 +411,10 @@ def paged_decode_chunk(
         )
         logits = _head(params, cfg, x)[:, 0]
         rng, sub = jax.random.split(rng)
-        tok, logp = sample_fn(logits.astype(jnp.float32), sub)
+        tok, logp = call_sample_fn(
+            sample_fn, logits.astype(jnp.float32), sub, lengths_ + 1,
+            row_seeds,
+        )
         tok = jnp.where(active, tok, 0)
         out_t = out_t.at[:, i].set(tok)
         out_l = out_l.at[:, i].set(jnp.where(active, logp, 0.0))

@@ -39,6 +39,7 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from areal_tpu.base import logging_
+from areal_tpu.engine.sampling import call_sample_fn
 from areal_tpu.models.config import TransformerConfig
 
 logger = logging_.getLogger("transformer")
@@ -884,9 +885,10 @@ def decode_chunk(
     budgets: jax.Array,  # [B] remaining new tokens (incl. pending cur)
     rng: jax.Array,
     chunk_size: int,
-    sample_fn,  # (logits_f32 [B,V], rng) -> (tokens [B] i32, logps [B] f32)
+    sample_fn,  # (logits_f32 [B,V], rng[, positions[, row_seeds]])
     stop_fn,  # (tokens [B]) -> [B] bool
     attn_len: Optional[int] = None,
+    row_seeds: Optional[jax.Array] = None,  # [B] per-request sampler keys
 ):
     """Generate up to ``chunk_size`` tokens for all active rows device-side.
 
@@ -1047,7 +1049,12 @@ def decode_chunk(
         )
         logits = _head(params, cfg, x)[:, 0]
         rng, sub = jax.random.split(rng)
-        tok, logp = sample_fn(logits.astype(jnp.float32), sub)
+        # position-aware samplers receive each sampled token's absolute
+        # position (cur sits at ``lengths``; its successor at lengths+1)
+        tok, logp = call_sample_fn(
+            sample_fn, logits.astype(jnp.float32), sub, lengths + 1,
+            row_seeds,
+        )
         tok = jnp.where(active, tok, 0)
         out_t = out_t.at[:, i].set(tok)
         out_l = out_l.at[:, i].set(jnp.where(active, logp, 0.0))

@@ -111,6 +111,43 @@ METRIC_TABLE = [
         "Pool blocks currently referenced by the radix prefix cache",
     ),
     MetricSpec(
+        "areal_inference_spec_draft_tokens_total",
+        "counter",
+        "Draft tokens proposed by self-speculative n-gram drafting "
+        "(per verify window, before verification)",
+    ),
+    MetricSpec(
+        "areal_inference_spec_accepted_tokens_total",
+        "counter",
+        "Draft tokens confirmed by the batched paged verify pass "
+        "(each saves one full decode step)",
+    ),
+    MetricSpec(
+        "areal_inference_spec_rejected_tokens_total",
+        "counter",
+        "Draft tokens the verify pass diverged from (truncated at the "
+        "first mismatch; the verifier's own token is emitted instead)",
+    ),
+    MetricSpec(
+        "areal_inference_spec_verify_chunks_total",
+        "counter",
+        "Speculative verify windows dispatched (each is one batched "
+        "paged prefill over the participating rows' drafts)",
+    ),
+    MetricSpec(
+        "areal_inference_spec_fallback_rows_total",
+        "counter",
+        "Rows whose acceptance-rate EMA fell below the spec-decode "
+        "threshold and dropped back to plain chunked decode",
+    ),
+    MetricSpec(
+        "areal_inference_spec_accept_rate",
+        "histogram",
+        "Per-verify-window acceptance fraction (accepted / drafted) — "
+        "the live readout of whether self-drafting pays on this "
+        "workload",
+    ),
+    MetricSpec(
         "areal_inference_inflight_rows",
         "gauge",
         "Rows currently decoding or chunk-filling",
@@ -401,6 +438,19 @@ TRACE_TABLE = [
         "event",
         "One harvested decode chunk's tokens folded into this row "
         "(attrs: row, epoch, n_tokens, step)",
+    ),
+    TraceSpec(
+        "decode.draft",
+        "event",
+        "Self-speculative n-gram draft proposed for a row "
+        "(attrs: row, tokens)",
+    ),
+    TraceSpec(
+        "decode.verify",
+        "span",
+        "One speculative verify window, dispatch to harvest: a batched "
+        "paged prefill of the row's draft (attrs: row, drafted, "
+        "accepted, emitted)",
     ),
     TraceSpec(
         "engine.finish",

@@ -41,6 +41,7 @@ class GenerationServerWorker(worker_base.Worker):
         from areal_tpu.engine.dispatch import resolve_dispatch_table
         from areal_tpu.engine.inference_server import ContinuousBatchingEngine
         from areal_tpu.engine.sampling import SamplingParams
+        from areal_tpu.engine.spec_decode import resolve_spec_params
         from areal_tpu.observability import tracing
 
         # configure BEFORE the engine is built: the engine binds the
@@ -89,7 +90,10 @@ class GenerationServerWorker(worker_base.Worker):
         elif config.device_idx is not None:
             device = jax.devices()[config.device_idx % len(jax.devices())]
         model = make_model(config.model, None, None, tokenizer=tokenizer)
-        sampling = SamplingParams(temperature=config.temperature)
+        sampling = SamplingParams(
+            temperature=config.temperature,
+            greedy=getattr(config, "greedy", False),
+        )
         self.engine = ContinuousBatchingEngine(
             model.model_cfg,
             model.init_params,
@@ -112,6 +116,9 @@ class GenerationServerWorker(worker_base.Worker):
             prefix_cache=config.prefix_cache,
             prefix_cache_capacity_frac=config.prefix_cache_capacity_frac,
             prefix_cache_min_tokens=config.prefix_cache_min_match_tokens,
+            spec_decode_params=resolve_spec_params(
+                getattr(config, "spec_decode", None)
+            ),
         )
 
         self._ctx = zmq.Context.instance()
@@ -207,6 +214,21 @@ class GenerationServerWorker(worker_base.Worker):
             "prefix_evictions": reg.counter(
                 "areal_inference_prefix_cache_evictions_total"
             ),
+            "spec_drafted": reg.counter(
+                "areal_inference_spec_draft_tokens_total"
+            ),
+            "spec_accepted": reg.counter(
+                "areal_inference_spec_accepted_tokens_total"
+            ),
+            "spec_rejected": reg.counter(
+                "areal_inference_spec_rejected_tokens_total"
+            ),
+            "spec_verify_chunks": reg.counter(
+                "areal_inference_spec_verify_chunks_total"
+            ),
+            "spec_fallback_rows": reg.counter(
+                "areal_inference_spec_fallback_rows_total"
+            ),
             "inflight": reg.gauge("areal_inference_inflight_rows"),
             "pending": reg.gauge("areal_inference_pending_requests"),
             "version": reg.gauge("areal_inference_weight_version"),
@@ -214,11 +236,16 @@ class GenerationServerWorker(worker_base.Worker):
             "inflight_chunks": reg.gauge("areal_inference_inflight_chunks"),
             "prefix_blocks": reg.gauge("areal_inference_prefix_cache_blocks"),
         }
+        self._obs_accept_hist = reg.histogram(
+            "areal_inference_spec_accept_rate",
+            buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        )
         self._obs_last: Dict[str, float] = {}
 
     def _export_engine_metrics(self):
         eng = self.engine
         pstats = eng.prefix_cache_stats()
+        sstats = eng.spec_stats()
         totals = {
             "chunks": float(eng.chunks_total),
             "host": eng.time_host_s,
@@ -232,12 +259,19 @@ class GenerationServerWorker(worker_base.Worker):
             "prefix_misses": float(pstats["misses_total"]),
             "prefix_cached_tokens": float(pstats["cached_tokens_total"]),
             "prefix_evictions": float(pstats["evictions_total"]),
+            "spec_drafted": float(sstats["drafted_total"]),
+            "spec_accepted": float(sstats["accepted_total"]),
+            "spec_rejected": float(sstats["rejected_total"]),
+            "spec_verify_chunks": float(sstats["verify_chunks_total"]),
+            "spec_fallback_rows": float(sstats["fallback_rows_total"]),
         }
         for key, total in totals.items():
             delta = total - self._obs_last.get(key, 0.0)
             if delta > 0:
                 self._obs[key].inc(delta)
                 self._obs_last[key] = total
+        for frac in eng.drain_spec_accept_samples():
+            self._obs_accept_hist.observe(frac)
         self._obs["inflight"].set(eng.n_inflight)
         self._obs["pending"].set(eng.n_pending)
         self._obs["version"].set(eng.version)
@@ -355,6 +389,12 @@ class GenerationServerWorker(worker_base.Worker):
             **{
                 f"prefix_cache_{k}": v
                 for k, v in self.engine.prefix_cache_stats().items()
+            },
+            # self-speculative decoding: draft/accept volume, verify
+            # passes, EMA fallbacks
+            **{
+                f"spec_{k}": v
+                for k, v in self.engine.spec_stats().items()
             },
             # decode-loop host/device/fetch attribution (cumulative s)
             **{

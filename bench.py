@@ -579,6 +579,154 @@ def bench_prefix_cache_ab(
     }
 
 
+def bench_spec_decode_ab(
+    cfg,
+    params,
+    batches=(32, 64),
+    prompt_len=512,
+    max_new=256,
+    motif_len=12,
+    motif_alphabet=2,
+    page=256,
+    chunk=64,
+    max_draft=7,
+):
+    """Self-speculative decoding A/B on a REPETITIVE-trace workload
+    (engine/spec_decode.py): decode tok/s with n-gram draft + batched
+    paged verify ON vs OFF, per batch size, under GREEDY sampling (the
+    mode speculative decode is exact in).  Prompts tile a per-row
+    random motif over a SMALL token alphabet: greedy decode from such
+    low-entropy context settles into near-periodic output even for the
+    bench's random-weight models — the synthetic proxy for what trained
+    models do on real math/code traces, which is the regime n-gram
+    drafting feeds on (the reported ``accept_rate`` makes the regime
+    explicit).  Both arms submit identical prompts; the timed phase
+    starts after admission/prefill completes, so the ratio isolates
+    decode.
+
+    Reported per batch: decode tok/s per arm, ``spec_over_off`` (the
+    acceptance bar tracks >= 1.3x here), the measured acceptance rate,
+    ``accepted_tokens_per_step`` (tokens emitted per verify pass), and
+    ``derived_min_accept_rate`` — the break-even EMA threshold implied
+    by the measured verify-vs-decode cost, the number recipe configs pin
+    into ``GenServerConfig.spec_decode.min_accept_rate``
+    (engine/dispatch.spec_break_even_accept_rate)."""
+    import zlib
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine.dispatch import spec_break_even_accept_rate
+    from areal_tpu.engine.sampling import SamplingParams
+    from areal_tpu.engine.spec_decode import SpecDecodeParams
+
+    def submit_repetitive(eng, B, tag):
+        for i in range(B):
+            # motif seeded by ROW ONLY: warmup and timed waves (and both
+            # arms) decode identical traces, so every window bucket the
+            # timed wave touches is compiled by the warmup
+            rng = np.random.default_rng(zlib.crc32(f"row{i}".encode()))
+            alpha = min(motif_alphabet, cfg.vocab_size)
+            motif = rng.integers(0, alpha, (motif_len,)).tolist()
+            ids = (motif * (prompt_len // motif_len + 1))[:prompt_len]
+            eng.submit(
+                APIGenerateInput(
+                    qid=f"{tag}{i}",
+                    prompt_ids=ids,
+                    input_ids=ids,
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=max_new, greedy=True
+                    ),
+                )
+            )
+
+    def decode_timed(eng):
+        """(tokens, seconds) of the post-admission decode phase."""
+        while eng.has_work and (eng.n_pending > 0 or eng._filling):
+            eng.step()
+        t0 = time.perf_counter()
+        n = 0
+        while eng.has_work:
+            n += eng.step()
+        eng.drain_results()
+        return n, time.perf_counter() - t0
+
+    def arm(B, spec_on, tag):
+        eng = make_engine(
+            cfg, params, B, prompt_len, max_new, chunk=chunk,
+            cache_mode="paged",
+            page_size=page,
+            sampling=SamplingParams(greedy=True),
+            spec_decode_params=(
+                SpecDecodeParams(enabled=True, max_draft_tokens=max_draft)
+                if spec_on
+                else None
+            ),
+        )
+        submit_repetitive(eng, B, f"w{tag}")  # warmup: compiles
+        drain(eng)
+        submit_repetitive(eng, B, tag)
+        n, dt = decode_timed(eng)
+        row = {
+            "decode_toks_per_sec": round(n / max(dt, 1e-9), 1),
+            "decode_tokens": int(n),
+        }
+        if spec_on:
+            s = eng.spec_stats()
+            row["accept_rate"] = round(
+                s["accepted_total"] / max(s["drafted_total"], 1), 3
+            )
+            # PER-ROW tokens emitted per verify pass (1 correction +
+            # accepted drafts), the quantity dispatch.py's a*k+1 model
+            # describes — a verify chunk batches many rows, so dividing
+            # by chunks would overstate this by ~the batch size
+            row["accepted_tokens_per_step"] = round(
+                (s["accepted_total"] + s["draft_row_passes_total"])
+                / max(s["draft_row_passes_total"], 1),
+                2,
+            )
+            row["verify_chunks"] = int(s["verify_chunks_total"])
+            row["fallback_rows"] = int(s["fallback_rows_total"])
+        del eng
+        return row
+
+    out = {
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+        "motif_len": motif_len,
+        "motif_alphabet": motif_alphabet,
+        "max_draft_tokens": max_draft,
+        "workload": (
+            "repetitive-trace (tiled per-row small-alphabet motif), "
+            "greedy"
+        ),
+    }
+    for B in batches:
+        off = arm(B, False, f"so{B}_")
+        on = arm(B, True, f"sn{B}_")
+        ratio = round(
+            on["decode_toks_per_sec"]
+            / max(off["decode_toks_per_sec"], 1e-9),
+            3,
+        )
+        a = on.get("accept_rate", 0.0)
+        tokens_per_pass = 1.0 + a * max_draft
+        # measured verify cost in plain-decode-step units, backed out of
+        # the A/B itself: on/off = tokens_per_pass / c
+        c = tokens_per_pass / max(ratio, 1e-9)
+        out[f"b{B}"] = {
+            "spec_off": off,
+            "spec_on": on,
+            "spec_over_off": ratio,
+            "verify_cost_over_decode_step": round(c, 3),
+            "derived_min_accept_rate": round(
+                spec_break_even_accept_rate(c, max_draft), 3
+            ),
+        }
+    return out
+
+
 def bench_prefill_ab(cfg, params, n_reqs=32, prompt_len=512, repeats=3):
     """Admission-path prefill A/B (VERDICT r5 #2: the in-round bench saw
     prefill fall 35.8k -> 23.8k tok/s at b32/512/0.5B between rounds with
@@ -821,16 +969,144 @@ def _probe_devices(
     return None
 
 
-def _section(fn, *args, **kw):
-    """Run one bench section; a failure becomes DATA (error string) so a
-    single section can never zero out the whole round's bench."""
-    try:
-        return fn(*args, **kw)
-    except Exception as e:  # noqa: BLE001 - report, don't die
-        import traceback
+#: per-section outcomes for the machine-parseable summary:
+#: {name: {"status": "ok"|"error"|"timeout", "seconds": wall}}.  A round
+#: that loses sections still reports WHICH ones and why.
+_SECTION_STATUS = {}
 
-        traceback.print_exc()
-        return {"error": f"{type(e).__name__}: {e}"[:300]}
+#: default per-section watchdog; generous because a cold section may pay
+#: multiple fresh XLA compiles (the decode A/B's deep-kernel cells run
+#: ~30-40s of compile EACH)
+SECTION_TIMEOUT_S = 900.0
+
+
+def _section(fn, *args, name=None, timeout_s=None, **kw):
+    """Run one bench section; a failure becomes DATA (error string) so a
+    single section can never zero out the whole round's bench.
+
+    With ``name`` the section also runs under its own fail-safe: a
+    daemon thread joined for ``timeout_s`` seconds, so a section that
+    HANGS (an axon backend init wedging inside a dispatch — BENCH_r05
+    lost all of rounds 8/9's TPU numbers to exactly one such hang)
+    forfeits only its own numbers; the round continues and the outcome
+    lands in the summary's per-section ``status`` table.  Best-effort by
+    design: a truly wedged thread may hold jax's dispatch lock and time
+    out the sections behind it too, but each of those is bounded the
+    same way and the round still emits its partial summary."""
+    import threading
+    import traceback
+
+    t0 = time.perf_counter()
+    if name is None:
+        try:
+            return fn(*args, **kw)
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            traceback.print_exc()
+            return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    box = {}
+
+    def target():
+        try:
+            box["result"] = fn(*args, **kw)
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            traceback.print_exc()
+            box["error"] = f"{type(e).__name__}: {e}"[:300]
+
+    th = threading.Thread(target=target, daemon=True, name=f"bench-{name}")
+    th.start()
+    budget = timeout_s if timeout_s is not None else SECTION_TIMEOUT_S
+    th.join(budget)
+    seconds = round(time.perf_counter() - t0, 1)
+    if th.is_alive():
+        _SECTION_STATUS[name] = {"status": "timeout", "seconds": seconds}
+        return {
+            "error": f"section {name!r} still running after {budget:.0f}s",
+            "status": "timeout",
+        }
+    if "error" in box:
+        _SECTION_STATUS[name] = {"status": "error", "seconds": seconds}
+        return {"error": box["error"]}
+    _SECTION_STATUS[name] = {"status": "ok", "seconds": seconds}
+    return box["result"]
+
+
+#: the machine-parseable summary's contract: these keys are ALWAYS
+#: present (value None when a section didn't run), so round-over-round
+#: diffs and the capture harness's `parsed` field never KeyError.
+#: Guarded by a tier-1 schema test (tests/engine/test_bench_sweep.py).
+SUMMARY_REQUIRED_KEYS = (
+    "pipeline_depth",
+    "decode",
+    "ring_ab",
+    "prefill_ab",
+    "prefix_cache_ab",
+    "trace_overhead_ab",
+    "spec_decode_ab",
+    "paged_decode_ab",
+    "dispatch_table",
+    "sections",
+)
+
+
+def build_summary(
+    gen,
+    prefill_ab=None,
+    prefix_cache_ab=None,
+    trace_overhead_ab=None,
+    spec_decode_ab=None,
+    decode_ab=None,
+    pipeline_depth=2,
+):
+    """Compact machine-parseable summary: the round's DIFFABLE numbers
+    (decode split + ring A/B, prefill A/B, the paged 3-column table and
+    the dispatch thresholds it derives, the spec-decode off/on A/B, and
+    each section's run status) duplicated out of `detail` so the capture
+    harness's `parsed` field carries them even when the full detail blob
+    is huge or the tail is truncated.  Always emits every key in
+    ``SUMMARY_REQUIRED_KEYS`` and always round-trips ``json.dumps`` —
+    the tier-1 schema test pins both."""
+
+    def _gen_summary(g):
+        if not isinstance(g, dict):
+            return None
+        return {
+            "prefill_toks_per_sec": g.get("prefill_toks_per_sec"),
+            "decode_toks_per_sec": g.get("decode_toks_per_sec"),
+            "engine_over_jit": g.get("engine_over_jit"),
+            "decode_split": g.get("decode_split"),
+        }
+
+    return {
+        "pipeline_depth": pipeline_depth,
+        "decode": {k: _gen_summary(v) for k, v in (gen or {}).items()},
+        "ring_ab": (gen.get("b32") or {}).get("ring_ab")
+        if isinstance((gen or {}).get("b32"), dict)
+        else None,
+        "prefill_ab": prefill_ab,
+        "prefix_cache_ab": prefix_cache_ab,
+        "trace_overhead_ab": trace_overhead_ab,
+        "spec_decode_ab": spec_decode_ab,
+        "paged_decode_ab": (
+            {
+                k: [
+                    row.get("dense_toks_per_sec"),
+                    row.get("paged_toks_per_sec"),
+                    row.get("paged_deep_toks_per_sec"),
+                ]
+                for k, row in decode_ab.items()
+                if isinstance(row, dict) and k.startswith("ctx")
+            }
+            if isinstance(decode_ab, dict)
+            else None
+        ),
+        "dispatch_table": (
+            decode_ab.get("derived_dispatch_table")
+            if isinstance(decode_ab, dict)
+            else None
+        ),
+        "sections": dict(_SECTION_STATUS),
+    }
 
 
 def bench_decode_ab(cfg15, params15, cases=None, page=1024, chunk=64,
@@ -1383,10 +1659,11 @@ def main():
     gen = {}
     gen_shape = {} if on_tpu else {"prompt_len": 32, "max_new": 16}
     for B in gen_batches:
-        gen[f"b{B}"] = bench_generation(
-            cfg, gen_params, n_reqs=B,
+        gen[f"b{B}"] = _section(
+            bench_generation, cfg, gen_params, n_reqs=B,
             ring_ab=(1, 2, 4) if (on_tpu and B == 32) else (),
             jit_ratio=on_tpu,
+            name=f"generation_b{B}",
             **gen_shape,
         )
 
@@ -1394,19 +1671,25 @@ def main():
     # chunked admit (roots the r5 prefill regression — VERDICT #2)
     mark("prefill A/B")
     prefill_ab = (
-        _section(bench_prefill_ab, cfg, gen_params) if on_tpu else None
+        _section(bench_prefill_ab, cfg, gen_params, name="prefill_ab")
+        if on_tpu
+        else None
     )
 
     # interruption A/B + update-visibility latency
     mark("interruption")
     interruption = (
-        _section(bench_interruption, cfg, gen_params) if on_tpu else None
+        _section(bench_interruption, cfg, gen_params, name="interruption")
+        if on_tpu
+        else None
     )
 
     # group-prompt KV dedup at admission (prefix-reuse A/B)
     mark("prefix reuse")
     prefix_reuse = (
-        _section(bench_prefix_reuse, cfg, gen_params) if on_tpu else None
+        _section(bench_prefix_reuse, cfg, gen_params, name="prefix_reuse")
+        if on_tpu
+        else None
     )
 
     # flight-recorder overhead A/B (off / sampled / always-on decode
@@ -1417,6 +1700,7 @@ def main():
         bench_trace_overhead_ab,
         cfg,
         gen_params,
+        name="trace_overhead_ab",
         **(
             {}
             if on_tpu
@@ -1432,12 +1716,33 @@ def main():
         bench_prefix_cache_ab,
         cfg,
         gen_params,
+        name="prefix_cache_ab",
         **(
             {}
             if on_tpu
             else dict(
                 n_sessions=2, turns=3, prompt_len=32, user_len=8,
                 max_new=8, page=16, chunk=32,
+            )
+        ),
+    )
+
+    # self-speculative decoding A/B: n-gram draft + batched paged verify
+    # on vs off, on a repetitive-trace workload (decode tok/s + accepted
+    # tokens per verify step).  Runs off-TPU too — tiny shapes — so the
+    # summary always carries the >=1.3x acceptance bar's number.
+    mark("spec decode A/B")
+    spec_decode_ab = _section(
+        bench_spec_decode_ab,
+        cfg,
+        gen_params,
+        name="spec_decode_ab",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                batches=(2, 4), prompt_len=48, max_new=160, motif_len=12,
+                page=32, chunk=16, max_draft=7,
             )
         ),
     )
@@ -1532,7 +1837,11 @@ def main():
     # the engine's admission scheduling, not model-size-dependent)
     mark("chunked prefill")
     chunked_prefill = (
-        _section(bench_chunked_prefill, cfg, gen_params) if on_tpu else None
+        _section(
+            bench_chunked_prefill, cfg, gen_params, name="chunked_prefill"
+        )
+        if on_tpu
+        else None
     )
 
     # 1.5B architecture (the reference's smallest published scale): the
@@ -1560,10 +1869,15 @@ def main():
             ),
             shapes,
         )
-        g15 = _section(bench_generation, cfg15, params15, n_reqs=32)
+        g15 = _section(
+            bench_generation, cfg15, params15, n_reqs=32,
+            name="generation_1p5b",
+        )
         gen_15b = {**g15, "n_params": param_count(params15)}
         mark("decode A/B")
-        decode_ab = _section(bench_decode_ab, cfg15, params15)
+        decode_ab = _section(
+            bench_decode_ab, cfg15, params15, name="decode_ab"
+        )
         del params15
 
     # {remat_policy x moment dtype} train sweep at the bench batch — the
@@ -1588,54 +1902,19 @@ def main():
         dev,
         cells=sweep_cells,
         progress=mark,
+        name="train_sweep",
+        timeout_s=1800.0,  # many per-cell compiles
     )
     mark("done")
 
-    # compact machine-parseable summary: the round's DIFFABLE numbers
-    # (decode split + ring A/B, prefill A/B, the paged 3-column table and
-    # the dispatch thresholds it derives) duplicated out of `detail` so
-    # the capture harness's `parsed` field carries them even when the
-    # full detail blob is huge or the tail is truncated
-    def _gen_summary(g):
-        if not isinstance(g, dict):
-            return None
-        return {
-            "prefill_toks_per_sec": g.get("prefill_toks_per_sec"),
-            "decode_toks_per_sec": g.get("decode_toks_per_sec"),
-            "engine_over_jit": g.get("engine_over_jit"),
-            "decode_split": g.get("decode_split"),
-        }
-
-    summary = {
-        "pipeline_depth": 2,
-        "decode": {
-            k: _gen_summary(v) for k, v in gen.items()
-        },
-        "ring_ab": (gen.get("b32") or {}).get("ring_ab")
-        if isinstance(gen.get("b32"), dict)
-        else None,
-        "prefill_ab": prefill_ab,
-        "prefix_cache_ab": prefix_cache_ab,
-        "trace_overhead_ab": trace_overhead_ab,
-        "paged_decode_ab": (
-            {
-                k: [
-                    row.get("dense_toks_per_sec"),
-                    row.get("paged_toks_per_sec"),
-                    row.get("paged_deep_toks_per_sec"),
-                ]
-                for k, row in decode_ab.items()
-                if isinstance(row, dict) and k.startswith("ctx")
-            }
-            if isinstance(decode_ab, dict)
-            else None
-        ),
-        "dispatch_table": (
-            decode_ab.get("derived_dispatch_table")
-            if isinstance(decode_ab, dict)
-            else None
-        ),
-    }
+    summary = build_summary(
+        gen,
+        prefill_ab=prefill_ab,
+        prefix_cache_ab=prefix_cache_ab,
+        trace_overhead_ab=trace_overhead_ab,
+        spec_decode_ab=spec_decode_ab,
+        decode_ab=decode_ab,
+    )
 
     print(
         json.dumps(
@@ -1688,6 +1967,7 @@ def main():
                     "prefix_reuse": prefix_reuse,
                     "prefix_cache_ab": prefix_cache_ab,
                     "trace_overhead_ab": trace_overhead_ab,
+                    "spec_decode_ab": spec_decode_ab,
                 },
             }
         )

@@ -1,9 +1,13 @@
-"""Tier-1 CPU smoke of bench.py's round-6 sections (the bench_decode_ab
+"""Tier-1 CPU smoke of bench.py's sections (the bench_decode_ab
 pattern from 9ab0b16: size-parametrized helpers validated end-to-end at
 tiny shapes so bench logic breakage is caught BEFORE a hardware round).
 
-Covers the {remat_policy x moment dtype} train sweep and the fail-safe
-device probe (bounded retry + structured JSON error record at rc=0)."""
+Covers the {remat_policy x moment dtype} train sweep, the fail-safe
+device probe (bounded retry + structured JSON error record at rc=0),
+the per-section watchdog (a hung section forfeits its own numbers, not
+the round's), the speculative-decoding off/on A/B, and the
+machine-parseable summary's schema contract (always json-round-trips,
+always carries every SUMMARY_REQUIRED_KEYS entry)."""
 
 import json
 import time
@@ -145,3 +149,111 @@ def test_probe_devices_bounds_a_hung_backend(monkeypatch, capsys):
     rec = _last_json_line(capsys)
     assert "timeout" in rec["error"]["message"]
     assert rec["error"]["attempts"] == 1
+
+
+# -- per-section fail-safe isolation ------------------------------------------
+
+
+def test_section_records_ok_status_and_result():
+    bench._SECTION_STATUS.pop("demo_ok", None)
+    out = bench._section(lambda x: {"v": x + 1}, 1, name="demo_ok")
+    assert out == {"v": 2}
+    assert bench._SECTION_STATUS["demo_ok"]["status"] == "ok"
+
+
+def test_section_turns_exception_into_data_with_status():
+    def boom():
+        raise RuntimeError("backend exploded")
+
+    out = bench._section(boom, name="demo_err")
+    assert "backend exploded" in out["error"]
+    assert bench._SECTION_STATUS["demo_err"]["status"] == "error"
+
+
+def test_section_bounds_a_hung_section():
+    """A section that HANGS (the BENCH_r05 axon-init failure mode) must
+    forfeit only its own numbers: bounded join, timeout status, round
+    continues."""
+
+    def hang():
+        time.sleep(5)
+        return {"never": True}
+
+    t0 = time.perf_counter()
+    out = bench._section(hang, name="demo_hang", timeout_s=0.2)
+    assert time.perf_counter() - t0 < 2.0
+    assert out["status"] == "timeout" and "error" in out
+    assert bench._SECTION_STATUS["demo_hang"]["status"] == "timeout"
+
+
+def test_unnamed_section_keeps_legacy_inline_behavior():
+    assert bench._section(lambda: 7) == 7
+    assert "error" in bench._section(
+        lambda: (_ for _ in ()).throw(ValueError("x"))
+    )
+
+
+# -- spec-decode A/B + summary schema -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def spec_ab(tiny_cfg):
+    """One tiny spec_decode_ab run shared by the section + schema tests
+    (greedy + paged, repetitive-trace workload)."""
+    import jax
+
+    from areal_tpu.models import transformer
+
+    params = transformer.init_params(tiny_cfg, jax.random.PRNGKey(0))
+    return bench.bench_spec_decode_ab(
+        tiny_cfg, params, batches=(2,), prompt_len=32, max_new=48,
+        motif_len=8, page=16, chunk=8, max_draft=3,
+    )
+
+
+def test_spec_decode_ab_reports_required_fields(spec_ab):
+    row = spec_ab["b2"]
+    for arm in ("spec_off", "spec_on"):
+        assert row[arm]["decode_toks_per_sec"] > 0
+    on = row["spec_on"]
+    assert on["verify_chunks"] > 0  # spec genuinely engaged
+    assert 0.0 <= on["accept_rate"] <= 1.0
+    assert on["accepted_tokens_per_step"] >= 1.0
+    assert row["spec_over_off"] > 0
+    assert 0.0 <= row["derived_min_accept_rate"] <= 1.0
+
+
+def test_summary_schema_round_trips_with_required_keys(spec_ab):
+    """The machine-parseable summary contract: json round-trip + every
+    SUMMARY_REQUIRED_KEYS entry present (None for sections that did not
+    run) — including the new spec_decode_ab section and the per-section
+    status table."""
+    gen = {"b2": {"prefill_toks_per_sec": 1.0,
+                  "decode_toks_per_sec": 2.0,
+                  "decode_split": {"host_frac": 1.0}},
+           "b4": {"error": "section died"}}
+    summary = bench.build_summary(
+        gen,
+        prefill_ab=None,
+        prefix_cache_ab={"replay_wall_speedup": 1.5},
+        trace_overhead_ab=None,
+        spec_decode_ab=spec_ab,
+        decode_ab={
+            "ctx2048_b16": {"dense_toks_per_sec": 1.0,
+                            "paged_toks_per_sec": 2.0,
+                            "paged_deep_toks_per_sec": 3.0},
+            "derived_dispatch_table": {"paged_min_cache_len": 2048},
+        },
+    )
+    blob = json.loads(json.dumps(summary))
+    for key in bench.SUMMARY_REQUIRED_KEYS:
+        assert key in blob, key
+    assert blob["spec_decode_ab"]["b2"]["spec_on"]["verify_chunks"] > 0
+    assert blob["decode"]["b2"]["decode_toks_per_sec"] == 2.0
+    assert blob["decode"]["b4"]["decode_toks_per_sec"] is None
+    assert blob["paged_decode_ab"]["ctx2048_b16"] == [1.0, 2.0, 3.0]
+    assert blob["dispatch_table"] == {"paged_min_cache_len": 2048}
+    assert isinstance(blob["sections"], dict)
+    # every recorded section row carries a status field
+    for row in blob["sections"].values():
+        assert row["status"] in ("ok", "error", "timeout")
