@@ -86,7 +86,7 @@ from areal_tpu.observability.tracing import get_tracer
 PAGED_MIN_CACHE_LEN = DEFAULT_PAGED_MIN_CACHE_LEN
 
 
-@partial(jax.jit, static_argnames=("sampling",))
+@partial(jax.jit, static_argnames=("sampling", "mesh"))
 def _sample_rows(
     logits: jax.Array,  # [F, V]
     src: jax.Array,  # [n] which logits row each target samples from
@@ -94,6 +94,7 @@ def _sample_rows(
     positions: jax.Array,  # [n] absolute position of the sampled token
     rng: jax.Array,  # the engine's FIXED sampling base key
     sampling: SamplingParams,
+    mesh=None,
 ):
     """First-token sampling for fill targets (each group member draws its
     own independent token from the shared prompt's final logits).  Keyed
@@ -101,7 +102,8 @@ def _sample_rows(
     for the same request at the same position would have drawn —
     chunking- and placement-invariant streams."""
     tok, logp = sample_logits_keyed(
-        logits[src].astype(jnp.float32), rng, seeds, positions, sampling
+        logits[src].astype(jnp.float32), rng, seeds, positions, sampling,
+        mesh=mesh,
     )
     return tok, logp
 
@@ -214,7 +216,11 @@ class _InflightChunk:
     spec_meta: Optional[Dict[int, Tuple[str, int]]] = None
 
 
-@partial(jax.jit, static_argnames=("cfg", "sampling"), donate_argnums=(2,))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "sampling", "mesh"),
+    donate_argnums=(2,),
+)
 def _admit_rows(
     params,
     cfg: TransformerConfig,
@@ -226,6 +232,7 @@ def _admit_rows(
     seeds: jax.Array,  # [n] per-request sampler key identity
     rng: jax.Array,
     sampling: SamplingParams,
+    mesh=None,
 ) -> Tuple[KVCache, jax.Array, jax.Array]:
     """Batched prefill: run ``m`` unique prompts through the model ONCE and
     scatter each prompt's KV into every target row that shares it (``src``
@@ -242,7 +249,7 @@ def _admit_rows(
     # logits at a 152k vocab would be multiple GB of HBM
     logits, mini = prefill(
         params, cfg, tokens, positions, seg, mini,
-        last_pos=jnp.maximum(lengths - 1, 0),
+        last_pos=jnp.maximum(lengths - 1, 0), mesh=mesh,
     )
     k = cache.k.at[:, rows, :, :T].set(mini.k[:, src], mode="drop")
     v = cache.v.at[:, rows, :, :T].set(mini.v[:, src], mode="drop")
@@ -253,14 +260,17 @@ def _admit_rows(
     # request's (identity, position), like every later token's —
     # admission batching cannot perturb streams
     tok, logp = sample_logits_keyed(
-        last[src].astype(jnp.float32), rng, seeds, lengths[src], sampling
+        last[src].astype(jnp.float32), rng, seeds, lengths[src], sampling,
+        mesh=mesh,
     )
     return KVCache(k=k, v=v, lengths=new_lengths), tok, logp
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "chunk_size", "stop_tokens", "sampling", "attn_len"),
+    static_argnames=(
+        "cfg", "chunk_size", "stop_tokens", "sampling", "attn_len", "mesh",
+    ),
     donate_argnums=(2,),
 )
 def _decode_chunk(
@@ -276,6 +286,7 @@ def _decode_chunk(
     stop_tokens: Tuple[int, ...],
     sampling: SamplingParams,
     attn_len: Optional[int] = None,
+    mesh=None,
 ):
     """Generate up to ``chunk_size`` tokens for all active rows device-side.
 
@@ -300,7 +311,9 @@ def _decode_chunk(
     # a position (pipeline depth / chunk size / speculative tail steps)
     # nor on which cache row the request landed in
     def keyed_sample(logits, _sub, positions, seeds):
-        return sample_logits_keyed(logits, rng, seeds, positions, sampling)
+        return sample_logits_keyed(
+            logits, rng, seeds, positions, sampling, mesh=mesh
+        )
 
     if cfg.sliding_window is None or chunk_size <= cfg.sliding_window:
         from areal_tpu.models.transformer import decode_chunk
@@ -318,11 +331,14 @@ def _decode_chunk(
             is_stop,
             attn_len=attn_len,
             row_seeds=row_seeds,
+            mesh=mesh,
         )
 
     def body(i, state):
         cache, cur, active, budgets, out_t, out_l, emitted, rng = state
-        logits, new_cache = decode_step(params, cfg, cur, cache, active=active)
+        logits, new_cache = decode_step(
+            params, cfg, cur, cache, active=active, mesh=mesh
+        )
         rng, sub = jax.random.split(rng)
         # post-step lengths IS the sampled token's absolute position
         tok, logp = keyed_sample(
@@ -457,9 +473,34 @@ class ContinuousBatchingEngine:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
-            from areal_tpu.models.transformer import param_pspecs
+            from areal_tpu.models.transformer import (
+                param_pspecs,
+                serving_param_pspecs,
+            )
 
-            pspecs = param_pspecs(cfg, params)
+            ep = mesh.shape.get("expert", 1)
+            if cfg.is_moe and ep > 1 and cfg.n_experts % ep != 0:
+                raise ValueError(
+                    f"n_experts {cfg.n_experts} not divisible by the "
+                    f"mesh's expert axis ({ep}); expert parallelism "
+                    "needs an even split"
+                )
+            if not cfg.is_moe and ep > 1:
+                raise ValueError(
+                    "mesh has an expert axis > 1 but the model is dense; "
+                    "use the model/data axes for dense serving"
+                )
+            # EP serving shards experts over the expert axis ONLY (the
+            # explicit shard_map in models/moe.py consumes exactly the
+            # local [E/ep, D, F] shard; see serving_param_pspecs).  On an
+            # expert-less mesh the training pspecs apply unchanged —
+            # experts keep their model/fsdp matmul-dim sharding, so a
+            # MoE model under plain TP serving never pays full expert
+            # replication (code-review finding)
+            if cfg.is_moe and ep > 1:
+                pspecs = serving_param_pspecs(cfg, params)
+            else:
+                pspecs = param_pspecs(cfg, params)
             self._param_shardings = jax.tree.map(
                 lambda ps: NamedSharding(mesh, ps), pspecs
             )
@@ -478,6 +519,9 @@ class ContinuousBatchingEngine:
             )
         elif device is not None:
             params = jax.device_put(params, device)
+        #: chips this engine's forward spans (1 off-mesh) — the fleet
+        #: manager scales capacity/routing weights by it
+        self.mesh_devices = int(mesh.devices.size) if mesh is not None else 1
         self.params = params
         self.tokenizer = tokenizer
         self.max_batch = max_batch
@@ -663,12 +707,14 @@ class ContinuousBatchingEngine:
         sampling_ref = self.sampling
         stop_ref = self.stop_tokens
         base_rng_ref = self._sample_base_rng
+        mesh_ref = self.mesh
 
         def _sample(logits, _sub, positions, seeds):
             # position-keyed: the draw for (request seed, position) is a
             # pure function of the engine seed (see sample_logits_keyed)
             return sample_logits_keyed(
-                logits, base_rng_ref, seeds, positions, sampling_ref
+                logits, base_rng_ref, seeds, positions, sampling_ref,
+                mesh=mesh_ref,
             )
 
         def _stop(tok):
@@ -1049,6 +1095,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(seed_arr),
             self._sample_base_rng,
             self.sampling,
+            mesh=self.mesh,
         )
         self.prefill_calls += 1
         self.prefill_tokens_total += int(lens[:m].sum())
@@ -1305,6 +1352,7 @@ class ContinuousBatchingEngine:
                 jnp.asarray(tgt_pos),
                 self._sample_base_rng,
                 self.sampling,
+                mesh=self.mesh,
             )
             toks = np.asarray(toks)[:n]
             logps = np.asarray(logps)[:n]
@@ -2012,6 +2060,7 @@ class ContinuousBatchingEngine:
             attn_len=self._attn_bucket(
                 extra=len(self._ring) * self.chunk_size
             ),
+            mesh=self.mesh,
         )
         self._enqueue_chunk(
             out_t, out_l, emitted, self.active, self.cur_tokens, snapshot

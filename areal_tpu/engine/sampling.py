@@ -111,6 +111,7 @@ def sample_logits_keyed(
     positions: jax.Array,  # [B] absolute position of the SAMPLED token
     params: SamplingParams,
     ban_mask: jax.Array = None,
+    mesh=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Position-keyed sampling: identity r's draw at position p depends
     only on ``(base_rng, r, p)`` — never on how many prior sampling
@@ -125,7 +126,17 @@ def sample_logits_keyed(
     layout still perturbs LOGITS at the float32 reduction-order level
     (~1e-7), so a stream can differ at a near-tie — essentially never
     under pure temperature sampling, but top-p/top-k cutoffs sit on
-    sorted-probability cliffs where a tie can flip the filtered set."""
+    sorted-probability cliffs where a tie can flip the filtered set.
+
+    ``mesh`` (serving meshes only): the gumbel generation runs inside a
+    fully-replicated manual ``shard_map`` region.  jax 0.4.x's legacy
+    (non-partitionable) threefry can generate DIFFERENT bits when XLA's
+    auto-partitioner shards the counter computation — measured on a
+    4-chip d/e/m mesh, the same (key, shape) drew different tokens than
+    the single-device engine, silently breaking sharded-vs-replicated
+    stream parity.  Inside the manual region every device computes the
+    full [B, V] gumbel locally with the exact single-device lowering,
+    so the bits are bitwise-identical to ``mesh=None``."""
     if params.temperature != 1.0:
         logits = logits / max(params.temperature, 1e-5)
     base_logprobs = jax.nn.log_softmax(logits, axis=-1)
@@ -143,7 +154,22 @@ def sample_logits_keyed(
             )
             return jax.random.gumbel(key, (V,), jnp.float32)
 
-        g = jax.vmap(row_gumbel)(rows, positions)  # [B, V]
+        def gen_gumbel(rows_, positions_):
+            return jax.vmap(row_gumbel)(rows_, positions_)
+
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from areal_tpu.base import jax_compat
+
+            gen_gumbel = jax_compat.shard_map(
+                gen_gumbel,
+                mesh=mesh,
+                in_specs=(P(None), P(None)),
+                out_specs=P(None, None),
+                check_vma=False,
+            )
+        g = gen_gumbel(rows, positions)  # [B, V]
         tokens = jnp.argmax(filtered + g, axis=-1)
 
     logp = jnp.take_along_axis(base_logprobs, tokens[:, None], axis=-1)[:, 0]

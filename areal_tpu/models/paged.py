@@ -224,7 +224,7 @@ def paged_window_forward(
         )
         x = x + _proj(lp["attn"]["o"], attn)
         h2 = _norm(x, lp["mlp_norm"], cfg)
-        mlp_out, _ = _mlp_block(cfg, lp, h2, seg_ids=seg_ids)
+        mlp_out, _ = _mlp_block(cfg, lp, h2, seg_ids=seg_ids, mesh=mesh)
         x = x + mlp_out
         # scatter chunk KV into the pool (in-place on the donated carry);
         # advanced indices split by the Hkv slice -> result [F, C, Hkv, hd]
@@ -402,7 +402,7 @@ def paged_decode_chunk(
             attn = attn.reshape(B, 1, cfg.n_q_heads * hd)
             x = x + _proj(lp["attn"]["o"], attn)
             h2 = _norm(x, lp["mlp_norm"], cfg)
-            mlp_out, _ = _mlp_block(cfg, lp, h2)
+            mlp_out, _ = _mlp_block(cfg, lp, h2, mesh=mesh)
             x = x + mlp_out
             return (x, wk, wv), None
 

@@ -190,6 +190,30 @@ def param_pspecs(
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+def serving_param_pspecs(cfg: TransformerConfig, params: Params) -> Params:
+    """PartitionSpec pytree for the SERVING engine's mesh.
+
+    Identical to :func:`param_pspecs` except MoE expert weights shard
+    over the ``expert`` mesh axis ONLY (replicated across model/fsdp):
+    the serving EP path computes local-expert groups under an explicit
+    shard_map (models/moe.py) whose in_specs must match the physical
+    layout exactly — sharding the D/F matmul dims over ``model`` too
+    would force an all-gather of every expert weight inside each
+    layer's shard_map, re-paying the traffic EP exists to avoid.  Dense
+    (attention/embedding/head) weights keep the megatron TP layout."""
+    specs = param_pspecs(cfg, params)
+    if not cfg.is_moe:
+        return specs
+
+    def fix(path, spec):
+        keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
+        if "experts" in keys:
+            return P(None, "expert", None, None)
+        return spec
+
+    return jax.tree_util.tree_map_with_path(fix, specs)
+
+
 # ---------------------------------------------------------------------------
 # Core ops
 # ---------------------------------------------------------------------------
@@ -495,16 +519,21 @@ def _attn_qkv(cfg: TransformerConfig, lp: Params, h, positions, rope_cs):
     return q, k, v
 
 
-def _mlp_block(cfg: TransformerConfig, lp: Params, h, seg_ids=None):
+def _mlp_block(cfg: TransformerConfig, lp: Params, h, seg_ids=None,
+               mesh=None):
     """Shared MLP/MoE block (post-attention half of every layer).
     Returns (out, aux): aux carries the router's load-balancing/z losses
     for MoE (coefficient-scaled, reference moe/router.py; padding masked
-    out of the statistics via ``seg_ids``) and is None for dense layers."""
+    out of the statistics via ``seg_ids``) and is None for dense layers.
+
+    ``mesh`` is the SERVING mesh (None for training): a mesh with an
+    ``expert`` axis > 1 routes MoE through the explicit expert-parallel
+    shard_map so per-chip expert residency is E/ep (see models/moe.py)."""
     if cfg.is_moe:
         from areal_tpu.models.moe import moe_mlp
 
         valid = None if seg_ids is None else (seg_ids != 0)
-        return moe_mlp(cfg, h, lp["mlp"], valid=valid)
+        return moe_mlp(cfg, h, lp["mlp"], valid=valid, mesh=mesh)
     gate = _activation(_proj(lp["mlp"]["gate"], h), cfg.activation)
     if cfg.gated_mlp:
         gate = gate * _proj(lp["mlp"]["up"], h)
@@ -521,6 +550,7 @@ def _layer(
     kv_write_pos: Optional[jax.Array] = None,
     seg_ids: Optional[jax.Array] = None,
     rope_cs: Optional[Tuple[jax.Array, jax.Array]] = None,
+    mesh=None,
 ):
     """One transformer block. Returns (y, (k_full, v_full), aux) where
     k/v_full include cached history when provided and aux carries MoE
@@ -556,7 +586,7 @@ def _layer(
     x = x + proj(lp["attn"]["o"], attn_out)
 
     h = _norm(x, lp["mlp_norm"], cfg)
-    mlp_out, aux = _mlp_block(cfg, lp, h, seg_ids=seg_ids)
+    mlp_out, aux = _mlp_block(cfg, lp, h, seg_ids=seg_ids, mesh=mesh)
     mlp_out = checkpoint_name(mlp_out, "mlp_out")
     x = x + mlp_out
     return x, (k_full, v_full), aux
@@ -758,6 +788,7 @@ def prefill(
     seg_ids: jax.Array,
     cache: KVCache,
     last_pos: Optional[jax.Array] = None,  # [B] index of each row's last tok
+    mesh=None,  # serving mesh (EP MoE dispatch); None elsewhere
 ) -> Tuple[jax.Array, KVCache]:
     """Run the prompt through the model, filling the KV cache.
 
@@ -796,6 +827,7 @@ def prefill(
             kv=(kc, vc),
             kv_write_pos=write_pos,
             rope_cs=rope_cs,
+            mesh=mesh,
         )
         return y, (k_full, v_full)
 
@@ -815,6 +847,7 @@ def decode_step(
     tokens: jax.Array,  # [B] int32 — next token per row
     cache: KVCache,
     active: Optional[jax.Array] = None,  # [B] bool; inactive rows don't advance
+    mesh=None,  # serving mesh (EP MoE dispatch); None elsewhere
 ) -> Tuple[jax.Array, KVCache]:
     """One decode step for all rows. Returns (logits [B, V], new cache).
 
@@ -862,7 +895,7 @@ def decode_step(
         x = x + _proj(lp["attn"]["o"], attn_out)
 
         h = _norm(x, lp["mlp_norm"], cfg)
-        mlp_out, _ = _mlp_block(cfg, lp, h)
+        mlp_out, _ = _mlp_block(cfg, lp, h, mesh=mesh)
         x = x + mlp_out
         return (x, k_all, v_all), None
 
@@ -889,6 +922,7 @@ def decode_chunk(
     stop_fn,  # (tokens [B]) -> [B] bool
     attn_len: Optional[int] = None,
     row_seeds: Optional[jax.Array] = None,  # [B] per-request sampler keys
+    mesh=None,  # serving mesh (EP MoE dispatch); None elsewhere
 ):
     """Generate up to ``chunk_size`` tokens for all active rows device-side.
 
@@ -1038,7 +1072,7 @@ def decode_chunk(
             attn = attn.reshape(B, 1, cfg.n_q_heads * hd)
             x = x + _proj(lp["attn"]["o"], attn)
             h = _norm(x, lp["mlp_norm"], cfg)
-            mlp_out, _ = _mlp_block(cfg, lp, h)
+            mlp_out, _ = _mlp_block(cfg, lp, h, mesh=mesh)
             x = x + mlp_out
             return (x, wk, wv), None
 

@@ -169,6 +169,12 @@ METRIC_TABLE = [
         "Requests queued for admission",
     ),
     MetricSpec(
+        "areal_inference_mesh_devices",
+        "gauge",
+        "Chips this engine's sharded forward spans (one server = one "
+        "mesh; 1 for a single-chip engine)",
+    ),
+    MetricSpec(
         "areal_inference_weight_version",
         "gauge",
         "Weight version the engine currently serves",
@@ -210,6 +216,13 @@ METRIC_TABLE = [
         "areal_gserver_server_tokens",
         "gauge",
         "Estimated resident tokens per generation server",
+        ("server",),
+    ),
+    MetricSpec(
+        "areal_gserver_server_mesh_devices",
+        "gauge",
+        "Chips behind each generation server's mesh (registration-"
+        "derived; routing and capacity weights scale with it)",
         ("server",),
     ),
     MetricSpec(

@@ -15,7 +15,7 @@ from __future__ import annotations
 import pickle
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import zmq
 
@@ -24,6 +24,25 @@ from areal_tpu.base import constants, logging_, name_resolve, names, network
 from areal_tpu.system import worker_base
 
 logger = logging_.getLogger("generation_server")
+
+
+def format_server_registration(addr: str, mesh_spec) -> str:
+    """Registration value for the gen_servers name-resolve subtree:
+    ``addr|mesh_devices|mesh_spec``.  One "server" = one mesh: the
+    gserver manager scales capacity accounting and routing weights by
+    the chip count, so a 4-chip TP/EP server absorbs 4x the load of a
+    single-chip one instead of being treated as an equal peer."""
+    return f"{addr}|{mesh_spec.world_size}|{mesh_spec}"
+
+
+def parse_server_registration(value: str) -> Tuple[str, int, str]:
+    """``(addr, mesh_devices, mesh_spec_str)`` from a registration value;
+    bare-address values (older registrations) parse as one device."""
+    parts = value.split("|")
+    addr = parts[0]
+    devices = int(parts[1]) if len(parts) > 1 and parts[1] else 1
+    spec = parts[2] if len(parts) > 2 else ""
+    return addr, max(1, devices), spec
 
 # ctrl-stream high-water mark (messages, each ~100s of bytes): bounds the
 # leader's buffer at ~10s of MB if a follower wedges, yet is ~100x deeper
@@ -137,7 +156,13 @@ class GenerationServerWorker(worker_base.Worker):
             self._sock = self._ctx.socket(zmq.ROUTER)
             port = self._sock.bind_to_random_port("tcp://*")
             self.addr = f"{network.gethostip()}:{port}"
-            name_resolve.add(base_key, self.addr, replace=True)
+            # registration carries the mesh shape: the manager weights
+            # this server's capacity/routing by its chip count
+            name_resolve.add(
+                base_key,
+                format_server_registration(self.addr, config.mesh_spec),
+                replace=True,
+            )
             if self._n_procs > 1:
                 # command-stream broadcast to follower controllers.
                 # HWM: the default (1000) silently DROPS messages under a
@@ -235,6 +260,7 @@ class GenerationServerWorker(worker_base.Worker):
             "ring_depth": reg.gauge("areal_inference_ring_depth"),
             "inflight_chunks": reg.gauge("areal_inference_inflight_chunks"),
             "prefix_blocks": reg.gauge("areal_inference_prefix_cache_blocks"),
+            "mesh_devices": reg.gauge("areal_inference_mesh_devices"),
         }
         self._obs_accept_hist = reg.histogram(
             "areal_inference_spec_accept_rate",
@@ -278,6 +304,7 @@ class GenerationServerWorker(worker_base.Worker):
         self._obs["ring_depth"].set(eng.pipeline_depth)
         self._obs["inflight_chunks"].set(eng.inflight_chunks)
         self._obs["prefix_blocks"].set(pstats["blocks_held"])
+        self._obs["mesh_devices"].set(eng.mesh_devices)
 
     # -- API ---------------------------------------------------------------
 
@@ -379,6 +406,9 @@ class GenerationServerWorker(worker_base.Worker):
             "gen_tokens_total": self.engine.gen_tokens_total,
             "version": self.engine.version,
             "uptime": time.monotonic() - self._start_time,
+            # one server = one mesh: chips this engine's forward spans
+            "mesh_devices": self.engine.mesh_devices,
+            "mesh_spec": str(self.config.mesh_spec),
             # decode-pipeline ring state + async-fetch overlap counters
             "ring_depth": self.engine.pipeline_depth,
             "inflight_chunks": self.engine.inflight_chunks,

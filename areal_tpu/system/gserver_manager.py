@@ -48,23 +48,38 @@ class GserverManager(worker_base.Worker):
                 "expected round_robin | least_requests | least_token_usage"
             )
 
-        # discover generation servers
-        self.server_addrs: List[str] = []
+        # discover generation servers.  A registration value carries the
+        # server's mesh shape (``addr|devices|spec``, see
+        # generation_server.format_server_registration): one "server" =
+        # one mesh, and every capacity/routing weight below scales with
+        # its chip count so a 4-chip TP/EP server absorbs 4x the load
+        # of a single-chip peer.
+        from areal_tpu.system.generation_server import (
+            parse_server_registration,
+        )
+
+        values: List[str] = []
         deadline = time.monotonic() + 120
-        while len(self.server_addrs) < config.n_servers:
-            self.server_addrs = sorted(
+        while len(values) < config.n_servers:
+            values = sorted(
                 name_resolve.get_subtree(
                     names.gen_servers(self._expr, self._trial)
                 )
             )
-            if len(self.server_addrs) >= config.n_servers:
+            if len(values) >= config.n_servers:
                 break
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"only {len(self.server_addrs)}/{config.n_servers} "
+                    f"only {len(values)}/{config.n_servers} "
                     "generation servers registered"
                 )
             time.sleep(0.1)
+        parsed = [parse_server_registration(v) for v in values]
+        self.server_addrs = [a for a, _, _ in parsed]
+        self._server_devices: Dict[str, int] = {
+            a: d for a, d, _ in parsed
+        }
+        self._server_mesh: Dict[str, str] = {a: s for a, _, s in parsed}
         self._clients = {a: GenServerClient(a) for a in self.server_addrs}
 
         # rollout accounting (reference: monitor.RolloutStat threading
@@ -130,9 +145,17 @@ class GserverManager(worker_base.Worker):
         self._m_lag = reg.gauge("areal_gserver_version_lag")
         self._m_srv_reqs = reg.gauge("areal_gserver_server_requests")
         self._m_srv_toks = reg.gauge("areal_gserver_server_tokens")
+        self._m_srv_devices = reg.gauge(
+            "areal_gserver_server_mesh_devices"
+        )
         self._m_affinity_escapes = reg.counter(
             "areal_gserver_affinity_escapes_total"
         )
+
+    def _devices(self, addr: str) -> int:
+        """Chip count of a server's mesh (1 for hand-built/legacy
+        registrations) — the weight every load signal normalizes by."""
+        return getattr(self, "_server_devices", {}).get(addr, 1)
 
     def _export_metrics(self):
         self._m_running.set(self.rollout_stat.running)
@@ -141,6 +164,7 @@ class GserverManager(worker_base.Worker):
         for addr in self.server_addrs:
             self._m_srv_reqs.set(self._server_load[addr], server=addr)
             self._m_srv_toks.set(self._server_tokens[addr], server=addr)
+            self._m_srv_devices.set(self._devices(addr), server=addr)
 
     # -- scheduling / staleness --------------------------------------------
 
@@ -207,14 +231,26 @@ class GserverManager(worker_base.Worker):
         if sibling is not None:
             addr = sibling
         elif self.config.schedule_policy == "least_requests":
-            addr = min(pool, key=lambda a: self._server_load[a])
+            # PER-CHIP load: a 4-chip mesh server should carry 4x the
+            # requests of a single-chip one before looking "busier"
+            addr = min(
+                pool, key=lambda a: self._server_load[a] / self._devices(a)
+            )
         elif self.config.schedule_policy == "least_token_usage":
-            # route by estimated resident tokens: prompt + 0.4x budget (the
-            # reference's expected-completion discount, gserver_manager
-            # :400-405) — a far better KV-pressure signal than request count
-            addr = min(pool, key=lambda a: self._server_tokens[a])
+            # route by estimated resident tokens PER CHIP: prompt + 0.4x
+            # budget (the reference's expected-completion discount,
+            # gserver_manager :400-405) — a far better KV-pressure signal
+            # than request count, normalized by the mesh's capacity
+            addr = min(
+                pool,
+                key=lambda a: self._server_tokens[a] / self._devices(a),
+            )
         else:  # round_robin (policy validated at _configure)
-            addr = pool[self._round_robin % len(pool)]
+            # weighted cycle: each server appears once per chip, so the
+            # rotation hands a 4-chip mesh 4 of every (4+1) requests in
+            # a {4-chip, 1-chip} fleet
+            wpool = [a for a in pool for _ in range(self._devices(a))]
+            addr = wpool[self._round_robin % len(wpool)]
             self._round_robin += 1
         self._qid_server[qid] = addr
         self._group_server[group] = addr
@@ -257,10 +293,14 @@ class GserverManager(worker_base.Worker):
         # imbalance = FOREIGN load on the hot server: the session's own
         # resident-token estimates are discounted, else a long
         # conversation would eventually evict itself from its hot cache
-        # just by growing
+        # just by growing.  All sides are PER-CHIP: a 4-chip mesh is not
+        # "overloaded" for holding 4x a single chip's tokens.
         own = self._group_tokens.get(group, {}).get(cand, 0.0)
-        foreign = self._server_tokens[cand] - own
-        least = min(self._server_tokens.values())
+        foreign = (self._server_tokens[cand] - own) / self._devices(cand)
+        least = min(
+            self._server_tokens[a] / self._devices(a)
+            for a in self.server_addrs
+        )
         if foreign > (
             self.config.affinity_imbalance_factor * least
             + self.config.affinity_imbalance_slack_tokens
@@ -498,6 +538,9 @@ class GserverManager(worker_base.Worker):
                         },
                         "server_load": dict(self._server_load),
                         "server_tokens": dict(self._server_tokens),
+                        "server_mesh_devices": {
+                            a: self._devices(a) for a in self.server_addrs
+                        },
                     }
                 else:
                     resp = {"error": f"unknown command {cmd}"}

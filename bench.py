@@ -969,6 +969,181 @@ def _probe_devices(
     return None
 
 
+def _sharded_serving_cfgs(on_tpu: bool):
+    """(dense_cfg, moe_cfg) for the sharded-serving A/B.  Small even on
+    TPU: the section measures the SCALING of the sharded engine (mesh
+    collectives + EP dispatch on the hot path), not peak model tok/s —
+    the other generation sections own that."""
+    import dataclasses
+
+    from areal_tpu.models.config import TransformerConfig
+
+    if on_tpu:
+        dense = TransformerConfig(
+            n_layers=8, hidden_dim=1024, n_q_heads=8, n_kv_heads=4,
+            head_dim=128, intermediate_dim=2816, vocab_size=32768,
+            max_position_embeddings=4096, dtype="bfloat16",
+        )
+    else:
+        dense = TransformerConfig(
+            n_layers=2, hidden_dim=64, n_q_heads=4, n_kv_heads=2,
+            head_dim=32, intermediate_dim=128, vocab_size=512,
+            max_position_embeddings=512, dtype="float32",
+        )
+    moe = dataclasses.replace(
+        dense,
+        intermediate_dim=dense.intermediate_dim // 2,
+        moe_intermediate_dim=dense.intermediate_dim // 2,
+        n_experts=4,
+        n_experts_per_tok=2,
+        moe_aux_loss_coef=0.01,
+        moe_z_loss_coef=0.001,
+    )
+    return dense, moe
+
+
+def _sharded_serving_measure(
+    n_chips=2, n_reqs=4, prompt_len=32, max_new=32, page=32, chunk=8
+):
+    """Decode tok/s at 1 vs ``n_chips`` chips for a dense-TP arm and a
+    moe-EP arm, with token parity between the two engines asserted as
+    data (greedy decode: the sharded engine must reproduce the
+    single-chip stream exactly)."""
+    import jax
+
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.engine.sampling import SamplingParams
+    from areal_tpu.models import transformer
+
+    on_tpu = jax.default_backend() == "tpu"
+    dense_cfg, moe_cfg = _sharded_serving_cfgs(on_tpu)
+    out = {"n_chips": n_chips, "backend": jax.default_backend()}
+
+    def run(eng, cfg, tag, parity_tag):
+        submit_wave(eng, cfg, n_reqs, prompt_len, max_new, f"w{tag}")
+        drain(eng)  # warm: compiles included here, not in the timing
+        submit_wave(eng, cfg, n_reqs, prompt_len, max_new, f"t{tag}")
+        t0 = time.perf_counter()
+        n = drain(eng)
+        dt = time.perf_counter() - t0
+        # parity wave: SAME tag (= same prompts/qids) on both engines so
+        # the sharded stream is compared token-for-token
+        submit_wave(eng, cfg, n_reqs, prompt_len, max_new, parity_tag)
+        while eng.has_work:
+            eng.step()
+        outs = eng.drain_results()
+        return n / max(dt, 1e-9), {
+            q: list(o.output_ids) for q, o in outs.items()
+        }
+
+    for arm, cfg, spec in (
+        ("dense_tp", dense_cfg, MeshSpec(model=n_chips)),
+        ("moe_ep", moe_cfg, MeshSpec(expert=n_chips)),
+    ):
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        kw = dict(
+            sampling=SamplingParams(greedy=True),
+            cache_mode="paged", page_size=page,
+            prefill_chunk_tokens=max(page, 64),
+        )
+        e1 = make_engine(
+            cfg, params, n_reqs, prompt_len, max_new, chunk=chunk, **kw
+        )
+        tps1, toks1 = run(e1, cfg, f"{arm}1", f"p{arm}")
+        del e1
+        mesh = spec.make_mesh(jax.devices()[:n_chips])
+        eN = make_engine(
+            cfg, params, n_reqs, prompt_len, max_new, chunk=chunk,
+            mesh=mesh, **kw,
+        )
+        row = {
+            "chips1_decode_toks_per_sec": round(tps1, 1),
+        }
+        if arm == "moe_ep":
+            w = eN.params["layers"]["mlp"]["experts"]["gate"]
+            # sharded for real, never silently replicated (acceptance
+            # criterion: shard_shape != shape)
+            row["expert_shard_ok"] = bool(
+                w.sharding.shard_shape(w.shape) != w.shape
+            )
+        tpsN, toksN = run(eN, cfg, f"{arm}N", f"p{arm}")
+        del eN
+        row[f"chips{n_chips}_decode_toks_per_sec"] = round(tpsN, 1)
+        row["scaling_x"] = round(tpsN / max(tps1, 1e-9), 3)
+        row["token_parity"] = toks1 == toksN
+        out[arm] = row
+    return out
+
+
+def bench_sharded_serving(
+    n_chips=2, n_reqs=4, prompt_len=32, max_new=32, page=32, chunk=8
+):
+    """Sharded-serving scaling A/B (ROADMAP item 1's bench): decode tok/s
+    at 1 vs N chips, dense-TP and moe-EP arms.
+
+    CPU-smoke capable: when the current process has too few devices (a
+    plain off-TPU run initializes ONE CPU device, and jax 0.4.x cannot
+    grow the device count post-init), the measurement runs in a child
+    process with a provisioned virtual CPU mesh and its JSON line is
+    parsed back — so the summary always carries the section."""
+    import jax
+
+    if len(jax.devices()) >= n_chips:
+        return _sharded_serving_measure(
+            n_chips=n_chips, n_reqs=n_reqs, prompt_len=prompt_len,
+            max_new=max_new, page=page, chunk=chunk,
+        )
+    import json as _json
+    import subprocess
+    import sys
+
+    args = dict(
+        n_chips=n_chips, n_reqs=n_reqs, prompt_len=prompt_len,
+        max_new=max_new, page=page, chunk=chunk,
+    )
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_chips}"
+    )
+    env["PYTHONPATH"] = repo_root
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "bench.py"),
+            "--sharded-serving-child",
+            _json.dumps(args),
+        ],
+        env=env,
+        cwd=repo_root,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    lines = [
+        l for l in proc.stdout.strip().splitlines() if l.startswith("{")
+    ]
+    if proc.returncode != 0 or not lines:
+        return {
+            "error": (
+                f"child rc={proc.returncode}: "
+                + (proc.stderr or proc.stdout)[-500:]
+            )
+        }
+    return _json.loads(lines[-1])
+
+
+def _sharded_serving_child(argv_json: str) -> None:
+    """Child-process entry for the CPU-smoke path: the parent provisioned
+    the virtual CPU mesh via env; measure and print ONE JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(_sharded_serving_measure(**json.loads(argv_json))))
+
+
 #: per-section outcomes for the machine-parseable summary:
 #: {name: {"status": "ok"|"error"|"timeout", "seconds": wall}}.  A round
 #: that loses sections still reports WHICH ones and why.
@@ -984,19 +1159,15 @@ def _section(fn, *args, name=None, timeout_s=None, **kw):
     """Run one bench section; a failure becomes DATA (error string) so a
     single section can never zero out the whole round's bench.
 
-    With ``name`` the section also runs under its own fail-safe: a
-    daemon thread joined for ``timeout_s`` seconds, so a section that
+    With ``name`` the section also runs under its own fail-safe
+    (``areal_tpu.base.watchdog.run_bounded`` — the daemon-thread
+    watchdog shared with ``dryrun_multichip``'s phases): a section that
     HANGS (an axon backend init wedging inside a dispatch — BENCH_r05
     lost all of rounds 8/9's TPU numbers to exactly one such hang)
     forfeits only its own numbers; the round continues and the outcome
-    lands in the summary's per-section ``status`` table.  Best-effort by
-    design: a truly wedged thread may hold jax's dispatch lock and time
-    out the sections behind it too, but each of those is bounded the
-    same way and the round still emits its partial summary."""
-    import threading
+    lands in the summary's per-section ``status`` table."""
     import traceback
 
-    t0 = time.perf_counter()
     if name is None:
         try:
             return fn(*args, **kw)
@@ -1004,31 +1175,23 @@ def _section(fn, *args, name=None, timeout_s=None, **kw):
             traceback.print_exc()
             return {"error": f"{type(e).__name__}: {e}"[:300]}
 
-    box = {}
+    from areal_tpu.base.watchdog import run_bounded
 
-    def target():
-        try:
-            box["result"] = fn(*args, **kw)
-        except Exception as e:  # noqa: BLE001 - report, don't die
-            traceback.print_exc()
-            box["error"] = f"{type(e).__name__}: {e}"[:300]
-
-    th = threading.Thread(target=target, daemon=True, name=f"bench-{name}")
-    th.start()
     budget = timeout_s if timeout_s is not None else SECTION_TIMEOUT_S
-    th.join(budget)
-    seconds = round(time.perf_counter() - t0, 1)
-    if th.is_alive():
-        _SECTION_STATUS[name] = {"status": "timeout", "seconds": seconds}
+    out = run_bounded(
+        fn, *args, name=f"bench-{name}", timeout_s=budget, **kw
+    )
+    _SECTION_STATUS[name] = {
+        "status": out["status"], "seconds": out["seconds"]
+    }
+    if out["status"] == "timeout":
         return {
             "error": f"section {name!r} still running after {budget:.0f}s",
             "status": "timeout",
         }
-    if "error" in box:
-        _SECTION_STATUS[name] = {"status": "error", "seconds": seconds}
-        return {"error": box["error"]}
-    _SECTION_STATUS[name] = {"status": "ok", "seconds": seconds}
-    return box["result"]
+    if out["status"] == "error":
+        return {"error": out["error"]}
+    return out["result"]
 
 
 #: the machine-parseable summary's contract: these keys are ALWAYS
@@ -1043,6 +1206,7 @@ SUMMARY_REQUIRED_KEYS = (
     "prefix_cache_ab",
     "trace_overhead_ab",
     "spec_decode_ab",
+    "sharded_serving",
     "paged_decode_ab",
     "dispatch_table",
     "sections",
@@ -1055,6 +1219,7 @@ def build_summary(
     prefix_cache_ab=None,
     trace_overhead_ab=None,
     spec_decode_ab=None,
+    sharded_serving=None,
     decode_ab=None,
     pipeline_depth=2,
 ):
@@ -1087,6 +1252,7 @@ def build_summary(
         "prefix_cache_ab": prefix_cache_ab,
         "trace_overhead_ab": trace_overhead_ab,
         "spec_decode_ab": spec_decode_ab,
+        "sharded_serving": sharded_serving,
         "paged_decode_ab": (
             {
                 k: [
@@ -1747,6 +1913,22 @@ def main():
         ),
     )
 
+    # sharded-serving scaling: decode tok/s at 1 vs N chips, dense-TP +
+    # moe-EP arms (ROADMAP item 1).  Runs off-TPU too (child process
+    # with a virtual CPU mesh) so the summary always carries it.
+    mark("sharded serving")
+    sharded_n = min(4, len(devs)) if on_tpu else 2
+    sharded_serving = _section(
+        bench_sharded_serving,
+        n_chips=max(2, sharded_n),
+        name="sharded_serving",
+        **(
+            {}
+            if on_tpu
+            else dict(n_reqs=2, prompt_len=16, max_new=16, page=16, chunk=4)
+        ),
+    )
+
     # train->generation weight publish (sharded raw-param checkpoint,
     # inference dtype; reference budget <3 s)
     mark("publish")
@@ -1913,6 +2095,7 @@ def main():
         prefix_cache_ab=prefix_cache_ab,
         trace_overhead_ab=trace_overhead_ab,
         spec_decode_ab=spec_decode_ab,
+        sharded_serving=sharded_serving,
         decode_ab=decode_ab,
     )
 
@@ -1968,6 +2151,7 @@ def main():
                     "prefix_cache_ab": prefix_cache_ab,
                     "trace_overhead_ab": trace_overhead_ab,
                     "spec_decode_ab": spec_decode_ab,
+                    "sharded_serving": sharded_serving,
                 },
             }
         )
@@ -1975,4 +2159,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if "--sharded-serving-child" in _sys.argv:
+        _sharded_serving_child(
+            _sys.argv[_sys.argv.index("--sharded-serving-child") + 1]
+        )
+    else:
+        main()

@@ -59,7 +59,8 @@ def main():
         budgets = jnp.full((B,), 10_000, jnp.int32)
         rng = jax.random.PRNGKey(1)
         out = _decode_chunk(
-            params, cfg, cache, cur, active, budgets, rng, chunk, (),
+            params, cfg, cache, cur, active, budgets,
+            jnp.zeros((B,), jnp.int32), rng, chunk, (),
             sampling, attn_len=attn_len,
         )
         cache, out_t, out_l, em, cur, active, budgets, rng = out
@@ -69,7 +70,8 @@ def main():
         N = 3
         for _ in range(N):
             out = _decode_chunk(
-                params, cfg, cache, cur, active, budgets, rng, chunk, (),
+                params, cfg, cache, cur, active, budgets,
+                jnp.zeros((B,), jnp.int32), rng, chunk, (),
                 sampling, attn_len=attn_len,
             )
             cache, out_t, out_l, em, cur, active, budgets, rng = out

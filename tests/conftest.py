@@ -23,6 +23,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests excluded from the tier-1 budget "
+        "(run with `-m slow` or no marker filter)",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_globals():
     """Reset process-global state between tests."""

@@ -238,6 +238,11 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
         prefix_cache_ab={"replay_wall_speedup": 1.5},
         trace_overhead_ab=None,
         spec_decode_ab=spec_ab,
+        sharded_serving={
+            "n_chips": 2,
+            "dense_tp": {"scaling_x": 1.7, "token_parity": True},
+            "moe_ep": {"scaling_x": 1.5, "expert_shard_ok": True},
+        },
         decode_ab={
             "ctx2048_b16": {"dense_toks_per_sec": 1.0,
                             "paged_toks_per_sec": 2.0,
@@ -253,7 +258,26 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
     assert blob["decode"]["b4"]["decode_toks_per_sec"] is None
     assert blob["paged_decode_ab"]["ctx2048_b16"] == [1.0, 2.0, 3.0]
     assert blob["dispatch_table"] == {"paged_min_cache_len": 2048}
+    assert blob["sharded_serving"]["moe_ep"]["expert_shard_ok"] is True
     assert isinstance(blob["sections"], dict)
     # every recorded section row carries a status field
     for row in blob["sections"].values():
         assert row["status"] in ("ok", "error", "timeout")
+
+
+@pytest.mark.slow
+def test_sharded_serving_section_runs_inline_on_a_cpu_mesh():
+    """With enough local devices (the test harness's 8-device virtual
+    CPU mesh) the section measures INLINE — both arms report 1-vs-N
+    decode tok/s, greedy token parity holds, and the moe arm's expert
+    weights are genuinely sharded."""
+    out = bench.bench_sharded_serving(
+        n_chips=2, n_reqs=2, prompt_len=16, max_new=12, page=16, chunk=4
+    )
+    assert out["n_chips"] == 2
+    for arm in ("dense_tp", "moe_ep"):
+        row = out[arm]
+        assert row["chips1_decode_toks_per_sec"] > 0
+        assert row["chips2_decode_toks_per_sec"] > 0
+        assert row["token_parity"] is True, row
+    assert out["moe_ep"]["expert_shard_ok"] is True

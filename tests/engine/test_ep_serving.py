@@ -1,0 +1,247 @@
+"""Expert-parallel MoE serving: an ``expert``-axis mesh must produce the
+same greedy outputs as the replicated single-device engine, with the
+[L, E, D, F] expert weights ACTUALLY sharded (E/ep per chip — the whole
+point; a silently-replicated expert tree would pass token parity while
+defeating the memory scaling EP serving exists for).
+
+The EP hot path is the explicit shard_map in models/moe.py (local-expert
+ragged_dot groups + psum combine), mirroring the TP paged-attention
+shard_map in models/paged._prefix_partials; the matrix here covers the
+dense engine, the paged pool, TP+EP composed on one mesh, the radix
+prefix cache, and speculative decode (ISSUE 7 acceptance criteria).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.api.model_api import (
+    APIGenerateInput,
+    GenerationHyperparameters,
+)
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.engine.inference_server import ContinuousBatchingEngine
+from areal_tpu.engine.sampling import SamplingParams
+from areal_tpu.engine.spec_decode import SpecDecodeParams
+from areal_tpu.models import transformer
+from areal_tpu.models.config import tiny_config
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    cfg = tiny_config(
+        n_layers=2,
+        hidden_dim=64,
+        n_q_heads=4,
+        n_kv_heads=2,
+        head_dim=32,
+        intermediate_dim=128,
+        vocab_size=128,
+        max_position_embeddings=256,
+        dtype="float32",
+    )
+    cfg = dataclasses.replace(
+        cfg,
+        n_experts=4,
+        n_experts_per_tok=2,
+        moe_aux_loss_coef=0.01,
+        moe_z_loss_coef=0.001,
+    )
+    assert cfg.is_moe
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+_PAGED = dict(cache_mode="paged", page_size=32, prefill_chunk_tokens=32)
+
+
+def _generate(engine, n_reqs=3, max_new=8, repetitive=False, prefix=""):
+    rng = np.random.default_rng(0)
+    gcfg = GenerationHyperparameters(max_new_tokens=max_new, greedy=True)
+    for i in range(n_reqs):
+        if repetitive:
+            ids = ([7, 8, 9, 10] * 8)[: 20 + i]
+        else:
+            ids = rng.integers(0, 128, (5 + i,)).tolist()
+        engine.submit(
+            APIGenerateInput(
+                qid=f"{prefix}{i}", prompt_ids=ids, input_ids=ids,
+                gconfig=gcfg,
+            )
+        )
+    outs = {}
+    for _ in range(400):
+        engine.step()
+        for i in range(n_reqs):
+            q = f"{prefix}{i}"
+            if q not in outs:
+                r = engine.try_get_result(q)
+                if r is not None:
+                    outs[q] = r
+        if len(outs) == n_reqs:
+            break
+    assert len(outs) == n_reqs, "generation did not finish"
+    return outs
+
+
+def _assert_expert_sharded(engine, ep=2):
+    """Expert weights are genuinely EP-sharded, never silently
+    replicated (the acceptance-criterion assert)."""
+    for name in ("gate", "up", "down"):
+        w = engine.params["layers"]["mlp"]["experts"][name]
+        shard = w.sharding.shard_shape(w.shape)
+        assert shard != w.shape, (name, w.sharding)
+        assert shard[1] == w.shape[1] // ep, (name, shard, w.shape)
+
+
+def _assert_parity(ref, got, key_map=lambda q: q):
+    for q in ref:
+        assert ref[q].output_ids == got[key_map(q)].output_ids, q
+        np.testing.assert_allclose(
+            ref[q].output_logprobs, got[key_map(q)].output_logprobs,
+            rtol=1e-4, atol=1e-4,
+        )
+
+
+def test_ep2_paged_engine_matches_single_device(moe_model):
+    """The tier-1 EP smoke: paged MoE decode on an expert=2 CPU mesh is
+    token-identical to the replicated single-device engine."""
+    cfg, params = moe_model
+    kwargs = dict(
+        max_batch=4, kv_cache_len=256, chunk_size=4,
+        sampling=SamplingParams(temperature=1.0), **_PAGED,
+    )
+    single = ContinuousBatchingEngine(cfg, params, **kwargs)
+    ref = _generate(single)
+    mesh = MeshSpec(expert=2).make_mesh(jax.devices()[:2])
+    ep = ContinuousBatchingEngine(cfg, params, mesh=mesh, **kwargs)
+    _assert_expert_sharded(ep)
+    assert ep.mesh_devices == 2
+    got = _generate(ep)
+    _assert_parity(ref, got)
+
+
+@pytest.mark.slow
+def test_ep2_dense_engine_matches_single_device(moe_model):
+    cfg, params = moe_model
+    kwargs = dict(
+        max_batch=4, kv_cache_len=256, chunk_size=4,
+        sampling=SamplingParams(temperature=1.0),
+        cache_mode="dense",
+    )
+    single = ContinuousBatchingEngine(cfg, params, **kwargs)
+    ref = _generate(single)
+    mesh = MeshSpec(expert=2).make_mesh(jax.devices()[:2])
+    ep = ContinuousBatchingEngine(cfg, params, mesh=mesh, **kwargs)
+    assert not ep.paged
+    _assert_expert_sharded(ep)
+    got = _generate(ep)
+    _assert_parity(ref, got)
+
+
+@pytest.mark.slow
+def test_tp2_ep2_composed_mesh_matches_single_device(moe_model):
+    """Dense-TP and MoE-EP compose on one 4-chip mesh: attention shards
+    over ``model``, experts over ``expert``, outputs token-identical."""
+    cfg, params = moe_model
+    kwargs = dict(
+        max_batch=4, kv_cache_len=256, chunk_size=4,
+        sampling=SamplingParams(temperature=1.0), **_PAGED,
+    )
+    single = ContinuousBatchingEngine(cfg, params, **kwargs)
+    ref = _generate(single)
+    mesh = MeshSpec(model=2, expert=2).make_mesh(jax.devices()[:4])
+    eng = ContinuousBatchingEngine(cfg, params, mesh=mesh, **kwargs)
+    _assert_expert_sharded(eng)
+    qw = eng.params["layers"]["attn"]["q"]["w"]
+    assert qw.sharding.shard_shape(qw.shape) != qw.shape  # TP real too
+    assert eng.mesh_devices == 4
+    got = _generate(eng)
+    _assert_parity(ref, got)
+
+
+@pytest.mark.slow
+def test_ep2_spec_decode_token_identical(moe_model):
+    """Speculative verify windows ride the EP shard_map MLP: spec-ON on
+    the expert mesh is token-identical to spec-OFF single-device greedy,
+    with verify chunks genuinely dispatched."""
+    cfg, params = moe_model
+    kwargs = dict(
+        max_batch=4, kv_cache_len=256, chunk_size=4,
+        sampling=SamplingParams(greedy=True), **_PAGED,
+    )
+    single = ContinuousBatchingEngine(cfg, params, **kwargs)
+    ref = _generate(single, n_reqs=2, max_new=12, repetitive=True)
+    mesh = MeshSpec(expert=2).make_mesh(jax.devices()[:2])
+    spec = ContinuousBatchingEngine(
+        cfg, params, mesh=mesh,
+        spec_decode_params=SpecDecodeParams(
+            enabled=True, max_draft_tokens=3
+        ),
+        **kwargs,
+    )
+    assert spec._spec is not None
+    got = _generate(spec, n_reqs=2, max_new=12, repetitive=True)
+    for q in ref:
+        assert ref[q].output_ids == got[q].output_ids, q
+    assert spec.spec_verify_chunks_total > 0
+    assert spec.spec_accepted_total > 0
+
+
+@pytest.mark.slow
+def test_ep2_prefix_cache_hits_and_parity(moe_model):
+    """The radix prefix cache (pin + COW tail over the sharded pool)
+    works under the expert mesh: replayed prompts hit and reproduce."""
+    cfg, params = moe_model
+    kwargs = dict(
+        max_batch=4, kv_cache_len=256, chunk_size=4,
+        sampling=SamplingParams(greedy=True),
+        prefix_cache=True, **_PAGED,
+    )
+    mesh = MeshSpec(expert=2).make_mesh(jax.devices()[:2])
+    eng = ContinuousBatchingEngine(cfg, params, mesh=mesh, **kwargs)
+    first = _generate(eng, n_reqs=2)
+    replay = _generate(eng, n_reqs=2, prefix="re")
+    stats = eng.prefix_cache_stats()
+    assert stats["hits_total"] > 0, stats
+    assert stats["cached_tokens_total"] > 0, stats
+    _assert_parity(first, replay, key_map=lambda q: f"re{q}")
+
+
+def test_ep_mesh_rejects_indivisible_experts(moe_model):
+    cfg, params = moe_model
+    cfg3 = dataclasses.replace(cfg, n_experts=3)
+    params3 = transformer.init_params(cfg3, jax.random.PRNGKey(0))
+    mesh = MeshSpec(expert=2).make_mesh(jax.devices()[:2])
+    with pytest.raises(ValueError, match="not divisible"):
+        ContinuousBatchingEngine(
+            cfg3, params3, mesh=mesh, max_batch=2, kv_cache_len=256,
+            chunk_size=4,
+        )
+
+
+def test_expert_axis_on_dense_model_rejected():
+    cfg = tiny_config(vocab_size=64)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = MeshSpec(expert=2).make_mesh(jax.devices()[:2])
+    with pytest.raises(ValueError, match="dense"):
+        ContinuousBatchingEngine(
+            cfg, params, mesh=mesh, max_batch=2, kv_cache_len=256,
+            chunk_size=4,
+        )
+
+
+def test_ep_weight_update_keeps_expert_sharding(moe_model):
+    cfg, params = moe_model
+    mesh = MeshSpec(expert=2).make_mesh(jax.devices()[:2])
+    eng = ContinuousBatchingEngine(
+        cfg, params, mesh=mesh, max_batch=2, kv_cache_len=256,
+        chunk_size=4, **_PAGED,
+    )
+    new_params = jax.tree.map(lambda x: x * 1.01, params)
+    eng.update_weights(new_params, version=3)
+    eng._apply_pending_weights()
+    assert eng.version == 3
+    _assert_expert_sharded(eng)

@@ -24,6 +24,8 @@ def _manager(policy="least_requests", **cfg_kwargs):
     m._qid_server = {}
     m._server_load = {a: 0 for a in m.server_addrs}
     m._server_tokens = {a: 0.0 for a in m.server_addrs}
+    m._server_devices = {a: 1 for a in m.server_addrs}
+    m._server_mesh = {a: "" for a in m.server_addrs}
     m._qid_tokens = {}
     m._group_server = {}
     m._group_prefix = {}
@@ -60,6 +62,48 @@ def test_round_robin_cycles():
     m = _manager(policy="round_robin")
     got = [m._schedule(f"q{i}") for i in range(4)]
     assert got == ["s0", "s1", "s2", "s0"]
+
+
+def test_registration_value_round_trip():
+    """One server = one mesh: the registration value carries the mesh
+    shape and parses back; bare legacy addresses parse as 1 chip."""
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.system.generation_server import (
+        format_server_registration,
+        parse_server_registration,
+    )
+
+    v = format_server_registration("10.0.0.1:5555", MeshSpec(model=2, expert=2))
+    addr, devices, spec = parse_server_registration(v)
+    assert addr == "10.0.0.1:5555"
+    assert devices == 4
+    assert MeshSpec.from_str(spec) == MeshSpec(model=2, expert=2)
+    assert parse_server_registration("10.0.0.2:80") == ("10.0.0.2:80", 1, "")
+
+
+def test_least_requests_weighs_mesh_devices():
+    """A 4-chip mesh server with 4 requests is LESS loaded per chip than
+    a 1-chip server with 2 — capacity scales with chips."""
+    m = _manager()
+    m._server_devices.update({"s0": 4})
+    m._server_load.update({"s0": 4, "s1": 2, "s2": 3})
+    assert m._schedule("qa") == "s0"  # 1.0/chip beats 2.0 and 3.0
+
+
+def test_round_robin_weighs_mesh_devices():
+    """The weighted rotation hands a 2-chip server 2 of every 4 slots."""
+    m = _manager(policy="round_robin")
+    m._server_devices.update({"s1": 2})
+    got = [m._schedule(f"q{i}") for i in range(8)]
+    assert got == ["s0", "s1", "s1", "s2"] * 2
+
+
+def test_least_token_usage_weighs_mesh_devices():
+    m = _manager(policy="least_token_usage")
+    m._server_devices.update({"s2": 4})
+    m._server_tokens.update({"s0": 100.0, "s1": 150.0, "s2": 300.0})
+    # 300/4 = 75 per chip: the big mesh is the least loaded
+    assert m._schedule("qa") == "s2"
 
 
 def test_staleness_gate_units():

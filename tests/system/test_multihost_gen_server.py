@@ -98,12 +98,15 @@ def test_multihost_tp_generation(cluster):
 
     procs = cluster
     try:
-        addr = name_resolve.wait(
+        reg = name_resolve.wait(
             names.gen_server("mhgen", "t0", "gen_server_0"), timeout=180
         )
     except TimeoutError:
         pytest.fail(f"leader never registered:\n{_dump_on_failure(procs)}")
 
+    from areal_tpu.system.generation_server import parse_server_registration
+
+    addr, _devices, _spec = parse_server_registration(reg)
     client = GenServerClient(addr, timeout=180.0)
     out = client.generate(
         APIGenerateInput(
