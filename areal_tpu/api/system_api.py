@@ -213,6 +213,14 @@ class GenServerConfig:
     # deep DMA-ring variant once the batch's longest context crosses it
     paged_min_cache_len: Optional[int] = None
     deep_kernel_min_context: Optional[int] = None
+    # staged weight sync: transient HBM headroom knob for the staged
+    # restore (update_weights mode="stage").  The snapshot restores in
+    # layer chunks of at most this many bytes, placed directly at the
+    # engine's serving shardings, so peak footprint during a stage is
+    # old tree + staged-so-far + ONE chunk of restore buffers — not old
+    # tree + a full host copy + a full device copy like the legacy
+    # full-reload path.  None = one-shot restore (small models).
+    stage_chunk_bytes: Optional[int] = 256 * 1024 * 1024
     # which local device hosts this server's engine (trainer/generation
     # device split on one host; None = default device)
     device_idx: Optional[int] = None
@@ -252,6 +260,19 @@ class GserverManagerConfig:
     # failed (one flaky server must not block the fleet's version bump)
     update_weights_retries: int = 3
     update_weights_retry_backoff_s: float = 0.5
+    # zero-downtime weight sync (default on for published sharded
+    # snapshots): servers restore the new snapshot into a device-resident
+    # STAGING tree while decode continues (update_weights mode="stage",
+    # issued to the whole fleet concurrently), then the fleet pauses only
+    # for the pointer-flip commit — pause becomes max(commit) instead of
+    # sum(load + transfer + apply).  A server whose stage fails falls
+    # back to the legacy full reload inside the pause window, so the
+    # fleet always converges on one version.  False = legacy full
+    # reloads (still fanned out concurrently).
+    staged_weight_updates: bool = True
+    # per-server timeout for the stage RPC — generous, because staging
+    # runs OFF the paused critical path (decode continues throughout)
+    stage_request_timeout: float = 600.0
     trace: Optional[TraceConfig] = None
 
 

@@ -15,9 +15,10 @@ for interop stays separate (TrainEngine.save_hf).
 
 from __future__ import annotations
 
+import json
 import os
 import re
-from typing import Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -25,6 +26,11 @@ import numpy as np
 from areal_tpu.base import logging_
 
 logger = logging_.getLogger("checkpoint")
+
+#: filename of the layout/dtype manifest published next to a raw-param
+#: snapshot (see :func:`write_manifest`); lives INSIDE the snapshot dir
+#: so the keep-last-2 GC removes it with the arrays
+MANIFEST_NAME = "areal_manifest.json"
 
 _checkpointer = None
 
@@ -135,6 +141,170 @@ def load_params_like(template, path: str):
 
     target = jax.tree.map(_abstract, template)
     return ck.restore(path, target)
+
+
+# -- staged (chunked, sharding-direct) restore -------------------------------
+
+
+def _only_dicts(tree) -> bool:
+    """True iff every container in ``tree`` is a plain dict — the shape
+    the partial-restore chunker can address by key path.  Param trees in
+    this repo are nested dicts; anything else falls back to the one-shot
+    restore."""
+    if isinstance(tree, dict):
+        return all(_only_dicts(v) for v in tree.values())
+    return not isinstance(tree, (list, tuple))
+
+
+def _flatten_dict(tree, prefix=()) -> List[Tuple[Tuple[str, ...], Any]]:
+    out: List[Tuple[Tuple[str, ...], Any]] = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.extend(_flatten_dict(tree[k], prefix + (str(k),)))
+    else:
+        out.append((prefix, tree))
+    return out
+
+
+def _insert_path(tree: Dict, path: Tuple[str, ...], value):
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
+
+
+def load_params_staged(
+    template,
+    path: str,
+    chunk_bytes: Optional[int] = None,
+):
+    """Restore a published raw-param tree onto ``template``'s shardings in
+    layer-sized CHUNKS — the staged half of the zero-downtime weight swap.
+
+    Each chunk is a partial orbax restore of <= ``chunk_bytes`` worth of
+    leaves, placed DIRECTLY at the template leaf's sharding/dtype (each
+    chip reads only its own shard ranges from the snapshot; there is
+    never a host-side full tree, and the transient restore buffers are
+    bounded by one chunk instead of the whole model).  With the old
+    full-reload path the peak footprint during a swap was old tree +
+    full host copy + full device copy; staged it is old tree + staged-
+    so-far + one chunk of read buffers.  ``chunk_bytes=None`` (or a
+    non-dict param tree) falls back to the one-shot
+    :func:`load_params_like` restore — same result, bigger transient.
+
+    The returned tree is fully device-resident but NOT yet blocked-on;
+    callers that need the swap pause to exclude transfer time should
+    ``jax.block_until_ready`` it before pausing (the engine's
+    ``stage_weights`` does)."""
+    if chunk_bytes is None or chunk_bytes <= 0 or not _only_dicts(template):
+        return load_params_like(template, path)
+    path = os.path.abspath(path)
+    import orbax.checkpoint as ocp
+    from orbax.checkpoint import checkpoint_utils
+
+    flat = _flatten_dict(template)
+    # greedy size-bounded chunking in stable (sorted-path) order: leaves
+    # of one layer stack are adjacent, so a chunk is "a few layers"
+    chunks: List[List[Tuple[Tuple[str, ...], Any]]] = [[]]
+    used = 0
+    for keypath, leaf in flat:
+        nbytes = int(
+            np.prod(leaf.shape) * np.dtype(leaf.dtype).itemsize
+        ) if hasattr(leaf, "shape") else 0
+        if chunks[-1] and used + nbytes > chunk_bytes:
+            chunks.append([])
+            used = 0
+        chunks[-1].append((keypath, leaf))
+        used += nbytes
+
+    def _abstract(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        x = np.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    restorer = ocp.PyTreeCheckpointer()
+    out: Dict = {}
+    for chunk in chunks:
+        item: Dict = {}
+        for keypath, leaf in chunk:
+            _insert_path(item, keypath, _abstract(leaf))
+        restored = restorer.restore(
+            path,
+            item=item,
+            # transforms={} switches orbax to partial-restore semantics:
+            # leaves absent from ``item`` are skipped entirely (their
+            # bytes are never read), which is what bounds the chunk
+            transforms={},
+            restore_args=checkpoint_utils.construct_restore_args(item),
+        )
+        for keypath, _ in chunk:
+            node = restored
+            for k in keypath:
+                node = node[k]
+            _insert_path(out, keypath, node)
+    return out
+
+
+def write_manifest(params, path: str, version: Optional[int] = None):
+    """Publish a layout/dtype manifest INSIDE a snapshot dir: per-leaf
+    key path, shape, and dtype (plus the version).  Consumers validate
+    their staging template against it BEFORE opening tensorstore arrays,
+    so a layout/arch mismatch fails as one readable error instead of an
+    orbax stack trace mid-restore — and readers can cheaply probe that a
+    snapshot survived keep-last-2 GC."""
+    leaves = {
+        "/".join(kp): {
+            "shape": list(getattr(leaf, "shape", ())),
+            "dtype": str(np.dtype(getattr(leaf, "dtype", np.float32))),
+        }
+        for kp, leaf in _flatten_dict(params)
+    }
+    manifest = {"version": version, "leaves": leaves}
+    # per-process tmp name: on multi-host publishes every host writes the
+    # same snapshot dir, and a SHARED tmp path would let one writer
+    # truncate another's in-progress file and os.replace torn bytes into
+    # place (the hosts' contents are identical, so last-replace-wins is
+    # fine once each write is private)
+    tmp = os.path.join(path, f"{MANIFEST_NAME}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+    return manifest
+
+
+def read_manifest(path: str) -> Optional[Dict]:
+    """The manifest written by :func:`write_manifest`, or None when the
+    snapshot predates manifests (older publishers) or is gone."""
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def validate_manifest(template, manifest: Dict) -> List[str]:
+    """Mismatches between ``template`` and a snapshot manifest, as
+    readable strings (empty = compatible).  Dtype differences are NOT
+    mismatches — orbax casts on restore (publishers write inference
+    dtype; consumers may hold fp32)."""
+    problems: List[str] = []
+    mine = {
+        "/".join(kp): list(getattr(leaf, "shape", ()))
+        for kp, leaf in _flatten_dict(template)
+    }
+    theirs = {k: v["shape"] for k, v in manifest.get("leaves", {}).items()}
+    for k in sorted(set(mine) - set(theirs)):
+        problems.append(f"missing from snapshot: {k}")
+    for k in sorted(set(theirs) - set(mine)):
+        problems.append(f"unexpected in snapshot: {k}")
+    for k in sorted(set(mine) & set(theirs)):
+        if mine[k] != theirs[k]:
+            problems.append(
+                f"shape mismatch at {k}: engine {mine[k]} vs "
+                f"snapshot {theirs[k]}"
+            )
+    return problems
 
 
 def latest_train_state(

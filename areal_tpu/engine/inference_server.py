@@ -606,7 +606,14 @@ class ContinuousBatchingEngine:
         self._results: Dict[str, model_api.APIGenerateOutput] = {}
         self._result_events: Dict[str, threading.Event] = {}
         self._lock = threading.Lock()
-        self._new_params = None
+        # pending swap: (params, target_version|None, pre_sharded) — one
+        # atomic cell so a racing second update can never mix its version
+        # with an earlier request's tree.  pre_sharded marks a STAGED
+        # tree (already device-resident under this engine's shardings):
+        # the apply skips the device_put and becomes a pointer flip.
+        self._new_params: Optional[Tuple[Any, Optional[int], bool]] = None
+        self._staged_params = None
+        self._staged_version: Optional[int] = None
         self._paused = threading.Event()
         self.gen_tokens_total = 0
         self.prefill_tokens_total = 0  # unique-prompt tokens actually run
@@ -626,6 +633,15 @@ class ContinuousBatchingEngine:
         # oldest chunk already complete (its fetch fully overlapped)
         self.async_fetches_total = 0
         self.fetch_ready_total = 0
+        # weight-swap time attribution (cumulative seconds): stage =
+        # restoring/transferring a staged tree while decode continued
+        # (off the paused critical path); pause = the swap work that DOES
+        # interrupt decode (_apply_pending_weights: ring drain + pointer
+        # flip or device_put + prefix-cache flush + in-flight recompute)
+        self.swap_stage_s = 0.0
+        self.swap_pause_s = 0.0
+        self.swaps_total = 0
+        self.swaps_staged_total = 0
         self.park_ttl_steps = 512  # engine steps a parked row may idle
         # True = decode only, admit nothing (drain-before-update servers)
         self.hold_admissions = False
@@ -890,18 +906,112 @@ class ContinuousBatchingEngine:
                 self._result_events.pop(qid, None)
         return out
 
-    def update_weights(self, params, version: Optional[int] = None) -> int:
+    def update_weights(
+        self,
+        params,
+        version: Optional[int] = None,
+        pre_sharded: bool = False,
+    ) -> int:
         """Swap weights between chunks; in-flight rows' KV is recomputed under
         the new weights on the next loop iteration.  Returns the number of
-        interrupted (in-flight) requests — the patch's return contract."""
+        interrupted (in-flight) requests — the patch's return contract.
+
+        ``pre_sharded``: the tree is already device-resident under this
+        engine's shardings (a staged tree); the apply becomes a pure
+        pointer flip with no transfer on the paused critical path."""
         with self._lock:
-            self._new_params = params
-            n_inflight = sum(
-                r is not None and not r.parked for r in self.rows
+            self._new_params = (params, version, pre_sharded)
+            return self.n_inflight
+
+    # -- staged (zero-downtime) weight sync ---------------------------------
+
+    def stage_weights(self, params, version: int) -> int:
+        """Prepare ``params`` as a device-resident STAGED tree while decode
+        continues: shard onto this engine's param shardings (a no-op when
+        the caller restored directly onto them) and block until every
+        buffer is materialized — so the later :meth:`commit_staged` pays
+        zero transfer inside the fleet pause.  Safe to call from a
+        non-engine thread; only the staged slot is touched."""
+        tik = time.perf_counter()
+        if self._param_shardings is not None:
+            params = jax.device_put(params, self._param_shardings)
+        elif self.device is not None:
+            params = jax.device_put(params, self.device)
+        jax.block_until_ready(params)
+        with self._lock:
+            if version is not None and version <= self.version:
+                # stale stage: a same-or-newer tree already serves (the
+                # round fell back to a full reload while this restore
+                # was still running).  Parking the tree anyway would pin
+                # a whole extra model copy in HBM until the next round.
+                self.swap_stage_s += time.perf_counter() - tik
+                logger.info(
+                    "discarding stale staged weights v%s (engine already "
+                    "at v%d)", version, self.version,
+                )
+                return version
+            self._staged_params = params
+            self._staged_version = version
+        self.swap_stage_s += time.perf_counter() - tik
+        logger.info(
+            "staged weights v%d in %.3fs (decode uninterrupted)",
+            version, time.perf_counter() - tik,
+        )
+        return version
+
+    @property
+    def staged_version(self) -> Optional[int]:
+        """Version of the currently staged (uncommitted) tree, if any."""
+        return self._staged_version
+
+    @property
+    def pending_version(self) -> Optional[int]:
+        """Target version of a committed-but-not-yet-applied swap (the
+        engine applies it at its next unpaused step).  Lets a commit
+        RETRY whose first reply was lost be acknowledged idempotently
+        instead of failing the fleet round."""
+        with self._lock:
+            return self._new_params[1] if self._new_params else None
+
+    def commit_staged(self, expected_version: Optional[int] = None) -> int:
+        """Pointer-flip commit of the staged tree: the next engine step
+        drains the ring and swaps by reference — no load, no transfer.
+        ``expected_version`` guards the fleet's version-consistent commit
+        barrier (a manager must never commit a different version than it
+        staged).  Returns the interrupted-request count, like
+        :meth:`update_weights`."""
+        with self._lock:
+            if self._staged_params is None:
+                raise RuntimeError("no staged weights to commit")
+            if (
+                expected_version is not None
+                and self._staged_version != expected_version
+            ):
+                raise RuntimeError(
+                    f"staged weights are v{self._staged_version}, commit "
+                    f"asked for v{expected_version}"
+                )
+            self._new_params = (
+                self._staged_params, self._staged_version, True
             )
-            if version is not None:
-                self._target_version = version
-        return n_inflight
+            self._staged_params = None
+            self._staged_version = None
+            return self.n_inflight
+
+    def discard_staged(self):
+        """Drop an uncommitted staged tree (an aborted fleet round)."""
+        with self._lock:
+            self._staged_params = None
+            self._staged_version = None
+
+    def swap_stats(self) -> Dict[str, float]:
+        """Cumulative weight-swap counters (worker scrape + bench)."""
+        return {
+            "stage_s": self.swap_stage_s,
+            "pause_s": self.swap_pause_s,
+            "swaps_total": self.swaps_total,
+            "swaps_staged_total": self.swaps_staged_total,
+        }
 
     def pause(self):
         self._paused.set()
@@ -954,22 +1064,45 @@ class ContinuousBatchingEngine:
         with self._lock:
             if self._new_params is None:
                 return
+        tik = time.perf_counter()
         # the host row state must be exact before re-prefilling in-flight
         # rows: quiesce the WHOLE pipeline ring first (every dispatched
         # chunk was computed under the old weights and must be folded in
         # before the swap — none may be emitted after it as if new)
         self._drain_ring()
         with self._lock:
-            new_params = self._new_params
+            pending = self._new_params
             self._new_params = None
-        if new_params is None:
+        if pending is None:
             return
-        if self._param_shardings is not None:
-            new_params = jax.device_put(new_params, self._param_shardings)
-        elif self.device is not None:
-            new_params = jax.device_put(new_params, self.device)
+        new_params, target_version, pre_sharded = pending
+        if not pre_sharded:
+            # legacy full path: the transfer happens HERE, on the paused
+            # critical path.  A staged tree already sits sharded on the
+            # devices (stage_weights block_until_ready'd it), so the swap
+            # below is a pure pointer flip.
+            if self._param_shardings is not None:
+                new_params = jax.device_put(new_params, self._param_shardings)
+            elif self.device is not None:
+                new_params = jax.device_put(new_params, self.device)
         self.params = new_params
-        self.version = getattr(self, "_target_version", self.version + 1)
+        self.version = (
+            target_version if target_version is not None else self.version + 1
+        )
+        with self._lock:
+            # an uncommitted staged tree at or below the version we just
+            # applied is dead weight (a stage-fallback round's leftover):
+            # free its HBM now instead of at the next round's stage
+            if (
+                self._staged_version is not None
+                and self._staged_version <= self.version
+            ):
+                logger.info(
+                    "dropping stale staged weights v%d (applied v%d)",
+                    self._staged_version, self.version,
+                )
+                self._staged_params = None
+                self._staged_version = None
         # parked rows hold KV computed under the OLD weights; resuming over
         # it would mix weight versions in attention.  Evict them — their
         # continuation re-prefills under the new weights, which is exactly
@@ -1035,10 +1168,18 @@ class ContinuousBatchingEngine:
                     np.int32,
                 )
                 self.cur_tokens = self.cur_tokens.at[ids].set(curs)
+        dt = time.perf_counter() - tik
+        self.swap_pause_s += dt
+        self.swaps_total += 1
+        if pre_sharded:
+            self.swaps_staged_total += 1
         logger.info(
-            "weights updated to v%d (%d in-flight recomputed)",
+            "weights updated to v%d (%d in-flight recomputed, %s, %.3fs "
+            "interrupted)",
             self.version,
             self.n_inflight,
+            "pointer-flip" if pre_sharded else "full reload",
+            dt,
         )
 
     def _prefill_rows(

@@ -179,6 +179,31 @@ METRIC_TABLE = [
         "gauge",
         "Weight version the engine currently serves",
     ),
+    MetricSpec(
+        "areal_inference_swap_stage_seconds_total",
+        "counter",
+        "Time spent restoring/transferring staged weight trees while "
+        "decode continued (the off-critical-path half of a staged swap)",
+    ),
+    MetricSpec(
+        "areal_inference_swap_pause_seconds_total",
+        "counter",
+        "Time weight swaps actually interrupted decode (ring drain + "
+        "pointer flip or full reload + prefix flush + in-flight "
+        "recompute)",
+    ),
+    MetricSpec(
+        "areal_inference_weight_swaps_total",
+        "counter",
+        "Weight swaps applied by the engine (staged pointer-flips + "
+        "legacy full reloads)",
+    ),
+    MetricSpec(
+        "areal_inference_weight_swaps_staged_total",
+        "counter",
+        "Weight swaps applied as staged pointer-flips (pre-restored, "
+        "zero transfer inside the pause)",
+    ),
     # -- gserver manager (system/gserver_manager.py) -------------------------
     MetricSpec(
         "areal_gserver_alloc_rejections_total",
@@ -230,6 +255,20 @@ METRIC_TABLE = [
         "counter",
         "Sessions re-routed away from their prefix-hot server because "
         "the load-imbalance escape hatch fired",
+    ),
+    MetricSpec(
+        "areal_gserver_weight_update_pause_seconds",
+        "gauge",
+        "Fleet pause of the most recent weight update (pause RPCs to "
+        "resume RPCs) — staged rounds pay max(commit), legacy rounds "
+        "pay the full reload",
+    ),
+    MetricSpec(
+        "areal_gserver_weight_updates_total",
+        "counter",
+        "Fleet weight-update rounds attempted, by protocol "
+        "(staged | full)",
+        ("mode",),
     ),
     # -- master buffer (system/buffer.py) ------------------------------------
     MetricSpec(

@@ -208,6 +208,11 @@ class GenerationServerWorker(worker_base.Worker):
         # qid -> ROUTER identity awaiting the result (leader only)
         self._waiting: Dict[str, bytes] = {}
         self._update_reply_idents = []  # clients awaiting update_weights
+        # in-flight staged weight restore (update_weights mode="stage"):
+        # a background thread restores the snapshot into a device-resident
+        # staging tree while decode continues; the RPC reply is deferred
+        # until the tree is resident (the manager's pre-pause barrier)
+        self._staging: Optional[Dict] = None
         self._start_time = time.monotonic()
 
         # observability: the engine keeps plain cumulative floats (no
@@ -254,6 +259,16 @@ class GenerationServerWorker(worker_base.Worker):
             "spec_fallback_rows": reg.counter(
                 "areal_inference_spec_fallback_rows_total"
             ),
+            "swap_stage": reg.counter(
+                "areal_inference_swap_stage_seconds_total"
+            ),
+            "swap_pause": reg.counter(
+                "areal_inference_swap_pause_seconds_total"
+            ),
+            "swaps": reg.counter("areal_inference_weight_swaps_total"),
+            "swaps_staged": reg.counter(
+                "areal_inference_weight_swaps_staged_total"
+            ),
             "inflight": reg.gauge("areal_inference_inflight_rows"),
             "pending": reg.gauge("areal_inference_pending_requests"),
             "version": reg.gauge("areal_inference_weight_version"),
@@ -290,6 +305,10 @@ class GenerationServerWorker(worker_base.Worker):
             "spec_rejected": float(sstats["rejected_total"]),
             "spec_verify_chunks": float(sstats["verify_chunks_total"]),
             "spec_fallback_rows": float(sstats["fallback_rows_total"]),
+            "swap_stage": eng.swap_stage_s,
+            "swap_pause": eng.swap_pause_s,
+            "swaps": float(eng.swaps_total),
+            "swaps_staged": float(eng.swaps_staged_total),
         }
         for key, total in totals.items():
             delta = total - self._obs_last.get(key, 0.0)
@@ -352,20 +371,45 @@ class GenerationServerWorker(worker_base.Worker):
             if cmd == "generate":
                 self.engine.submit(payload)
             elif cmd == "update_weights":
+                if (payload.get("mode") or "full") == "stage":
+                    # deferred reply: the stage RPC answers only once the
+                    # staged tree is device-resident (see _reply_staged)
+                    self._begin_stage(payload)
+                    continue
+                commit_failed = None
                 try:
-                    n = self._update_weights(payload)
+                    if (payload.get("mode") or "full") == "commit":
+                        n = self._commit_staged(payload)
+                    else:
+                        n = self._update_weights(payload)
                     resp = {
                         "num_interrupted": n,
                         "version": self.engine.version,
                     }
                 except Exception as e:  # noqa: BLE001
                     self.logger.exception("weight update failed")
+                    commit_failed = e
                     resp = {"error": repr(e)}
                 if self._is_leader and self._update_reply_idents:
                     ident = self._update_reply_idents.pop(0)
                     self._sock.send_multipart(
                         [ident, b"", pickle.dumps(resp)]
                     )
+                if (
+                    commit_failed is not None
+                    and self._n_procs > 1
+                    and (payload.get("mode") or "full") == "commit"
+                ):
+                    # multi-host lockstep: a commit that fails on ONE
+                    # controller while peers flip would leave shards of
+                    # one SPMD computation serving different weight
+                    # versions — silently corrupted tokens.  Die loudly
+                    # instead (same policy as a ctrl-stream seq gap).
+                    raise RuntimeError(
+                        "staged weight commit failed on one SPMD "
+                        "controller — versions would diverge across "
+                        "the lockstep mesh"
+                    ) from commit_failed
             elif cmd == "pause":
                 self.engine.pause()
             elif cmd == "resume":
@@ -386,18 +430,157 @@ class GenerationServerWorker(worker_base.Worker):
         ``format == "params"`` is the fast path: a sharded raw-param orbax
         tree restored straight onto this engine's shardings/dtypes (no HF
         conversion, resharding handled by orbax).  Plain HF checkpoint dirs
-        remain accepted for cross-job swaps."""
+        remain accepted for cross-job swaps.
+
+        This is the LEGACY full-reload path: the restore runs on the poll
+        thread, so a paused fleet waits out disk + transfer here.  The
+        staged protocol (``mode="stage"`` then ``mode="commit"``) moves
+        everything but the pointer flip off that critical path."""
+        params = self._load_update_params(payload, staged=False)
+        return self.engine.update_weights(
+            params, version=payload.get("version")
+        )
+
+    # -- staged weight sync (stage -> commit) --------------------------------
+
+    def _load_update_params(self, payload: Dict, staged: bool):
+        """Restore the snapshot named by an update payload.  The staged
+        path restores layer-chunked straight onto the engine's serving
+        shardings (each chip reads only its own shard ranges; transient
+        restore buffers bounded by ``stage_chunk_bytes``) and pre-checks
+        the publisher's layout manifest so an arch mismatch fails as one
+        readable error instead of an orbax stack trace."""
         path = payload.get("path")
-        version = payload.get("version")
         if payload.get("format") == "params":
             from areal_tpu.engine import checkpoint
 
-            params = checkpoint.load_params_like(self.engine.params, path)
-        else:
-            from areal_tpu.models.hf.registry import load_hf_model
+            if staged:
+                manifest = checkpoint.read_manifest(path)
+                if manifest is not None:
+                    problems = checkpoint.validate_manifest(
+                        self.engine.params, manifest
+                    )
+                    if problems:
+                        raise RuntimeError(
+                            "published snapshot does not match this "
+                            f"engine's layout: {problems[:3]}"
+                        )
+                return checkpoint.load_params_staged(
+                    self.engine.params,
+                    path,
+                    chunk_bytes=getattr(
+                        self.config, "stage_chunk_bytes", None
+                    ),
+                )
+            return checkpoint.load_params_like(self.engine.params, path)
+        from areal_tpu.models.hf.registry import load_hf_model
 
-            _, params = load_hf_model(path)
-        return self.engine.update_weights(params, version=version)
+        _, params = load_hf_model(path)
+        return params
+
+    def _begin_stage(self, payload: Dict):
+        """Start restoring ``payload``'s snapshot into a device-resident
+        staging tree on a background thread — decode continues.  The RPC
+        reply is sent by :meth:`_reply_staged` once the tree is resident
+        (or the restore failed), which is the manager's pre-pause
+        barrier."""
+        ident = None
+        if self._is_leader and self._update_reply_idents:
+            ident = self._update_reply_idents.pop(0)
+        if self._staging is not None and not self._staging["done"].is_set():
+            # a concurrent round is still restoring: the manager is
+            # retrying after a timeout — reply fail-fast (it re-polls the
+            # published version; by then this staging has settled)
+            if ident is not None:
+                self._sock.send_multipart([
+                    ident, b"",
+                    pickle.dumps({"error": "weight staging in progress"}),
+                ])
+            return
+        # an aborted round may have left an uncommitted tree: drop it so
+        # the commit barrier can never flip a stale version
+        self.engine.discard_staged()
+        rec: Dict = {
+            "done": threading.Event(),
+            "result": None,
+            "ident": ident,
+            "replied": False,
+            "version": payload.get("version"),
+            "t0": time.monotonic(),
+        }
+        rec["thread"] = threading.Thread(
+            target=self._stage_worker,
+            args=(payload, rec),
+            daemon=True,
+            name=f"weight-stage-v{payload.get('version')}",
+        )
+        self._staging = rec
+        rec["thread"].start()
+
+    def _stage_worker(self, payload: Dict, rec: Dict):
+        try:
+            params = self._load_update_params(payload, staged=True)
+            # device_put onto the serving shardings (no-op when the
+            # restore already placed them there) + block_until_ready:
+            # the commit's pointer flip pays zero transfer
+            self.engine.stage_weights(params, payload.get("version"))
+            rec["result"] = {
+                "staged": payload.get("version"),
+                "stage_seconds": round(time.monotonic() - rec["t0"], 4),
+            }
+        except Exception as e:  # noqa: BLE001 - reported to the manager
+            self.logger.exception("weight staging failed")
+            rec["result"] = {"error": repr(e)}
+        finally:
+            rec["done"].set()
+
+    def _reply_staged(self):
+        """Answer a finished stage RPC (leader poll loop; followers have
+        no ident and just let the record sit until commit)."""
+        rec = self._staging
+        if rec is None or rec["replied"] or not rec["done"].is_set():
+            return
+        rec["replied"] = True
+        if rec["ident"] is not None:
+            self._sock.send_multipart(
+                [rec["ident"], b"", pickle.dumps(rec["result"])]
+            )
+
+    def _commit_staged(self, payload: Dict) -> int:
+        """Version-consistent commit barrier: wait out any still-running
+        local staging (SPMD followers can lag the leader), surface a
+        failed restore, then pointer-flip the staged tree into the
+        engine.  The fleet pause covers exactly this call plus the
+        engine's next-step ring drain."""
+        rec = self._staging
+        version = payload.get("version")
+        if rec is not None:
+            if not rec["done"].wait(
+                timeout=float(payload.get("commit_timeout", 60.0))
+            ):
+                raise RuntimeError("staged restore still running at commit")
+            self._reply_staged()  # never leave a stage RPC unanswered
+            self._staging = None
+            result = rec["result"]
+            if isinstance(result, dict) and "error" in result:
+                raise RuntimeError(
+                    f"staged restore failed: {result['error']}"
+                )
+        if self.engine.staged_version is None and version is not None and (
+            self.engine.version == version
+            or self.engine.pending_version == version
+        ):
+            # idempotent retry ack: the first commit flipped (or queued)
+            # this exact version but its reply was lost in flight — the
+            # manager's timeout-retry must not turn a completed round
+            # into a failed one (the legacy full reload was idempotent
+            # under the same retry loop)
+            self.logger.info(
+                "commit v%s retried after a lost reply: already applied",
+                version,
+            )
+            return 0
+        return self.engine.commit_staged(expected_version=version)
 
     def metrics(self) -> Dict:
         return {
@@ -431,6 +614,13 @@ class GenerationServerWorker(worker_base.Worker):
                 f"time_{k}": v
                 for k, v in self.engine.timing_split().items()
             },
+            # weight-swap attribution: staging time (off the paused
+            # critical path) vs pause time (what actually interrupted
+            # decode), plus staged-vs-full swap counts
+            **{
+                f"swap_{k}": v
+                for k, v in self.engine.swap_stats().items()
+            },
         }
 
     # -- poll ---------------------------------------------------------------
@@ -447,6 +637,7 @@ class GenerationServerWorker(worker_base.Worker):
             self._apply_commands(batch)
             n = self.engine.step()
             self._reply_finished()
+            self._reply_staged()
             self._export_engine_metrics()
             return worker_base.PollResult(sample_count=n)
         # follower: lockstep replay of the leader's command stream — one
@@ -463,6 +654,7 @@ class GenerationServerWorker(worker_base.Worker):
         self._apply_commands(batch)
         n = self.engine.step()
         self.engine.drain_results()  # leader owns client replies
+        self._reply_staged()  # followers: just mark the record settled
         self._export_engine_metrics()
         return worker_base.PollResult(sample_count=n)
 

@@ -151,6 +151,13 @@ class GserverManager(worker_base.Worker):
         self._m_affinity_escapes = reg.counter(
             "areal_gserver_affinity_escapes_total"
         )
+        self._m_update_pause = reg.gauge(
+            "areal_gserver_weight_update_pause_seconds"
+        )
+        self._m_updates = reg.counter(
+            "areal_gserver_weight_updates_total"
+        )
+        self._update_pool = None
 
     def _devices(self, addr: str) -> int:
         """Chip count of a server's mesh (1 for hand-built/legacy
@@ -408,20 +415,28 @@ class GserverManager(worker_base.Worker):
             return None
         return info
 
-    def _update_one_server(self, addr: str, client, payload: Dict):
+    def _update_one_server(
+        self, addr: str, client, payload: Dict, timeout: Optional[float] = None
+    ):
         """Per-server ``update_weights`` with bounded-backoff retries: a
         TRANSIENT RPC failure (timeout, connection reset, a server busy
         draining a long chunk) on ONE server must not fail the whole
         fleet's version bump.  A server-side rejection (the client
         raises ``RuntimeError`` for an ``{"error": ...}`` response, e.g.
         a bad checkpoint path) reproduces on every attempt and fails the
-        server IMMEDIATELY — these calls run while the WHOLE fleet is
-        paused, so each attempt is also capped at
-        ``flush_request_timeout`` (not the client's default 600s).
+        server IMMEDIATELY — commit/full calls run while the WHOLE fleet
+        is paused, so each attempt is also capped at
+        ``flush_request_timeout`` (stage calls pass the longer
+        ``stage_request_timeout``: decode continues while they run).
         Returns the success response dict, or the failure (exception
         repr / bad response) once retries are spent."""
         retries = max(1, self.config.update_weights_retries)
         backoff = max(0.0, self.config.update_weights_retry_backoff_s)
+        if timeout is None:
+            timeout = self.config.flush_request_timeout
+        #: stage replies carry "staged"; commit/full replies carry
+        #: "num_interrupted" — either marks success
+        ok_keys = ("num_interrupted", "staged")
         last = None
         for attempt in range(retries):
             if attempt:
@@ -430,7 +445,7 @@ class GserverManager(worker_base.Worker):
                 resp = client.call(
                     "update_weights",
                     payload,
-                    timeout=self.config.flush_request_timeout,
+                    timeout=timeout,
                 )
             except (TimeoutError, ConnectionError, OSError) as e:
                 last = repr(e)
@@ -446,7 +461,7 @@ class GserverManager(worker_base.Worker):
                     addr, last,
                 )
                 return last
-            if isinstance(resp, dict) and "num_interrupted" in resp:
+            if isinstance(resp, dict) and any(k in resp for k in ok_keys):
                 return resp
             # a malformed (non-error, non-success) response reproduces
             # too: report it without burning paused-fleet time on retries
@@ -457,12 +472,50 @@ class GserverManager(worker_base.Worker):
             return last
         return last
 
+    def _fan_out(self, fn, items):
+        """Run ``fn(addr, client)`` for every server CONCURRENTLY on a
+        persistent thread pool and return ``{addr: result}``.  The pool
+        is long-lived so the clients' thread-local sockets are reused
+        across rounds instead of churning one DEALER per call.  ``fn``
+        must not raise (the update/pause/resume wrappers below return
+        failures as values)."""
+        items = list(items)
+        if len(items) <= 1:
+            return {addr: fn(addr, client) for addr, client in items}
+        import concurrent.futures as cf
+
+        if getattr(self, "_update_pool", None) is None:
+            self._update_pool = cf.ThreadPoolExecutor(
+                max_workers=min(32, len(self._clients)),
+                thread_name_prefix="weight-update",
+            )
+        futs = {
+            self._update_pool.submit(fn, addr, client): addr
+            for addr, client in items
+        }
+        return {futs[f]: f.result() for f in cf.as_completed(futs)}
+
     def _flush_and_update(self, info: Dict):
+        """Push a newly published version to every generation server.
+
+        Staged protocol (``staged_weight_updates``, sharded snapshots):
+          1. ``mode="stage"`` to ALL servers concurrently — each restores
+             the snapshot into a device-resident staging tree while its
+             decode loop keeps emitting tokens; the RPC returns once the
+             tree is resident (the pre-pause barrier).
+          2. pause the fleet (concurrent), ``mode="commit"`` (a pointer
+             flip + next-step ring drain; version-checked server-side so
+             the barrier is version-consistent), resume — the fleet
+             pause is max(commit) across servers instead of
+             sum(load + transfer + apply).
+          3. a server whose stage failed takes the legacy full reload
+             INSIDE the pause window, so the fleet still converges on
+             one version; any remaining failure withholds the version
+             bump exactly like the legacy path.
+
+        Legacy protocol (flag off, or an HF-format cross-job swap):
+        pause, concurrent full ``update_weights``, resume."""
         version = info["version"]
-        for addr, client in self._clients.items():
-            client.call("pause", {})
-        n_interrupted = 0
-        failed = []
         payload = {
             "path": info["path"],
             "version": version,
@@ -470,20 +523,92 @@ class GserverManager(worker_base.Worker):
             # sharded raw-param load path for orbax trees
             "format": info.get("format"),
         }
-        try:
-            for addr, client in self._clients.items():
-                resp = self._update_one_server(addr, client, payload)
-                if isinstance(resp, dict) and "num_interrupted" in resp:
-                    n_interrupted += resp["num_interrupted"]
+        staged = bool(
+            getattr(self.config, "staged_weight_updates", False)
+            and info.get("format") == "params"
+        )
+        items = list(self._clients.items())
+        stage_ok: Dict[str, Dict] = {}
+        if staged:
+            # phase 1 — decode continues fleet-wide while every server
+            # restores its shards concurrently
+            res = self._fan_out(
+                lambda addr, client: self._update_one_server(
+                    addr,
+                    client,
+                    {**payload, "mode": "stage"},
+                    timeout=self.config.stage_request_timeout,
+                ),
+                items,
+            )
+            stage_failed = []
+            for addr, r in res.items():
+                if isinstance(r, dict) and "staged" in r:
+                    stage_ok[addr] = r
                 else:
-                    failed.append((addr, resp))
-        finally:
+                    stage_failed.append((addr, r))
+            if stage_failed:
+                self.logger.warning(
+                    "weight staging v%d failed on %d/%d servers (%s); "
+                    "they take the full reload inside the pause window",
+                    version, len(stage_failed), len(items),
+                    stage_failed[:2],
+                )
+
+        def _pause(addr, client):
+            try:
+                client.call("pause", {})
+                return True
+            except Exception as e:  # noqa: BLE001 - recorded as failure
+                return repr(e)
+
+        def _resume(addr, client):
             # servers must NEVER stay paused — even if an update errored
-            for addr, client in self._clients.items():
-                try:
-                    client.call("resume", {})
-                except Exception:  # noqa: BLE001 - keep resuming the rest
-                    self.logger.exception("resume failed on %s", addr)
+            try:
+                client.call("resume", {})
+                return True
+            except Exception:  # noqa: BLE001 - keep resuming the rest
+                self.logger.exception("resume failed on %s", addr)
+                return False
+
+        def _commit(addr, client):
+            if staged and addr in stage_ok:
+                # server-side barrier wait strictly inside the RPC
+                # timeout: a commit must answer (success or failure)
+                # before the client gives up, or the timeout-retry races
+                # an already-applied flip
+                commit_timeout = max(
+                    5.0, 0.5 * self.config.flush_request_timeout
+                )
+                return self._update_one_server(
+                    addr, client,
+                    {
+                        **payload,
+                        "mode": "commit",
+                        "commit_timeout": commit_timeout,
+                    },
+                )
+            return self._update_one_server(addr, client, payload)
+
+        n_interrupted = 0
+        failed = []
+        t_pause = time.monotonic()
+        pause_res = self._fan_out(_pause, items)
+        try:
+            for addr, r in pause_res.items():
+                if r is not True:
+                    self.logger.warning("pause failed on %s: %s", addr, r)
+            res = self._fan_out(_commit, items)
+            for addr, r in res.items():
+                if isinstance(r, dict) and "num_interrupted" in r:
+                    n_interrupted += r["num_interrupted"]
+                else:
+                    failed.append((addr, r))
+        finally:
+            self._fan_out(_resume, items)
+        pause_seconds = time.monotonic() - t_pause
+        self._m_update_pause.set(pause_seconds)
+        self._m_updates.inc(mode="staged" if staged else "full")
         if failed:
             # leave _model_version unchanged: the poll loop retries on the
             # next (or same) published version instead of deadlocking
@@ -497,10 +622,13 @@ class GserverManager(worker_base.Worker):
             return
         self._model_version = version
         self.logger.info(
-            "weights updated to v%d on %d servers (%d interrupted)",
+            "weights updated to v%d on %d servers (%d interrupted, "
+            "%s, fleet paused %.3fs)",
             version,
             len(self._clients),
             n_interrupted,
+            "staged" if staged else "full",
+            pause_seconds,
         )
 
     # -- poll ---------------------------------------------------------------
@@ -560,6 +688,9 @@ class GserverManager(worker_base.Worker):
         return worker_base.PollResult(sample_count=1)
 
     def _exit_hook(self):
+        pool = getattr(self, "_update_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
         if hasattr(self, "_sock"):
             self._sock.close(linger=0)
 

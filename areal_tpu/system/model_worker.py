@@ -298,9 +298,21 @@ class ModelWorker(worker_base.Worker):
             new = _ema(src_params, dst.params)
         dst.set_params(new)
 
-    def _load_published_params(self, source: str, dst_engine):
+    def _load_published_params(
+        self, source: str, dst_engine, deadline_s: float = 10.0
+    ):
         """Latest published sharded checkpoint of ``source``, restored
-        directly onto the destination engine's shardings."""
+        directly onto the destination engine's shardings.
+
+        The publisher GCs old snapshots (keep-last-2), so a restore can
+        race the deletion of the very version it resolved: the ``v{n}``
+        dir vanishes mid-restore.  Instead of crashing, every attempt
+        RE-RESOLVES the version key and retries — the GC only ever runs
+        after a newer version is advertised, so the re-resolved key
+        names a strictly newer, intact snapshot.  A version that failed
+        once is never retried (its deletion is permanent); if no newer
+        version shows up before ``deadline_s``, the race is reported as
+        such."""
         import pickle as _pickle
 
         from areal_tpu.base import name_resolve, names
@@ -310,10 +322,10 @@ class ModelWorker(worker_base.Worker):
         key = names.model_version(
             constants.experiment_name(), constants.trial_name(), role
         )
-        # the publisher GCs old snapshots (keep-last-2): a restore racing
-        # that deletion re-resolves the key and retries on a newer version
         last_exc = None
-        for _ in range(3):
+        failed_versions = set()
+        deadline = time.monotonic() + deadline_s
+        while True:
             try:
                 payload = _pickle.loads(bytes.fromhex(name_resolve.get(key)))
             except name_resolve.NameEntryNotFoundError:
@@ -322,16 +334,33 @@ class ModelWorker(worker_base.Worker):
                     f"{self.worker_name} and has never published weights; "
                     "add a publish_weights post-hook to its train MFC"
                 ) from None
+            version = payload.get("version")
+            if version in failed_versions:
+                # same doomed version still advertised: wait for the
+                # publisher to advertise the next-newer one
+                if time.monotonic() > deadline:
+                    break
+                time.sleep(0.2)
+                continue
             try:
                 return checkpoint.load_params_like(
                     dst_engine.params, payload["path"]
                 )
-            except (FileNotFoundError, ValueError) as e:
+            except (FileNotFoundError, ValueError, OSError) as e:
                 last_exc = e
+                failed_versions.add(version)
+                getattr(self, "logger", logger).warning(
+                    "published checkpoint v%s of %r vanished mid-restore "
+                    "(keep-last-2 GC race); waiting for a newer version",
+                    version, source,
+                )
+                if time.monotonic() > deadline:
+                    break
                 time.sleep(0.2)
         raise RuntimeError(
             f"param_realloc: published checkpoint for {source!r} kept "
-            "disappearing mid-restore (GC race)"
+            "disappearing mid-restore (GC race) and no newer version was "
+            f"advertised within {deadline_s:.0f}s"
         ) from last_exc
 
     def _publish_weights(self, model_name: str):
@@ -367,6 +396,19 @@ class ModelWorker(worker_base.Worker):
         payload = _pickle.dumps(
             {"version": version, "path": path, "format": "params"}
         ).hex()
+        # layout/dtype manifest, captured EAGERLY (aval metadata only —
+        # the params may be donated by the next train step before the
+        # async commit runs).  Consumers (the gen servers' staged
+        # restore) validate against it before opening tensorstore
+        # arrays, and its presence is a cheap liveness probe for a
+        # snapshot racing keep-last-2 GC.
+        import jax.numpy as jnp
+
+        _manifest_dtype = jnp.dtype(model.model_cfg.dtype)
+        manifest_params = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), _manifest_dtype),
+            model.engine.params,
+        )
 
         def _commit():
             # advertise the version only once the checkpoint is durable,
@@ -374,6 +416,16 @@ class ModelWorker(worker_base.Worker):
             # :287-305)
             try:
                 checkpoint.wait_for_saves()
+                try:
+                    checkpoint.write_manifest(
+                        manifest_params, path, version=version
+                    )
+                except OSError:
+                    # snapshot already GC'd by a newer publish: the
+                    # version check below returns without advertising
+                    self.logger.warning(
+                        "manifest write failed for %s", path
+                    )
                 with self._publish_lock:
                     # concurrent commits may finish out of order (the
                     # shared checkpointer waits for ALL pending saves);

@@ -899,6 +899,314 @@ def bench_interruption(cfg, params, n_reqs=32, prompt_len=256):
     }
 
 
+def _weight_swap_cfg():
+    """Tiny greedy-decode model for the swap A/B: the mechanism under
+    test — restore off the paused critical path vs on it — is
+    model-size-independent, and the tiny tree keeps the CPU-smoke arm
+    honest (both paths restore the SAME snapshot)."""
+    from areal_tpu.models.config import TransformerConfig
+
+    return TransformerConfig(
+        n_layers=2, hidden_dim=64, n_q_heads=4, n_kv_heads=2,
+        head_dim=32, intermediate_dim=128, vocab_size=512,
+        max_position_embeddings=512, dtype="float32",
+    )
+
+
+def _weight_swap_measure_arm(
+    arm, n_reqs=4, prompt_len=32, max_new=48, page=32, chunk=8,
+    repeats=2, n_chips=2,
+):
+    """One ``weight_swap_ab`` arm (dense | paged_prefix | mesh): the
+    FULL-reload swap (pause covers restore + transfer + flip) vs the
+    STAGED swap (restore while decode continues; pause covers only
+    ring-drain + pointer flip) on the same mid-generation workload, plus
+    post-swap token parity against a fresh engine running the new
+    weights.  Mirrors the engine half of the fleet protocol exactly
+    (generation_server's stage thread + commit barrier drive the same
+    engine calls)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from areal_tpu.engine import checkpoint
+    from areal_tpu.engine.sampling import SamplingParams
+    from areal_tpu.models import transformer
+
+    cfg = _weight_swap_cfg()
+    params0 = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    params1 = transformer.init_params(cfg, jax.random.PRNGKey(42))
+    kw = dict(sampling=SamplingParams(greedy=True))
+    if arm == "dense":
+        kw.update(cache_mode="dense")
+    elif arm == "paged_prefix":
+        kw.update(
+            cache_mode="paged", page_size=page,
+            prefill_chunk_tokens=max(page, 64), prefix_cache=True,
+        )
+    elif arm == "mesh":
+        from areal_tpu.base.topology import MeshSpec
+
+        kw.update(
+            cache_mode="paged", page_size=page,
+            prefill_chunk_tokens=max(page, 64),
+            mesh=MeshSpec(model=n_chips).make_mesh(
+                jax.devices()[:n_chips]
+            ),
+        )
+    else:
+        raise ValueError(arm)
+    pub = tempfile.mkdtemp(prefix="areal-swapab-")
+    try:
+        # two snapshots: ``same`` re-publishes the CURRENT weights (the
+        # timed swaps are token-neutral, so the full and staged arms run
+        # on byte-identical decode workloads), ``new`` carries genuinely
+        # new weights for the final flip whose post-swap stream the
+        # fresh-engine replay must reproduce
+        snap_same = os.path.join(pub, "v_same")
+        snap_new = os.path.join(pub, "v_new")
+        checkpoint.save_params(params0, snap_same)
+        checkpoint.write_manifest(params0, snap_same, version=0)
+        checkpoint.save_params(params1, snap_new)
+        checkpoint.write_manifest(params1, snap_new, version=1)
+        # ONE engine per arm, seeded from a RESTORED tree: every tree the
+        # engine ever holds (initial, full-swapped, staged) then shares
+        # one committed-sharding jit variant, and the warm-up swap below
+        # pays the re-prefill shape-bucket compiles — so the timed
+        # windows measure the swap mechanism, not first-use compiles (a
+        # long-lived server is past both after its first swap)
+        eng = make_engine(
+            cfg,
+            checkpoint.load_params_like(params0, snap_same),
+            n_reqs, prompt_len, max_new, chunk=chunk, **kw,
+        )
+        trigger = n_reqs * max_new // 4
+        wave_n = [0]
+
+        def wave(tag=None):
+            wave_n[0] += 1
+            submit_wave(
+                eng, cfg, n_reqs, prompt_len, max_new,
+                tag or f"w{wave_n[0]}{arm}",
+            )
+
+        def run_to_trigger():
+            tok = 0
+            while eng.has_work and tok < trigger:
+                tok += eng.step()
+
+        version = [0]
+
+        def full_swap():
+            version[0] += 1
+            t0 = time.perf_counter()
+            eng.pause()
+            eng.step()  # quiesce the in-flight ring
+            # the legacy path's restore happens INSIDE the pause
+            p = checkpoint.load_params_like(eng.params, snap_same)
+            eng.update_weights(p, version=version[0])
+            eng.resume()
+            while eng.version != version[0]:
+                eng.step()
+            return time.perf_counter() - t0
+
+        def staged_swap(snap):
+            version[0] += 1
+            v, box = version[0], {}
+
+            def _stage():
+                try:
+                    p = checkpoint.load_params_staged(
+                        eng.params, snap, chunk_bytes=1 << 20
+                    )
+                    eng.stage_weights(p, v)
+                except Exception as e:  # noqa: BLE001 - reported
+                    box["error"] = repr(e)
+
+            th = threading.Thread(target=_stage, daemon=True)
+            t_st, tok = time.perf_counter(), 0
+            th.start()
+            while th.is_alive():
+                tok += eng.step()  # decode CONTINUES during staging
+            th.join()
+            if "error" in box:
+                raise RuntimeError(box["error"])
+            stage_s = time.perf_counter() - t_st
+            t0 = time.perf_counter()
+            eng.pause()
+            eng.step()
+            eng.commit_staged(expected_version=v)
+            eng.resume()
+            while eng.version != v:
+                eng.step()
+            return (
+                time.perf_counter() - t0,
+                stage_s,
+                tok / max(stage_s, 1e-9),
+            )
+
+        # warm-up swap: compiles the ring-drain/re-prefill buckets once
+        wave()
+        run_to_trigger()
+        full_swap()
+        drain(eng)
+        fulls, stageds, stage_ss, stage_tps, before_tps = [], [], [], [], []
+        for _ in range(repeats):
+            wave()
+            run_to_trigger()
+            t_b, tok_b = time.perf_counter(), 0
+            while eng.has_work and tok_b < n_reqs * chunk:
+                tok_b += eng.step()
+            before_tps.append(tok_b / max(time.perf_counter() - t_b, 1e-9))
+            fulls.append(full_swap())
+            drain(eng)
+            wave()
+            run_to_trigger()
+            p_s, s_s, s_tps = staged_swap(snap_same)
+            stageds.append(p_s)
+            stage_ss.append(s_s)
+            stage_tps.append(s_tps)
+            drain(eng)
+        # the REAL flip: staged swap to the NEW weights mid-wave, then a
+        # post-swap wave whose greedy stream a fresh engine running the
+        # new weights must reproduce token-for-token
+        wave()
+        run_to_trigger()
+        staged_swap(snap_new)
+        drain(eng)
+        eng.drain_results()
+        submit_wave(eng, cfg, n_reqs, prompt_len, max_new, f"p{arm}")
+        while eng.has_work:
+            eng.step()
+        post = {
+            q: list(o.output_ids) for q, o in eng.drain_results().items()
+        }
+        del eng
+        fresh = make_engine(
+            cfg,
+            checkpoint.load_params_like(params1, snap_new),
+            n_reqs, prompt_len, max_new, chunk=chunk, **kw,
+        )
+        submit_wave(fresh, cfg, n_reqs, prompt_len, max_new, f"p{arm}")
+        while fresh.has_work:
+            fresh.step()
+        ref = {
+            q: list(o.output_ids)
+            for q, o in fresh.drain_results().items()
+        }
+        del fresh
+        full_pause = min(fulls)
+        staged_pause = min(stageds)
+        return {
+            "full_pause_ms": round(full_pause * 1e3, 1),
+            "staged_pause_ms": round(staged_pause * 1e3, 1),
+            "staged_stage_ms": round(min(stage_ss) * 1e3, 1),
+            "pause_ratio": round(staged_pause / max(full_pause, 1e-9), 4),
+            "staged_below_full": bool(staged_pause < full_pause),
+            "decode_tps_before": round(float(np.mean(before_tps)), 1),
+            "decode_tps_during_stage": round(float(np.mean(stage_tps)), 1),
+            "post_swap_parity": bool(post == ref),
+        }
+    finally:
+        shutil.rmtree(pub, ignore_errors=True)
+
+
+def _weight_swap_child(argv_json: str) -> None:
+    """Child-process entry for the mesh arm off-TPU: the parent
+    provisioned the virtual CPU devices; measure and print ONE JSON
+    line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(_weight_swap_measure_arm("mesh", **json.loads(argv_json))))
+
+
+def bench_weight_swap_ab(
+    n_reqs=4, prompt_len=32, max_new=48, page=32, chunk=8, repeats=2,
+    mesh_chips=2,
+):
+    """Zero-downtime weight sync A/B (ISSUE 8's acceptance bench): the
+    staged (stage-while-decoding -> pointer-flip commit) swap against
+    the legacy full reload, per serving arm — pause-ms, decode tok/s
+    around the swap, and post-swap fresh-replay token parity.  Runs
+    off-TPU too (tiny shapes; the mesh arm spawns a virtual-CPU-mesh
+    child when this process lacks devices, like ``sharded_serving``)."""
+    import jax
+
+    shape = dict(
+        n_reqs=n_reqs, prompt_len=prompt_len, max_new=max_new,
+        page=page, chunk=chunk, repeats=repeats,
+    )
+    out = {"backend": jax.default_backend()}
+    for arm in ("dense", "paged_prefix"):
+        try:
+            out[arm] = _weight_swap_measure_arm(arm, **shape)
+        except Exception as e:  # noqa: BLE001 - an arm failure is data
+            out[arm] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if len(jax.devices()) >= mesh_chips:
+        try:
+            out["mesh"] = _weight_swap_measure_arm(
+                "mesh", n_chips=mesh_chips, **shape
+            )
+        except Exception as e:  # noqa: BLE001
+            out["mesh"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    else:
+        import json as _json
+        import subprocess
+        import sys
+
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={mesh_chips}"
+        )
+        env["PYTHONPATH"] = repo_root
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(repo_root, "bench.py"),
+                    "--weight-swap-child",
+                    _json.dumps({**shape, "n_chips": mesh_chips}),
+                ],
+                env=env,
+                cwd=repo_root,
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            lines = [
+                l for l in proc.stdout.strip().splitlines()
+                if l.startswith("{")
+            ]
+            if proc.returncode != 0 or not lines:
+                out["mesh"] = {
+                    "error": (
+                        f"child rc={proc.returncode}: "
+                        + (proc.stderr or proc.stdout)[-500:]
+                    )
+                }
+            else:
+                out["mesh"] = _json.loads(lines[-1])
+        except Exception as e:  # noqa: BLE001
+            out["mesh"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    arms_ok = [
+        v for k, v in out.items()
+        if isinstance(v, dict) and "staged_below_full" in v
+    ]
+    out["staged_below_full_all"] = bool(arms_ok) and all(
+        v["staged_below_full"] for v in arms_ok
+    )
+    out["post_swap_parity_all"] = bool(arms_ok) and all(
+        v.get("post_swap_parity") for v in arms_ok
+    )
+    return out
+
+
 
 def _probe_devices(
     max_attempts: int = 3,
@@ -1207,6 +1515,7 @@ SUMMARY_REQUIRED_KEYS = (
     "trace_overhead_ab",
     "spec_decode_ab",
     "sharded_serving",
+    "weight_swap_ab",
     "paged_decode_ab",
     "dispatch_table",
     "sections",
@@ -1220,6 +1529,7 @@ def build_summary(
     trace_overhead_ab=None,
     spec_decode_ab=None,
     sharded_serving=None,
+    weight_swap_ab=None,
     decode_ab=None,
     pipeline_depth=2,
 ):
@@ -1253,6 +1563,7 @@ def build_summary(
         "trace_overhead_ab": trace_overhead_ab,
         "spec_decode_ab": spec_decode_ab,
         "sharded_serving": sharded_serving,
+        "weight_swap_ab": weight_swap_ab,
         "paged_decode_ab": (
             {
                 k: [
@@ -1913,6 +2224,25 @@ def main():
         ),
     )
 
+    # zero-downtime weight sync A/B: staged (stage-while-decoding ->
+    # pointer-flip commit) vs legacy full-reload swap — pause-ms, decode
+    # dip around the swap, post-swap fresh-replay parity.  Runs off-TPU
+    # too (tiny shapes; mesh arm via a virtual-CPU-mesh child) so the
+    # summary always carries the acceptance numbers.
+    mark("weight swap A/B")
+    weight_swap_ab = _section(
+        bench_weight_swap_ab,
+        name="weight_swap_ab",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                n_reqs=2, prompt_len=24, max_new=32, page=16, chunk=4,
+                repeats=2,
+            )
+        ),
+    )
+
     # sharded-serving scaling: decode tok/s at 1 vs N chips, dense-TP +
     # moe-EP arms (ROADMAP item 1).  Runs off-TPU too (child process
     # with a virtual CPU mesh) so the summary always carries it.
@@ -2096,6 +2426,7 @@ def main():
         trace_overhead_ab=trace_overhead_ab,
         spec_decode_ab=spec_decode_ab,
         sharded_serving=sharded_serving,
+        weight_swap_ab=weight_swap_ab,
         decode_ab=decode_ab,
     )
 
@@ -2164,6 +2495,10 @@ if __name__ == "__main__":
     if "--sharded-serving-child" in _sys.argv:
         _sharded_serving_child(
             _sys.argv[_sys.argv.index("--sharded-serving-child") + 1]
+        )
+    elif "--weight-swap-child" in _sys.argv:
+        _weight_swap_child(
+            _sys.argv[_sys.argv.index("--weight-swap-child") + 1]
         )
     else:
         main()

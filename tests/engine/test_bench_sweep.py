@@ -243,6 +243,14 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
             "dense_tp": {"scaling_x": 1.7, "token_parity": True},
             "moe_ep": {"scaling_x": 1.5, "expert_shard_ok": True},
         },
+        weight_swap_ab={
+            "dense": {
+                "full_pause_ms": 20.0, "staged_pause_ms": 8.0,
+                "staged_below_full": True, "post_swap_parity": True,
+            },
+            "staged_below_full_all": True,
+            "post_swap_parity_all": True,
+        },
         decode_ab={
             "ctx2048_b16": {"dense_toks_per_sec": 1.0,
                             "paged_toks_per_sec": 2.0,
@@ -259,6 +267,10 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
     assert blob["paged_decode_ab"]["ctx2048_b16"] == [1.0, 2.0, 3.0]
     assert blob["dispatch_table"] == {"paged_min_cache_len": 2048}
     assert blob["sharded_serving"]["moe_ep"]["expert_shard_ok"] is True
+    assert blob["weight_swap_ab"]["staged_below_full_all"] is True
+    assert blob["weight_swap_ab"]["dense"]["staged_pause_ms"] < (
+        blob["weight_swap_ab"]["dense"]["full_pause_ms"]
+    )
     assert isinstance(blob["sections"], dict)
     # every recorded section row carries a status field
     for row in blob["sections"].values():
@@ -281,3 +293,19 @@ def test_sharded_serving_section_runs_inline_on_a_cpu_mesh():
         assert row["chips2_decode_toks_per_sec"] > 0
         assert row["token_parity"] is True, row
     assert out["moe_ep"]["expert_shard_ok"] is True
+
+
+@pytest.mark.slow
+def test_weight_swap_ab_paged_arm_staged_beats_full():
+    """The weight_swap_ab measure on the paged+prefix-cache arm: the
+    staged pause must come in strictly below the full-reload pause and
+    the post-swap stream must match the fresh-engine replay (ISSUE 8
+    acceptance, inline CPU-smoke arm)."""
+    row = bench._weight_swap_measure_arm(
+        "paged_prefix", n_reqs=2, prompt_len=24, max_new=32, page=16,
+        chunk=4, repeats=1,
+    )
+    assert row["staged_below_full"] is True, row
+    assert row["post_swap_parity"] is True, row
+    assert row["staged_pause_ms"] < row["full_pause_ms"]
+    assert row["decode_tps_during_stage"] > 0  # decode never stopped

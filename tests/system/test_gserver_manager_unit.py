@@ -334,12 +334,24 @@ def test_finish_clears_prefix_affinity():
 
 class _FakeClient:
     """Records calls; update_weights can raise transiently or reply with
-    an error response."""
+    an error response.  Speaks BOTH update protocols: a full/commit call
+    answers ``{"num_interrupted": ...}``, a ``mode="stage"`` call
+    answers ``{"staged": version}`` (optionally after ``stage_sleep``
+    seconds, to exercise the fan-out's concurrency) unless
+    ``stage_error`` forces a server-side staging failure."""
 
-    def __init__(self, raise_n=0, always_error=False):
+    def __init__(
+        self,
+        raise_n=0,
+        always_error=False,
+        stage_error=False,
+        stage_sleep=0.0,
+    ):
         self.calls = []
         self.raise_n = raise_n
         self.always_error = always_error
+        self.stage_error = stage_error
+        self.stage_sleep = stage_sleep
 
     def n_updates(self):
         return sum(1 for c, _ in self.calls if c == "update_weights")
@@ -348,6 +360,15 @@ class _FakeClient:
         self.calls.append((cmd, payload))
         if cmd != "update_weights":
             return "ok"
+        mode = (payload or {}).get("mode") or "full"
+        if mode == "stage":
+            if self.stage_sleep:
+                import time as _t
+
+                _t.sleep(self.stage_sleep)
+            if self.stage_error:
+                raise RuntimeError("server error: staging failed")
+            return {"staged": payload["version"], "stage_seconds": 0.01}
         if self.always_error:
             # the real GenServerClient raises RuntimeError for an
             # {"error": ...} server response
@@ -359,9 +380,27 @@ class _FakeClient:
     def cmds(self):
         return [c for c, _ in self.calls]
 
+    def update_modes(self):
+        return [
+            (p or {}).get("mode") or "full"
+            for c, p in self.calls
+            if c == "update_weights"
+        ]
+
 
 def _update_info(version=5):
     return {"version": version, "path": "/tmp/ckpt", "format": "params"}
+
+
+def _legacy_manager(**kw):
+    """Manager pinned to the legacy (non-staged) protocol — these arms
+    test the full-reload semantics the staged path falls back to."""
+    return _manager(
+        update_weights_retries=kw.pop("update_weights_retries", 3),
+        update_weights_retry_backoff_s=0.0,
+        staged_weight_updates=False,
+        **kw,
+    )
 
 
 def test_update_failure_resumes_all_and_keeps_version():
@@ -371,9 +410,7 @@ def test_update_failure_resumes_all_and_keeps_version():
     _model_version must stay unchanged so the poll loop retries the
     published version (gserver_manager.py finally-resume path —
     previously untested)."""
-    m = _manager(
-        update_weights_retries=3, update_weights_retry_backoff_s=0.0
-    )
+    m = _legacy_manager()
     good, bad = _FakeClient(), _FakeClient(always_error=True)
     m._clients = {"s0": good, "s1": bad}
     m._flush_and_update(_update_info(version=5))
@@ -386,9 +423,7 @@ def test_update_failure_resumes_all_and_keeps_version():
 def test_update_transient_failure_retried_to_success():
     """One flaky server no longer blocks the fleet's version bump: the
     per-server bounded-backoff retry absorbs a transient failure."""
-    m = _manager(
-        update_weights_retries=3, update_weights_retry_backoff_s=0.0
-    )
+    m = _legacy_manager()
     flaky = _FakeClient(raise_n=1)
     m._clients = {"s0": _FakeClient(), "s1": flaky}
     m._flush_and_update(_update_info(version=7))
@@ -399,12 +434,177 @@ def test_update_transient_failure_retried_to_success():
 
 
 def test_update_exception_exhausting_retries_keeps_version():
-    m = _manager(
-        update_weights_retries=2, update_weights_retry_backoff_s=0.0
-    )
+    m = _legacy_manager(update_weights_retries=2)
     dead = _FakeClient(raise_n=10)  # raises forever
     m._clients = {"s0": dead}
     m._flush_and_update(_update_info(version=9))
     assert m._model_version == 0
     assert dead.n_updates() == 2
     assert dead.cmds()[-1] == "resume"
+
+
+# -- parallel fan-out (legacy path) -------------------------------------------
+
+
+def test_legacy_updates_fan_out_concurrently():
+    """The legacy full reloads run on a thread pool: with every server's
+    update taking ~0.25s, a 4-server fleet must finish in well under the
+    1s a sequential loop would take."""
+    import time as _t
+
+    class _SlowFull(_FakeClient):
+        def call(self, cmd, payload, timeout=None):
+            if cmd == "update_weights":
+                _t.sleep(0.25)
+            return super().call(cmd, payload, timeout)
+
+    m = _legacy_manager()
+    m._clients = {f"s{i}": _SlowFull() for i in range(4)}
+    t0 = _t.monotonic()
+    m._flush_and_update(_update_info(version=3))
+    elapsed = _t.monotonic() - t0
+    assert m._model_version == 3
+    assert elapsed < 0.8, f"sequential-looking fan-out: {elapsed:.2f}s"
+
+
+def test_legacy_one_slow_server_bounds_fleet_at_max_not_sum():
+    import time as _t
+
+    class _Slow(_FakeClient):
+        def call(self, cmd, payload, timeout=None):
+            if cmd == "update_weights":
+                _t.sleep(0.4)
+            return super().call(cmd, payload, timeout)
+
+    m = _legacy_manager()
+    m._clients = {"s0": _Slow(), "s1": _FakeClient(), "s2": _FakeClient()}
+    t0 = _t.monotonic()
+    m._flush_and_update(_update_info(version=4))
+    elapsed = _t.monotonic() - t0
+    assert m._model_version == 4
+    # max(0.4) + overhead, not 0.4 + 2 * epsilon_sequential_pauses
+    assert elapsed < 0.7, elapsed
+
+
+def test_legacy_one_failing_server_fails_round_others_resumed():
+    m = _legacy_manager()
+    bad = _FakeClient(always_error=True)
+    ok = [_FakeClient(), _FakeClient()]
+    m._clients = {"s0": ok[0], "s1": bad, "s2": ok[1]}
+    m._flush_and_update(_update_info(version=6))
+    assert m._model_version == 0
+    for c in (bad, *ok):
+        assert c.cmds()[-1] == "resume"
+
+
+# -- staged (stage -> commit) protocol ----------------------------------------
+
+
+def _staged_manager(**kw):
+    return _manager(
+        update_weights_retries=kw.pop("update_weights_retries", 3),
+        update_weights_retry_backoff_s=0.0,
+        staged_weight_updates=True,
+        **kw,
+    )
+
+
+def test_staged_update_stage_then_pause_commit_resume():
+    """Happy path: every server sees stage (unpaused) -> pause -> commit
+    -> resume, in that order, and the version bumps once."""
+    m = _staged_manager()
+    clients = {f"s{i}": _FakeClient() for i in range(3)}
+    m._clients = dict(clients)
+    m._flush_and_update(_update_info(version=5))
+    assert m._model_version == 5
+    for c in clients.values():
+        assert c.update_modes() == ["stage", "commit"]
+        cmds = c.cmds()
+        # stage strictly before pause: staging runs while decode continues
+        assert cmds.index("pause") > 0
+        assert cmds[0] == "update_weights"  # the stage call
+        assert cmds[-1] == "resume"
+        # commit lands between pause and resume
+        assert (
+            cmds.index("pause")
+            < len(cmds) - 1 - cmds[::-1].index("update_weights")
+            < cmds.index("resume")
+        )
+
+
+def test_staged_stage_runs_concurrently_across_fleet():
+    """Staging the fleet costs max(stage), not sum: 3 servers each
+    sleeping 0.3s in stage must finish staging in well under 0.9s."""
+    import time as _t
+
+    m = _staged_manager()
+    m._clients = {f"s{i}": _FakeClient(stage_sleep=0.3) for i in range(3)}
+    t0 = _t.monotonic()
+    m._flush_and_update(_update_info(version=2))
+    elapsed = _t.monotonic() - t0
+    assert m._model_version == 2
+    assert elapsed < 0.75, f"stage fan-out not concurrent: {elapsed:.2f}s"
+
+
+def test_staged_one_slow_stager_does_not_block_peers_commit():
+    import time as _t
+
+    m = _staged_manager()
+    slow = _FakeClient(stage_sleep=0.4)
+    fast = _FakeClient()
+    m._clients = {"s0": slow, "s1": fast}
+    m._flush_and_update(_update_info(version=8))
+    assert m._model_version == 8
+    # both committed (the barrier waits for the slow stager, by design —
+    # version consistency beats partial commits)
+    assert slow.update_modes() == ["stage", "commit"]
+    assert fast.update_modes() == ["stage", "commit"]
+
+
+def test_staged_stage_failure_falls_back_to_full_reload_in_pause():
+    """A server whose stage fails still converges: it takes the legacy
+    full reload INSIDE the pause window; the fleet's version bumps."""
+    m = _staged_manager()
+    bad_stage = _FakeClient(stage_error=True)
+    good = _FakeClient()
+    m._clients = {"s0": good, "s1": bad_stage}
+    m._flush_and_update(_update_info(version=4))
+    assert m._model_version == 4
+    assert good.update_modes() == ["stage", "commit"]
+    # failed stage -> full (no mode) reload while paused
+    assert bad_stage.update_modes() == ["stage", "full"]
+    for c in (good, bad_stage):
+        assert c.cmds()[-1] == "resume"
+
+
+def test_staged_commit_failure_keeps_version_and_resumes():
+    class _CommitFails(_FakeClient):
+        def call(self, cmd, payload, timeout=None):
+            if (
+                cmd == "update_weights"
+                and ((payload or {}).get("mode") == "commit")
+            ):
+                self.calls.append((cmd, payload))
+                raise RuntimeError("server error: staged v3 != commit v4")
+            return super().call(cmd, payload, timeout)
+
+    m = _staged_manager()
+    bad = _CommitFails()
+    m._clients = {"s0": _FakeClient(), "s1": bad}
+    m._flush_and_update(_update_info(version=4))
+    assert m._model_version == 0  # barrier failed: no bump
+    for c in m._clients.values():
+        assert c.cmds()[-1] == "resume"
+
+
+def test_staged_disabled_for_hf_format_checkpoints():
+    """Cross-job HF checkpoint swaps have no sharded snapshot to stage:
+    the manager must take the legacy path even with staging enabled."""
+    m = _staged_manager()
+    c = _FakeClient()
+    m._clients = {"s0": c}
+    m._flush_and_update(
+        {"version": 2, "path": "/tmp/hf", "format": None}
+    )
+    assert m._model_version == 2
+    assert c.update_modes() == ["full"]

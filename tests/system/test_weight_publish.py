@@ -100,6 +100,19 @@ def test_publish_to_generation_server_hot_swap(trial):
             rtol=1e-2,
             atol=1e-2,
         )
+        # the manager's default protocol is STAGED for sharded snapshots:
+        # the server must have restored off the critical path and applied
+        # the swap as a pointer flip, not a full paused reload
+        deadline = time.monotonic() + 10
+        while (
+            time.monotonic() < deadline
+            and server.engine.swaps_staged_total < 1
+        ):
+            time.sleep(0.1)
+        stats = server.engine.swap_stats()
+        assert stats["swaps_staged_total"] == 1, stats
+        assert stats["swaps_total"] == 1, stats
+        assert stats["stage_s"] > 0.0
     finally:
         manager.exit()
         server.exit()
@@ -159,3 +172,144 @@ def test_cross_worker_param_realloc(trial, tmp_path):
 
     with pytest.raises(RuntimeError, match="publish_weights"):
         mw._param_realloc("critic", "ref", eta=1.0)
+
+
+def _fake_model_worker():
+    from areal_tpu.system.model_worker import ModelWorker
+
+    mw = ModelWorker.__new__(ModelWorker)
+    mw.worker_name = "model_worker_0"
+    return mw
+
+
+class _TemplateEngine:
+    def __init__(self, params):
+        self.params = params
+
+
+def test_publish_gc_race_retries_on_next_newer_version(trial, tmp_path):
+    """keep-last-2 GC can delete the very snapshot a reader resolved:
+    the restore must re-resolve the version key and retry on the NEXT
+    advertised version instead of crashing (ISSUE 8 satellite)."""
+    from areal_tpu.base import name_resolve, names
+
+    expr, tname = trial
+    key = names.model_version(expr, tname, "actor")
+    params = {"w": jnp.full((4, 4), 7.0)}
+    # v1 is advertised but its dir is already GONE (GC won the race)
+    name_resolve.add(
+        key,
+        pickle.dumps(
+            {"version": 1, "path": str(tmp_path / "gone" / "v1"),
+             "format": "params"}
+        ).hex(),
+        replace=True,
+    )
+    good = str(tmp_path / "pub" / "v2")
+    from areal_tpu.engine import checkpoint
+
+    checkpoint.save_params(params, good)
+
+    def _advertise_v2():
+        time.sleep(0.6)
+        name_resolve.add(
+            key,
+            pickle.dumps(
+                {"version": 2, "path": good, "format": "params"}
+            ).hex(),
+            replace=True,
+        )
+
+    t = threading.Thread(target=_advertise_v2, daemon=True)
+    t.start()
+    mw = _fake_model_worker()
+    got = mw._load_published_params(
+        "actor", _TemplateEngine(params), deadline_s=10.0
+    )
+    t.join()
+    np.testing.assert_allclose(np.asarray(got["w"]), 7.0)
+
+
+def test_publish_gc_race_gives_up_when_no_newer_version(trial, tmp_path):
+    """A doomed version that stays advertised past the deadline reports
+    the GC race instead of spinning forever (and never hammers the same
+    failed version with repeated restores)."""
+    import pytest
+
+    from areal_tpu.base import name_resolve, names
+
+    expr, tname = trial
+    key = names.model_version(expr, tname, "actor")
+    name_resolve.add(
+        key,
+        pickle.dumps(
+            {"version": 5, "path": str(tmp_path / "gone" / "v5"),
+             "format": "params"}
+        ).hex(),
+        replace=True,
+    )
+    mw = _fake_model_worker()
+    params = {"w": jnp.zeros((2,))}
+    with pytest.raises(RuntimeError, match="GC race"):
+        mw._load_published_params(
+            "actor", _TemplateEngine(params), deadline_s=1.0
+        )
+
+
+def test_publish_weights_writes_manifest(trial, tmp_path):
+    """_publish_weights drops a layout/dtype manifest inside the
+    committed snapshot: per-leaf shapes + the published (inference)
+    dtype, version-stamped — the staged restore's pre-validation
+    input."""
+    import os
+    import threading as _threading
+
+    from areal_tpu.base import constants as _c
+    from areal_tpu.base import name_resolve, names
+    from areal_tpu.engine import checkpoint
+
+    expr, tname = trial
+
+    class _Version:
+        global_step = 4
+
+    class _Name:
+        role = "actor"
+
+    class _Cfg:
+        dtype = "bfloat16"
+
+    class _Model:
+        version = _Version()
+        name = _Name()
+        model_cfg = _Cfg()
+        engine = _TemplateEngine(
+            {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        )
+
+    mw = _fake_model_worker()
+    mw._models = {"actor": _Model()}
+    mw._publish_lock = _threading.Lock()
+    mw._publish_threads = []
+    mw._last_published_version = {}
+    from areal_tpu.base import logging_
+
+    mw.logger = logging_.getLogger("test-mw")
+    mw._publish_weights("actor")
+    for t in mw._publish_threads:
+        t.join(timeout=30)
+    path = os.path.join(_c.get_param_realloc_path(), "actor", "v4")
+    manifest = checkpoint.read_manifest(path)
+    assert manifest is not None
+    assert manifest["version"] == 4
+    assert manifest["leaves"]["w"] == {
+        "shape": [4, 4], "dtype": "bfloat16"
+    }
+    # and the advertised payload points at the manifest'd snapshot
+    raw = name_resolve.get(names.model_version(expr, tname, "actor"))
+    info = pickle.loads(bytes.fromhex(raw))
+    assert info["version"] == 4 and info["path"] == path
+    # the manifest validates the engine's own template cleanly
+    assert checkpoint.validate_manifest(
+        _Model.engine.params, manifest
+    ) == []
