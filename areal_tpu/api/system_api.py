@@ -200,6 +200,12 @@ class GenServerConfig:
     spec_decode: SpecDecodeConfig = dataclasses.field(
         default_factory=SpecDecodeConfig
     )
+    # request-level SLO plane (observability/latency.py): per-request
+    # latency decomposition (schedule/admission wait, TTFT, TPOT,
+    # swap/preempt stall) streamed into mergeable percentile digests and
+    # exported as the areal_slo_* families.  Off = the bench A/B's
+    # baseline arm; overhead is a few clock stamps per request.
+    slo_tracking: bool = True
     # decode-pipeline depth: max chunks dispatched-but-unharvested (the
     # engine's in-flight ring).  2 overlaps each chunk's output fetch
     # with the next chunk's device time; raise it when the fetch RTT

@@ -78,6 +78,7 @@ from areal_tpu.engine.sampling import SamplingParams, sample_logits_keyed
 from areal_tpu.models import paged
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import KVCache, decode_step, prefill
+from areal_tpu.observability.latency import LatencyDigest, LatencyRecord
 from areal_tpu.observability.tracing import get_tracer
 
 #: back-compat alias: the auto dense/paged crossover now lives in the
@@ -160,6 +161,17 @@ class _Row:
     # (lazily created; survives park/resume/preempt — history never
     # rewrites).  None until the row first drafts.
     spec: Optional[spec_decode.SpecRowState] = None
+    # SLO latency decomposition (monotonic-clock stamps; telemetry only —
+    # never read by dispatch decisions, so SPMD lockstep is untouched):
+    # submit -> admit = admission wait, submit -> first token = TTFT,
+    # (last - first) / (tokens - 1) = TPOT; stall_s accumulates weight-
+    # swap pause + preempted-out-of-service time while in flight
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0
+    t_last: float = 0.0
+    slo_stall_s: float = 0.0
+    t_preempt: float = 0.0
 
 
 @dataclasses.dataclass
@@ -389,6 +401,8 @@ class ContinuousBatchingEngine:
         prefix_cache_capacity_frac: float = 0.5,
         prefix_cache_min_tokens: int = 1,
         spec_decode_params: Optional[spec_decode.SpecDecodeParams] = None,
+        slo_tracking: bool = True,
+        server_name: str = "",
     ):
         """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
         shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
@@ -655,6 +669,23 @@ class ContinuousBatchingEngine:
         # the in-flight chunk ring: dispatched-but-unharvested decode
         # chunks, FIFO, at most ``pipeline_depth`` deep
         self._ring: Deque[_InflightChunk] = deque()
+        # request-level SLO plane (observability/latency.py): per-request
+        # LatencyRecords + streaming percentile digests over the fixed
+        # log buckets.  Host-side telemetry only — a few monotonic-clock
+        # stamps per request lifecycle event, nothing on the per-token
+        # path and nothing dispatch decisions read (SPMD-safe).
+        # ``slo_tracking=False`` is the bench A/B's off arm.
+        self._slo_enabled = bool(slo_tracking)
+        self.server_name = server_name
+        self.slo_records_total = 0
+        self._submit_ts: Dict[str, float] = {}
+        self._slo_records: Deque[LatencyRecord] = deque(maxlen=4096)
+        self._slo_digests: Dict[str, LatencyDigest] = {
+            "admission_wait_s": LatencyDigest(),
+            "ttft_s": LatencyDigest(),
+            "tpot_s": LatencyDigest(),
+            "stall_s": LatencyDigest(),
+        }
 
     # -- paged-cache state --------------------------------------------------
 
@@ -875,7 +906,92 @@ class ContinuousBatchingEngine:
             self._pending.append(req)
             ev = threading.Event()
             self._result_events[req.qid] = ev
+            if self._slo_enabled:
+                self._submit_ts[req.qid] = time.monotonic()
         return req.qid
+
+    # -- request-level SLO plane ---------------------------------------------
+
+    def _slo_admitted(self, row: _Row, now: Optional[float] = None):
+        """Stamp a row's submit/admit times (admission-wait starts the
+        TTFT decomposition).  Called once wherever a request binds to a
+        cache row: dense admit, paged fill admission, park-resume."""
+        if not self._slo_enabled:
+            return
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            t0 = self._submit_ts.pop(row.req.qid, now)
+        row.t_submit = t0
+        row.t_admit = now
+        row.t_first = row.t_last = 0.0
+        row.slo_stall_s = 0.0
+        row.t_preempt = 0.0
+
+    def _slo_first_token(self, row: _Row, now: Optional[float] = None):
+        if not self._slo_enabled or row.t_first:
+            return
+        row.t_first = row.t_last = (
+            time.monotonic() if now is None else now
+        )
+
+    def _slo_finish(self, row: _Row):
+        """Fold a finished (or parked — each chunk is a completed request
+        from the client's view) row into the records deque + digests."""
+        if not self._slo_enabled:
+            return
+        with self._lock:
+            self._submit_ts.pop(row.req.qid, None)
+        tokens = len(row.generated)
+        if row.t_admit == 0.0 or row.t_first == 0.0 or tokens == 0:
+            return  # never admitted / produced nothing: no decomposition
+        md = row.req.metadata or {}
+        ttft = max(0.0, row.t_first - row.t_submit)
+        tpot = (
+            max(0.0, row.t_last - row.t_first) / (tokens - 1)
+            if tokens >= 2
+            else None
+        )
+        sched = md.get("slo_schedule_wait_s")
+        rec = LatencyRecord(
+            qid=row.req.qid,
+            workload=str(md.get("workload", "rollout")),
+            server=self.server_name,
+            mesh_devices=self.mesh_devices,
+            schedule_wait_s=(
+                float(sched) if isinstance(sched, (int, float)) else None
+            ),
+            admission_wait_s=max(0.0, row.t_admit - row.t_submit),
+            ttft_s=ttft,
+            tpot_s=tpot,
+            stall_s=row.slo_stall_s,
+            tokens=tokens,
+        )
+        self._slo_records.append(rec)
+        self.slo_records_total += 1
+        d = self._slo_digests
+        d["admission_wait_s"].observe(rec.admission_wait_s)
+        d["ttft_s"].observe(ttft)
+        d["stall_s"].observe(rec.stall_s)
+        if tpot is not None:
+            d["tpot_s"].observe(tpot)
+
+    def drain_slo_records(self) -> List[LatencyRecord]:
+        """Pop the recent per-request latency records (the worker feeds
+        them into the ``areal_slo_*`` registry histograms)."""
+        out = list(self._slo_records)
+        self._slo_records.clear()
+        return out
+
+    def slo_stats(self) -> Dict[str, Any]:
+        """Percentile summary of the engine-local digests (metrics RPC +
+        bench); ``digests`` carries the mergeable raw state."""
+        return {
+            "records_total": self.slo_records_total,
+            **{k: d.percentiles() for k, d in self._slo_digests.items()},
+        }
+
+    def slo_digests(self) -> Dict[str, Dict[str, Any]]:
+        return {k: d.to_dict() for k, d in self._slo_digests.items()}
 
     def wait_result(
         self, qid: str, timeout: float = 600.0
@@ -1064,6 +1180,18 @@ class ContinuousBatchingEngine:
         with self._lock:
             if self._new_params is None:
                 return
+            peek_version = self._new_params[1]
+        # the apply window as a flight-recorder span: staged syncs show
+        # up in Perfetto NEXT TO the decode chunks they interrupt (the
+        # counters alone can't show the overlap).  Swap roots are
+        # synthetic ("swap-v{n}") and force-sampled — a weight swap is
+        # fleet-wide, never a per-rollout event the hash slice covers.
+        swap_root = f"swap-v{peek_version}" if peek_version is not None \
+            else f"swap-v{self.version + 1}"
+        self.tracer.force(swap_root)
+        self.tracer.span_begin(
+            swap_root, "swap.commit", root=swap_root, version=peek_version,
+        )
         tik = time.perf_counter()
         # the host row state must be exact before re-prefilling in-flight
         # rows: quiesce the WHOLE pipeline ring first (every dispatched
@@ -1074,6 +1202,9 @@ class ContinuousBatchingEngine:
             pending = self._new_params
             self._new_params = None
         if pending is None:
+            self.tracer.span_end(
+                swap_root, "swap.commit", root=swap_root, aborted=True,
+            )
             return
         new_params, target_version, pre_sharded = pending
         if not pre_sharded:
@@ -1173,6 +1304,21 @@ class ContinuousBatchingEngine:
         self.swaps_total += 1
         if pre_sharded:
             self.swaps_staged_total += 1
+        if self._slo_enabled:
+            # the pause quiesced every in-flight request: attribute the
+            # whole window to each one's stall time (they all waited it
+            # out — drain, flip/reload, recompute).  Rows mid
+            # preemption-readmit (t_preempt still set) are skipped: their
+            # out-of-service window, added at re-activation, already
+            # spans this pause — adding dt here would double-count it.
+            for row in self.rows:
+                if row is not None and not row.parked and not row.t_preempt:
+                    row.slo_stall_s += dt
+        self.tracer.span_end(
+            swap_root, "swap.commit", root=swap_root,
+            version=self.version, pre_sharded=pre_sharded,
+            interrupted=self.n_inflight,
+        )
         logger.info(
             "weights updated to v%d (%d in-flight recomputed, %s, %.3fs "
             "interrupted)",
@@ -1278,6 +1424,7 @@ class ContinuousBatchingEngine:
             row.no_eos = False
             row.parked = False
             row.budget_left = max_new
+            self._slo_admitted(row)
             self._epoch_counter += 1
             row.epoch = self._epoch_counter
             rid = np.array([row_id], np.int32)
@@ -1457,6 +1604,12 @@ class ContinuousBatchingEngine:
                     self._set_row_blocks(tgt.row_id, own)
                 if tgt.resume is not None:
                     row = tgt.resume
+                    if self._slo_enabled and row.t_preempt:
+                        # back in service: the preempted window was stall
+                        row.slo_stall_s += (
+                            time.monotonic() - row.t_preempt
+                        )
+                        row.t_preempt = 0.0
                     self._epoch_counter += 1
                     row.epoch = self._epoch_counter
                     row.filling = False
@@ -1497,6 +1650,7 @@ class ContinuousBatchingEngine:
             )
             toks = np.asarray(toks)[:n]
             logps = np.asarray(logps)[:n]
+            t_first = time.monotonic()  # fill's first tokens on host
             for (f, tgt, _), tok_i, logp in zip(
                 sample_targets, toks.tolist(), logps.tolist()
             ):
@@ -1505,6 +1659,7 @@ class ContinuousBatchingEngine:
                 row.generated = [int(tok_i)]
                 row.logprobs = [float(logp)]
                 row.filling = False
+                self._slo_first_token(row, now=t_first)
                 plen = len(f.tokens)
                 if tok_i in self.stop_tokens or tgt.max_new <= 1:
                     row.no_eos = tok_i not in self.stop_tokens
@@ -1654,10 +1809,12 @@ class ContinuousBatchingEngine:
             fill.targets.append(
                 _FillTarget(row_id=rid, req=req, max_new=max_new)
             )
-            self.rows[rid] = _Row(
+            row = _Row(
                 req=req, prompt=prompt, generated=[], logprobs=[],
                 version_start=self.version, filling=True,
             )
+            self._slo_admitted(row)
+            self.rows[rid] = row
 
     def _ensure_decode_blocks(self):
         """Every ACTIVE row's table must cover ``length + chunk`` slots
@@ -1759,6 +1916,8 @@ class ContinuousBatchingEngine:
             return  # the drain finished or parked the victim: done
         self.active = self.active.at[row_id].set(False)
         self._release_row(row_id)
+        if self._slo_enabled:
+            row.t_preempt = time.monotonic()  # stall until re-activation
         self._preempted.append(row)
         self.preempted_total += 1
         self.tracer.event(
@@ -2064,10 +2223,12 @@ class ContinuousBatchingEngine:
                 req.qid, "engine.admit", row=rid,
                 prompt_len=len(prompt), cached_tokens=0, shared=False,
             )
+        t_admit = time.monotonic()  # admission decided; prefill follows
         toks, logps = self._prefill_rows(
             [(rid, prompt) for rid, _, prompt, _ in to_admit],
             seeds=[_qid_seed(req.qid) for _, req, _, _ in to_admit],
         )
+        t_first = time.monotonic()  # first tokens materialized on host
         started_ids, started_curs, started_budgets = [], [], []
         started_seeds = []
         for (row_id, req, prompt, max_new), tok_i, logp in zip(
@@ -2080,6 +2241,8 @@ class ContinuousBatchingEngine:
                 logprobs=[float(logp)],
                 version_start=self.version,
             )
+            self._slo_admitted(row, now=t_admit)
+            self._slo_first_token(row, now=t_first)
             if tok_i in self.stop_tokens or max_new <= 1:
                 row.no_eos = tok_i not in self.stop_tokens
                 self._finish(row_id, row, started=False)
@@ -2109,6 +2272,7 @@ class ContinuousBatchingEngine:
     def _finish(
         self, row_id: int, row: _Row, started: bool = True, park: bool = False
     ):
+        self._slo_finish(row)
         out = model_api.APIGenerateOutput.from_input(row.req)
         out.output_ids = list(row.generated)
         out.output_logprobs = list(row.logprobs)
@@ -2270,6 +2434,7 @@ class ContinuousBatchingEngine:
         self.time_fetch_s += t_fetched - t_ready
         self.chunks_total += 1
         n_tokens = 0
+        t_harvest = time.monotonic()  # chunk's tokens reach the host NOW
         spec_meta = chunk.spec_meta
         for row_id, epoch in snapshot:
             row = self.rows[row_id]
@@ -2287,6 +2452,9 @@ class ContinuousBatchingEngine:
             row.logprobs.extend(lps)
             row.budget_left -= len(toks)
             n_tokens += len(toks)
+            if toks and self._slo_enabled:
+                self._slo_first_token(row, now=t_harvest)
+                row.t_last = t_harvest
             if spec_meta is not None and row_id in spec_meta:
                 qid, drafted = spec_meta[row_id]
                 # every emitted token but the last is a confirmed draft;

@@ -21,6 +21,11 @@ that plane as a real subsystem:
   recorder: per-sample span/event rings on every worker, harvested by a
   master-owned collector into ``traces.jsonl`` + a Perfetto export, with
   a stall watchdog (see ``docs/observability.md`` § Tracing).
+* :mod:`latency` — the request-level SLO plane: per-request
+  ``LatencyRecord`` decomposition (schedule/admission wait, TTFT, TPOT,
+  swap/preempt stall) and mergeable fixed-bucket percentile digests,
+  exported as the ``areal_slo_*`` families and fleet-merged by the
+  aggregator (see ``docs/observability.md`` § Request-level SLOs).
 """
 
 from areal_tpu.observability.registry import (  # noqa: F401
@@ -36,6 +41,13 @@ from areal_tpu.observability.table import (  # noqa: F401
     TRACE_TABLE,
     MetricSpec,
     TraceSpec,
+)
+from areal_tpu.observability.latency import (  # noqa: F401
+    SLO_BUCKETS,
+    SLO_FAMILIES,
+    SLO_REL_ERROR_BOUND,
+    LatencyDigest,
+    LatencyRecord,
 )
 from areal_tpu.observability.tracing import (  # noqa: F401
     TraceConfig,

@@ -57,6 +57,10 @@ class ClusterMetricsAggregator:
         # would put dead workers on the training critical path
         self.failure_backoff_s = 30.0
         self._skip_until: Dict[str, float] = {}
+        # previous cumulative SLO digest per (worker, family, workload):
+        # merge_slo windows each scrape against this, so fleet
+        # percentiles mean "since the last scrape", not "since boot"
+        self._slo_prev: Dict[tuple, object] = {}
 
     # -- discovery ----------------------------------------------------------
 
@@ -130,10 +134,46 @@ class ClusterMetricsAggregator:
                     flat[f"cluster/{worker}/{_series_key(s)}"] = s.value
         return flat
 
+    def merge_slo(
+        self, scraped: Dict[str, Dict[str, prom_text.Family]]
+    ) -> Dict[str, float]:
+        """Fleet SLO percentiles for THIS scrape window: rebuild every
+        worker's ``areal_slo_*`` digest from its scraped histogram
+        buckets, diff it against the previous scrape's cumulative
+        snapshot (``latency.digest_delta`` — exact, with worker-restart
+        counter resets handled), and merge the per-window deltas into
+        fleet rows.  Windowing is what makes the watchdog's "p99 TTFT
+        right now" mean *now*: a lifetime-cumulative p99 would take
+        ~99x the history in fast samples to recover after one storm,
+        and would dilute a late regression the same way.  Returns the
+        ``slo/<family>/<workload>/pXX`` rows (plus per-server p99) that
+        join the per-step sink row; failures degrade to an empty dict,
+        never a master stall."""
+        from areal_tpu.observability import latency
+
+        try:
+            window: Dict[str, dict] = {}
+            for worker, fams in scraped.items():
+                for key, dig in latency.digests_from_families(
+                    fams
+                ).items():
+                    prev = self._slo_prev.get((worker,) + key)
+                    window.setdefault(worker, {})[key] = (
+                        latency.digest_delta(dig, prev)
+                    )
+                    self._slo_prev[(worker,) + key] = dig
+            return latency.fleet_rows_from_digests(window)
+        except Exception:  # noqa: BLE001 - telemetry must not fail a step
+            logger.exception("fleet SLO digest merge failed")
+            return {}
+
     def step(self, step: int) -> Dict[str, float]:
-        """Scrape the cluster, append one jsonl snapshot, return the flat
-        dict for the metrics sinks."""
-        flat = self.flatten(self.scrape())
+        """Scrape the cluster, append one jsonl snapshot (cluster series
+        + fleet-merged SLO percentiles), return the flat dict for the
+        metrics sinks."""
+        scraped = self.scrape()
+        flat = self.flatten(scraped)
+        flat.update(self.merge_slo(scraped))
         if self._jsonl is not None:
             self._jsonl.write(
                 json.dumps({"step": step, "time": time.time(), **flat})

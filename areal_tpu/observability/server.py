@@ -12,7 +12,11 @@ Routes:
   ``/trace``    JSON flight-recorder harvest (``?since=<seq>`` cursor);
                 the worker half of the distributed trace plane — same
                 discovery key, same server, zero extra threads
-  ``/healthz``  200 "ok" (cheap liveness probe for ops tooling)
+  ``/healthz``  200 JSON liveness/lease probe: worker id, uptime, and
+                the last-activity timestamp (refreshed by the worker's
+                poll loop whenever a poll produced work) — the signal a
+                lease/liveness layer or the aggregator's dead-endpoint
+                triage reads without parsing a whole metrics page
 """
 
 from __future__ import annotations
@@ -21,6 +25,7 @@ import json
 import os
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -58,8 +63,16 @@ class MetricsServer:
     ):
         self.registry = registry or get_registry()
         self.tracer = tracer or get_tracer()
+        # /healthz state: identity + uptime + last activity.  Activity is
+        # stamped by the worker's poll loop (note_activity) whenever a
+        # poll produced work, so "alive but wedged" (HTTP up, poll loop
+        # stuck) is distinguishable from "alive and working".
+        self.worker_name = ""
+        self._started_monotonic = time.monotonic()
+        self.last_activity_ts = time.time()
         reg = self.registry
         trc = self.tracer
+        srv = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - http.server API
@@ -88,10 +101,12 @@ class MetricsServer:
                     self.end_headers()
                     self.wfile.write(body)
                 elif path == "/healthz":
+                    body = json.dumps(srv.health()).encode("utf-8")
                     self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
-                    self.wfile.write(b"ok")
+                    self.wfile.write(body)
                 else:
                     self.send_error(404)
 
@@ -102,6 +117,27 @@ class MetricsServer:
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
         self._registered_key: Optional[str] = None
+
+    def health(self) -> dict:
+        """The ``/healthz`` body: worker identity, uptime, and how stale
+        the poll loop's last productive activity is."""
+        now = time.time()
+        return {
+            "status": "ok",
+            "worker": self.worker_name,
+            "uptime_s": round(
+                time.monotonic() - self._started_monotonic, 3
+            ),
+            "last_activity_ts": self.last_activity_ts,
+            "last_activity_age_s": round(
+                max(0.0, now - self.last_activity_ts), 3
+            ),
+        }
+
+    def note_activity(self):
+        """Stamp the last-activity clock (called from the worker's poll
+        loop on productive polls; cheap enough for every poll)."""
+        self.last_activity_ts = time.time()
 
     @property
     def port(self) -> int:
@@ -172,6 +208,7 @@ def start_worker_metrics_server(
     try:
         port = int(os.environ.get(PORT_ENV, "0") or "0")
         srv = MetricsServer(registry=registry, port=port).start()
+        srv.worker_name = worker_name
         srv.register(experiment_name, trial_name, worker_name)
         logger.info(
             "worker %s serving /metrics at %s", worker_name, srv.address

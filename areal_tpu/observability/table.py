@@ -204,6 +204,48 @@ METRIC_TABLE = [
         "Weight swaps applied as staged pointer-flips (pre-restored, "
         "zero transfer inside the pause)",
     ),
+    # -- request-level SLO plane (observability/latency.py consumers) --------
+    # Each family is a histogram over the FIXED log-bucket boundaries
+    # latency.SLO_BUCKETS, so the master can rebuild + exactly merge
+    # per-worker digests into fleet percentiles (the lint asserts this
+    # vocabulary matches latency.SLO_FAMILIES both ways).
+    MetricSpec(
+        "areal_slo_schedule_wait_seconds",
+        "histogram",
+        "Time a rollout waited at the gserver manager's admission gate "
+        "(first rejected allocate to the eventual ok; 0 when admitted "
+        "immediately) — SLO digest, fixed log buckets",
+        ("workload",),
+    ),
+    MetricSpec(
+        "areal_slo_admission_wait_seconds",
+        "histogram",
+        "Time a request queued at the engine between submit and cache-"
+        "row admission — SLO digest, fixed log buckets",
+        ("workload",),
+    ),
+    MetricSpec(
+        "areal_slo_ttft_seconds",
+        "histogram",
+        "Time to first token: engine submit to the first generated "
+        "token (queue + prefill) — SLO digest, fixed log buckets",
+        ("workload",),
+    ),
+    MetricSpec(
+        "areal_slo_tpot_seconds",
+        "histogram",
+        "Per-token time: mean inter-token gap after the first token, "
+        "one observation per finished request — SLO digest, fixed log "
+        "buckets",
+        ("workload",),
+    ),
+    MetricSpec(
+        "areal_slo_stall_seconds",
+        "histogram",
+        "Time a request spent quiesced by weight swaps or parked by "
+        "preemption while in flight — SLO digest, fixed log buckets",
+        ("workload",),
+    ),
     # -- gserver manager (system/gserver_manager.py) -------------------------
     MetricSpec(
         "areal_gserver_alloc_rejections_total",
@@ -503,6 +545,21 @@ TRACE_TABLE = [
         "One speculative verify window, dispatch to harvest: a batched "
         "paged prefill of the row's draft (attrs: row, drafted, "
         "accepted, emitted)",
+    ),
+    TraceSpec(
+        "swap.stage",
+        "span",
+        "Staged weight restore on the generation server: snapshot "
+        "restore -> device-resident staging tree, while decode "
+        "continues (attrs: version; root swap-v{n}, force-sampled)",
+    ),
+    TraceSpec(
+        "swap.commit",
+        "span",
+        "The weight-swap apply window that actually interrupts decode: "
+        "ring drain -> pointer flip (or legacy full reload) -> prefix "
+        "flush -> in-flight recompute (attrs: version, pre_sharded, "
+        "interrupted)",
     ),
     TraceSpec(
         "engine.finish",

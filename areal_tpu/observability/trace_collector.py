@@ -67,6 +67,11 @@ class StallWatchdog:
             "areal_trace_stall_total"
         )
         self._flagged: Set[Tuple] = set()
+        # SLO percentile alarm state: consecutive breach count + whether
+        # the current breach episode already fired (one alarm per
+        # episode; recovery re-arms)
+        self._slo_breaches = 0
+        self._slo_fired = False
 
     def check(
         self,
@@ -113,6 +118,35 @@ class StallWatchdog:
         # spans that closed (or were harvested away) re-arm their key
         self._flagged &= live_keys
         return stalls
+
+    def check_slo(self, ttft_p99: Optional[float]) -> bool:
+        """Percentile-based SLO alarm: fleet p99 TTFT (the aggregator's
+        merged ``slo/areal_slo_ttft_seconds/all/p99`` row) above
+        ``config.slo_ttft_p99_s`` for ``config.slo_breach_scrapes``
+        consecutive scrape cycles fires
+        ``areal_trace_stall_total{kind="slo"}`` ONCE per breach episode
+        (a recovered p99 re-arms it).  ``None`` threshold disables; a
+        ``None`` observation (no digests scraped yet) neither breaches
+        nor resets.  Returns True iff the alarm fired this call."""
+        thr = getattr(self.config, "slo_ttft_p99_s", None)
+        if thr is None or ttft_p99 is None:
+            return False
+        if ttft_p99 <= thr:
+            self._slo_breaches = 0
+            self._slo_fired = False
+            return False
+        self._slo_breaches += 1
+        need = max(1, getattr(self.config, "slo_breach_scrapes", 3))
+        if self._slo_breaches < need or self._slo_fired:
+            return False
+        self._slo_fired = True
+        self._m_stalls.inc(kind="slo")
+        logger.warning(
+            "SLO alarm: fleet p99 TTFT %.3fs above threshold %.3fs for "
+            "%d consecutive scrapes",
+            ttft_p99, thr, self._slo_breaches,
+        )
+        return True
 
 
 class TraceCollector:
@@ -251,15 +285,24 @@ class TraceCollector:
             return None
 
     def step(
-        self, step: int, current_version: Optional[int] = None
+        self,
+        step: int,
+        current_version: Optional[int] = None,
+        fleet_slo: Optional[Dict[str, float]] = None,
     ) -> int:
         """One collection cycle: harvest every worker, persist, run the
-        stall watchdog.  Returns the number of events harvested."""
+        stall watchdog (span deadlines, buffer age, and — when the
+        caller passes the aggregator's fleet SLO row — the p99-TTFT
+        percentile alarm).  Returns the number of events harvested."""
         events, open_spans = self.harvest()
         self._record(events, open_spans, step)
         if current_version is None:
             current_version = self._current_version()
         self.watchdog.check(open_spans, current_version=current_version)
+        if fleet_slo is not None:
+            from areal_tpu.observability.latency import FLEET_TTFT_P99_KEY
+
+            self.watchdog.check_slo(fleet_slo.get(FLEET_TTFT_P99_KEY))
         return len(events)
 
     # -- export -------------------------------------------------------------

@@ -65,6 +65,12 @@ class TraceConfig:
     #: stall watchdog: an open buffer-resident span whose recorded weight
     #: version lags the current version by more than this is flagged
     stall_buffer_versions: int = 8
+    #: SLO percentile alarm: fleet-merged p99 TTFT (seconds) above this
+    #: threshold for ``slo_breach_scrapes`` CONSECUTIVE scrape cycles
+    #: fires ``areal_trace_stall_total{kind="slo"}`` once (re-armed when
+    #: p99 recovers).  None disables the alarm.
+    slo_ttft_p99_s: Optional[float] = None
+    slo_breach_scrapes: int = 3
 
 
 #: env fallback for processes that receive no TraceConfig (bench arms,

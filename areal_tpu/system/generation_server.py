@@ -138,6 +138,8 @@ class GenerationServerWorker(worker_base.Worker):
             spec_decode_params=resolve_spec_params(
                 getattr(config, "spec_decode", None)
             ),
+            slo_tracking=getattr(config, "slo_tracking", True),
+            server_name=config.worker_name,
         )
 
         self._ctx = zmq.Context.instance()
@@ -281,6 +283,26 @@ class GenerationServerWorker(worker_base.Worker):
             "areal_inference_spec_accept_rate",
             buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
         )
+        # request-level SLO digests: each family is a histogram over the
+        # FIXED log buckets (latency.SLO_BUCKETS), so the master-side
+        # aggregator can rebuild and EXACTLY merge per-worker digests
+        # into fleet percentiles (observability/latency.py)
+        from areal_tpu.observability.latency import SLO_BUCKETS
+
+        self._obs_slo = {
+            "admission_wait_s": reg.histogram(
+                "areal_slo_admission_wait_seconds", buckets=SLO_BUCKETS
+            ),
+            "ttft_s": reg.histogram(
+                "areal_slo_ttft_seconds", buckets=SLO_BUCKETS
+            ),
+            "tpot_s": reg.histogram(
+                "areal_slo_tpot_seconds", buckets=SLO_BUCKETS
+            ),
+            "stall_s": reg.histogram(
+                "areal_slo_stall_seconds", buckets=SLO_BUCKETS
+            ),
+        }
         self._obs_last: Dict[str, float] = {}
 
     def _export_engine_metrics(self):
@@ -317,6 +339,15 @@ class GenerationServerWorker(worker_base.Worker):
                 self._obs_last[key] = total
         for frac in eng.drain_spec_accept_samples():
             self._obs_accept_hist.observe(frac)
+        for rec in eng.drain_slo_records():
+            w = rec.workload
+            self._obs_slo["admission_wait_s"].observe(
+                rec.admission_wait_s, workload=w
+            )
+            self._obs_slo["ttft_s"].observe(rec.ttft_s, workload=w)
+            self._obs_slo["stall_s"].observe(rec.stall_s, workload=w)
+            if rec.tpot_s is not None:
+                self._obs_slo["tpot_s"].observe(rec.tpot_s, workload=w)
         self._obs["inflight"].set(eng.n_inflight)
         self._obs["pending"].set(eng.n_pending)
         self._obs["version"].set(eng.version)
@@ -518,6 +549,17 @@ class GenerationServerWorker(worker_base.Worker):
         rec["thread"].start()
 
     def _stage_worker(self, payload: Dict, rec: Dict):
+        # the staged restore as a flight-recorder span: it runs WHILE
+        # decode continues, and the Perfetto lane ("swap-v{n}") makes
+        # the overlap with the decode chunks visible instead of only
+        # counted.  Force-sampled: swaps are fleet events, not rollouts.
+        swap_root = f"swap-v{payload.get('version')}"
+        tracer = self.engine.tracer
+        tracer.force(swap_root)
+        tracer.span_begin(
+            swap_root, "swap.stage", root=swap_root,
+            version=payload.get("version"),
+        )
         try:
             params = self._load_update_params(payload, staged=True)
             # device_put onto the serving shardings (no-op when the
@@ -528,9 +570,16 @@ class GenerationServerWorker(worker_base.Worker):
                 "staged": payload.get("version"),
                 "stage_seconds": round(time.monotonic() - rec["t0"], 4),
             }
+            tracer.span_end(
+                swap_root, "swap.stage", root=swap_root, ok=True,
+            )
         except Exception as e:  # noqa: BLE001 - reported to the manager
             self.logger.exception("weight staging failed")
             rec["result"] = {"error": repr(e)}
+            tracer.span_end(
+                swap_root, "swap.stage", root=swap_root, ok=False,
+                error=repr(e),
+            )
         finally:
             rec["done"].set()
 
@@ -621,6 +670,11 @@ class GenerationServerWorker(worker_base.Worker):
                 f"swap_{k}": v
                 for k, v in self.engine.swap_stats().items()
             },
+            # request-level SLO plane: per-stage percentile summaries
+            # (records_total + TTFT/TPOT/admission/stall p50-p99) and the
+            # raw mergeable digest state for external consumers
+            "slo": self.engine.slo_stats(),
+            "slo_digests": self.engine.slo_digests(),
         }
 
     # -- poll ---------------------------------------------------------------

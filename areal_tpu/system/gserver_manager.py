@@ -157,6 +157,17 @@ class GserverManager(worker_base.Worker):
         self._m_updates = reg.counter(
             "areal_gserver_weight_updates_total"
         )
+        # SLO plane: schedule wait = how long a rollout sat at the
+        # staleness/capacity gate before admission (first rejected
+        # allocate -> the eventual ok; 0 when admitted immediately).
+        # Fixed log buckets so the master can merge this digest with the
+        # engines' TTFT/TPOT families into one fleet row.
+        from areal_tpu.observability.latency import SLO_BUCKETS
+
+        self._m_slo_sched = reg.histogram(
+            "areal_slo_schedule_wait_seconds", buckets=SLO_BUCKETS
+        )
+        self._gate_first_reject: Dict[str, float] = {}
         self._update_pool = None
 
     def _devices(self, addr: str) -> int:
@@ -366,12 +377,21 @@ class GserverManager(worker_base.Worker):
         cap = self.config.max_concurrent_rollouts or 10**9
         if self.rollout_stat.running >= cap:
             self._m_rejects.inc(reason="capacity")
+            self._gate_first_reject.setdefault(qid, time.monotonic())
             return {"ok": False, "reason": "capacity"}
         if self.is_staled():
             self._m_rejects.inc(reason="staled")
+            self._gate_first_reject.setdefault(qid, time.monotonic())
             return {"ok": False, "reason": "staled"}
         self.rollout_stat.submitted += 1
         self.rollout_stat.running += 1
+        # schedule wait: gate-queueing latency of this rollout (0 when
+        # it was never rejected) — the SLO plane's head-of-pipeline term
+        t0 = self._gate_first_reject.pop(qid, None)
+        self._m_slo_sched.observe(
+            0.0 if t0 is None else max(0.0, time.monotonic() - t0),
+            workload="rollout",
+        )
         return {"ok": True, "reason": ""}
 
     def _finish_rollout(self, qid: str, accepted: bool):
@@ -398,6 +418,9 @@ class GserverManager(worker_base.Worker):
         self._group_server.pop(qid, None)
         self._group_prefix.pop(qid, None)
         self._group_tokens.pop(qid, None)
+        # a rollout abandoned between reject and ok must not leak its
+        # gate stamp (and must not pollute a later same-qid rollout)
+        self._gate_first_reject.pop(qid, None)
 
     # -- weight updates -----------------------------------------------------
 

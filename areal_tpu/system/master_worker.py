@@ -332,7 +332,9 @@ class MasterWorker(worker_base.AsyncWorker):
         except Exception:  # noqa: BLE001 - scraping never fails a step
             self.logger.exception("cluster metrics scrape failed")
         try:
-            self._trace_collector.step(step.global_step)
+            # the cluster row carries the fleet-merged SLO percentiles;
+            # handing it to the collector arms the p99-TTFT alarm
+            self._trace_collector.step(step.global_step, fleet_slo=cluster)
         except Exception:  # noqa: BLE001 - tracing never fails a step
             self.logger.exception("trace harvest failed")
         self._metrics.log({**stats, **cluster}, step.global_step)

@@ -11,6 +11,7 @@ are reassembled before replying).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, List, Optional, Tuple
 
 from areal_tpu.api import model_api
@@ -90,6 +91,7 @@ class PartialRolloutManager:
                 qid, "rollout.chunk", root=root,
                 attempt=attempt, gen_qid=gen_qid,
             )
+            t_sched = time.monotonic()
             try:
                 sched = await asyncio.to_thread(
                     self.manager_client.call,
@@ -121,6 +123,12 @@ class PartialRolloutManager:
                     prompt_ids=prompt_ids,
                     input_ids=cur,
                     gconfig=self.gconfig.new(max_new_tokens=chunk, n=1),
+                    # SLO plane: client-observed routing latency, stamped
+                    # on THIS clock (no cross-host skew) — the engine
+                    # folds it into the request's LatencyRecord
+                    metadata={
+                        "slo_schedule_wait_s": time.monotonic() - t_sched
+                    },
                 )
                 out = await asyncio.to_thread(client.generate, inp)
                 self._tracer.span_end(

@@ -134,6 +134,11 @@ class WorkerServer:
     def register_handler(self, command: str, fn):
         self._handlers[command] = fn
 
+    def note_activity(self):
+        """Refresh the /healthz last-activity stamp (productive polls)."""
+        if self.metrics_server is not None:
+            self.metrics_server.note_activity()
+
     def set_status(self, status: WorkerServerStatus):
         self._status = status
         name_resolve.add(self._status_key, status.value, replace=True)
@@ -381,6 +386,8 @@ class Worker:
                 r = self._poll()
                 if r.sample_count == r.batch_count == 0:
                     time.sleep(0.002)
+                elif self._server:
+                    self._server.note_activity()
             status = self._exit_status or WorkerServerStatus.COMPLETED
             if self._server:
                 self._server.set_status(status)
@@ -429,6 +436,8 @@ class AsyncWorker(Worker):
                 r = await self._poll_async()
                 if r.sample_count == r.batch_count == 0:
                     await asyncio.sleep(0.002)
+                elif self._server:
+                    self._server.note_activity()
             status = self._exit_status or WorkerServerStatus.COMPLETED
             if self._server:
                 self._server.set_status(status)

@@ -579,6 +579,221 @@ def bench_prefix_cache_ab(
     }
 
 
+def bench_slo_report(
+    cfg,
+    params,
+    n_sessions=6,
+    turns=3,
+    prompt_len=192,
+    user_len=32,
+    max_new=48,
+    page=256,
+    chunk=32,
+    overhead_reqs=32,
+    overhead_prompt=256,
+    overhead_new=256,
+    overhead_repeats=2,
+):
+    """Request-level SLO report (observability/latency.py):
+
+    * **multi_turn** — the multi-turn replay workload split across TWO
+      engines posing as separate servers; each engine's TTFT/TPOT
+      digests are FLEET-MERGED (exact: fixed log buckets) and reported
+      as p50/p95/p99 alongside per-server p99 — the same merge the
+      master's aggregator performs over scraped pages.
+    * **spec_decode** — the repetitive-trace workload with speculative
+      decoding ON (greedy + paged), so the report covers the serving
+      mode whose TTFT/TPOT shape differs most from plain decode.
+    * **overhead_ab** — sustained decode tok/s with SLO tracking on vs
+      off; the tracked acceptance bar is on < 2% overhead vs off (same
+      bar as the flight recorder's).
+
+    ``merge_within_bound`` cross-checks the merged p50/p95/p99 against
+    the pooled raw records' inverted-CDF quantiles — the documented
+    digest error bound, asserted in tier-1 by
+    tests/engine/test_bench_sweep.py."""
+    import zlib
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine.sampling import SamplingParams
+    from areal_tpu.engine.spec_decode import SpecDecodeParams
+    from areal_tpu.observability.latency import (
+        SLO_REL_ERROR_BOUND,
+        LatencyDigest,
+    )
+
+    def _pct(digest):
+        p = digest.percentiles()
+        return {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in p.items()
+        }
+
+    def _fleet(engines, records):
+        """Fleet-merge the engines' digests + cross-check vs raw
+        records (the pooled inverted-CDF quantiles must sit within the
+        documented bound of the merged digest's)."""
+        fleet = {"ttft_s": LatencyDigest(), "tpot_s": LatencyDigest()}
+        servers = {}
+        for eng in engines:
+            digs = {
+                k: LatencyDigest.from_dict(v)
+                for k, v in eng.slo_digests().items()
+            }
+            for k in fleet:
+                fleet[k].merge(digs[k])
+            servers[eng.server_name] = {
+                "ttft_p99": digs["ttft_s"].quantile(0.99),
+                "tpot_p99": digs["tpot_s"].quantile(0.99),
+                "records": eng.slo_records_total,
+            }
+        checks = []
+        for field, dig in fleet.items():
+            raw = sorted(
+                r.ttft_s if field == "ttft_s" else r.tpot_s
+                for r in records
+                if (field == "ttft_s" or r.tpot_s is not None)
+            )
+            for q in (0.50, 0.95, 0.99):
+                if not raw:
+                    continue
+                # inverted-CDF: the ceil(q*n)-th smallest raw value
+                emp = raw[
+                    min(len(raw) - 1, max(0, int(np.ceil(q * len(raw))) - 1))
+                ]
+                got = dig.quantile(q)
+                if emp > 0 and got is not None:
+                    checks.append(abs(got - emp) / emp)
+        return {
+            "fleet": {k: _pct(d) for k, d in fleet.items()},
+            "servers": servers,
+            "merge_max_rel_err": round(max(checks), 4) if checks else None,
+            "merge_within_bound": bool(
+                not checks or max(checks) <= SLO_REL_ERROR_BOUND + 1e-12
+            ),
+        }
+
+    def multi_turn():
+        engines = [
+            make_engine(
+                cfg, params, n_sessions,
+                prompt_len + (turns - 1) * (max_new + user_len), max_new,
+                chunk=chunk, cache_mode="paged", page_size=page,
+                server_name=f"srv{j}",
+            )
+            for j in range(2)
+        ]
+        records = []
+        rngs = [
+            np.random.default_rng(zlib.crc32(f"slo-s{s}".encode()))
+            for s in range(n_sessions)
+        ]
+        convs = [
+            rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+            for rng in rngs
+        ]
+        for j in range(turns):
+            for s, conv in enumerate(convs):
+                eng = engines[s % 2]  # session -> "server" routing
+                eng.submit(
+                    APIGenerateInput(
+                        qid=f"slo-s{s}@t{j}",
+                        prompt_ids=conv,
+                        input_ids=conv,
+                        gconfig=GenerationHyperparameters(
+                            max_new_tokens=max_new, temperature=1.0
+                        ),
+                        metadata={"slo_schedule_wait_s": 0.0},
+                    )
+                )
+            for eng in engines:
+                drain(eng)
+            for s, rng in enumerate(rngs):
+                convs[s] = convs[s] + rng.integers(
+                    0, cfg.vocab_size, (max_new + user_len,)
+                ).tolist()
+        for eng in engines:
+            records.extend(eng.drain_slo_records())
+        out = _fleet(engines, records)
+        out["records"] = len(records)
+        engines.clear()  # free both engines' KV/params before the next arm
+        return out
+
+    def spec_workload():
+        eng = make_engine(
+            cfg, params, n_sessions, prompt_len, max_new, chunk=chunk,
+            cache_mode="paged", page_size=page,
+            sampling=SamplingParams(greedy=True),
+            spec_decode_params=SpecDecodeParams(
+                enabled=True, max_draft_tokens=7
+            ),
+            server_name="srv-spec",
+        )
+        for i in range(n_sessions):
+            rng = np.random.default_rng(zlib.crc32(f"slor{i}".encode()))
+            motif = rng.integers(0, 2, (12,)).tolist()
+            ids = (motif * (prompt_len // 12 + 1))[:prompt_len]
+            eng.submit(
+                APIGenerateInput(
+                    qid=f"slosp{i}",
+                    prompt_ids=ids,
+                    input_ids=ids,
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=max_new, greedy=True
+                    ),
+                )
+            )
+        drain(eng)
+        records = eng.drain_slo_records()
+        out = _fleet([eng], records)
+        out["records"] = len(records)
+        del eng
+        return out
+
+    def overhead_ab():
+        rows = {}
+        for arm, on in (("off", False), ("on", True)):
+            eng = make_engine(
+                cfg, params, overhead_reqs, overhead_prompt,
+                overhead_new, slo_tracking=on,
+            )
+            submit_wave(
+                eng, cfg, overhead_reqs, overhead_prompt, overhead_new,
+                f"slow{arm}",
+            )
+            drain(eng)  # warmup: compiles shared across arms
+            best = 0.0
+            for r in range(overhead_repeats):
+                submit_wave(
+                    eng, cfg, overhead_reqs, overhead_prompt,
+                    overhead_new, f"slot{arm}{r}",
+                )
+                eng._admit()
+                int(np.asarray(eng.cache.lengths)[0])  # prefill done
+                t0 = time.perf_counter()
+                n = drain(eng)
+                best = max(best, n / (time.perf_counter() - t0))
+            rows[arm] = round(best, 1)
+            del eng
+        return {
+            "slo_off_toks_per_sec": rows["off"],
+            "slo_on_toks_per_sec": rows["on"],
+            "overhead_frac_vs_off": round(
+                1.0 - rows["on"] / max(rows["off"], 1e-9), 4
+            ),
+        }
+
+    return {
+        "error_bound": round(SLO_REL_ERROR_BOUND, 4),
+        "multi_turn": multi_turn(),
+        "spec_decode": spec_workload(),
+        "overhead_ab": overhead_ab(),
+    }
+
+
 def bench_spec_decode_ab(
     cfg,
     params,
@@ -1514,6 +1729,7 @@ SUMMARY_REQUIRED_KEYS = (
     "prefix_cache_ab",
     "trace_overhead_ab",
     "spec_decode_ab",
+    "slo_report",
     "sharded_serving",
     "weight_swap_ab",
     "paged_decode_ab",
@@ -1528,6 +1744,7 @@ def build_summary(
     prefix_cache_ab=None,
     trace_overhead_ab=None,
     spec_decode_ab=None,
+    slo_report=None,
     sharded_serving=None,
     weight_swap_ab=None,
     decode_ab=None,
@@ -1562,6 +1779,7 @@ def build_summary(
         "prefix_cache_ab": prefix_cache_ab,
         "trace_overhead_ab": trace_overhead_ab,
         "spec_decode_ab": spec_decode_ab,
+        "slo_report": slo_report,
         "sharded_serving": sharded_serving,
         "weight_swap_ab": weight_swap_ab,
         "paged_decode_ab": (
@@ -2204,6 +2422,27 @@ def main():
         ),
     )
 
+    # request-level SLO report: fleet-merged TTFT/TPOT percentiles under
+    # the multi-turn replay + spec-decode workloads, digest-merge
+    # cross-check, and the SLO-tracking on/off overhead A/B (<2% bar).
+    # Runs off-TPU too — tiny shapes — so the summary always carries it.
+    mark("slo report")
+    slo_report = _section(
+        bench_slo_report,
+        cfg,
+        gen_params,
+        name="slo_report",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                n_sessions=2, turns=2, prompt_len=32, user_len=8,
+                max_new=12, page=16, chunk=4, overhead_reqs=2,
+                overhead_prompt=32, overhead_new=16, overhead_repeats=1,
+            )
+        ),
+    )
+
     # self-speculative decoding A/B: n-gram draft + batched paged verify
     # on vs off, on a repetitive-trace workload (decode tok/s + accepted
     # tokens per verify step).  Runs off-TPU too — tiny shapes — so the
@@ -2425,6 +2664,7 @@ def main():
         prefix_cache_ab=prefix_cache_ab,
         trace_overhead_ab=trace_overhead_ab,
         spec_decode_ab=spec_decode_ab,
+        slo_report=slo_report,
         sharded_serving=sharded_serving,
         weight_swap_ab=weight_swap_ab,
         decode_ab=decode_ab,
@@ -2482,6 +2722,7 @@ def main():
                     "prefix_cache_ab": prefix_cache_ab,
                     "trace_overhead_ab": trace_overhead_ab,
                     "spec_decode_ab": spec_decode_ab,
+                    "slo_report": slo_report,
                     "sharded_serving": sharded_serving,
                 },
             }

@@ -152,6 +152,50 @@ def collect_documented_trace_names(path: str = DOCS_TABLE) -> Set[str]:
     return out
 
 
+def slo_vocabulary_problems(families: Dict[str, str], table) -> List[str]:
+    """The ``areal_slo_*`` digest vocabulary, linted BOTH ways:
+
+    * every family in ``latency.SLO_FAMILIES`` must exist in
+      METRIC_TABLE as a *histogram* labeled exactly ``(workload,)`` —
+      the digest merge rebuilds percentiles from scraped histogram
+      buckets, so a family declared as any other shape silently breaks
+      fleet merging;
+    * every ``areal_slo_*`` METRIC_TABLE entry must be in SLO_FAMILIES —
+      an SLO-prefixed metric outside the digest plane would LOOK
+      mergeable to operators but never reach the fleet rows.
+
+    Split out (pure function of its inputs) so the tier-1 test can feed
+    it fabricated mismatches."""
+    problems: List[str] = []
+    by_name = {spec.name: spec for spec in table}
+    for name in sorted(families):
+        spec = by_name.get(name)
+        if spec is None:
+            problems.append(
+                f"SLO family {name} (latency.SLO_FAMILIES) is missing "
+                "from METRIC_TABLE"
+            )
+            continue
+        if spec.type != "histogram":
+            problems.append(
+                f"SLO family {name} must be a histogram (digest "
+                f"transport), table declares {spec.type!r}"
+            )
+        if tuple(spec.labels) != ("workload",):
+            problems.append(
+                f"SLO family {name} must be labeled exactly "
+                f"('workload',), table declares {tuple(spec.labels)!r}"
+            )
+    for spec in table:
+        if spec.name.startswith("areal_slo_") and spec.name not in families:
+            problems.append(
+                f"METRIC_TABLE entry {spec.name} uses the areal_slo_ "
+                "prefix but is not in latency.SLO_FAMILIES — it would "
+                "never merge into the fleet percentile rows"
+            )
+    return problems
+
+
 def run_lint() -> List[str]:
     """Returns a list of violation messages (empty = clean)."""
     sys.path.insert(0, REPO_ROOT)
@@ -208,6 +252,11 @@ def run_lint() -> List[str]:
             "areal_tpu/observability/table.py METRIC_TABLE (stale doc "
             "row — remove it or add the table entry)"
         )
+
+    # -- areal_slo_* digest vocabulary (latency.py <-> table, both ways) ----
+    from areal_tpu.observability.latency import SLO_FAMILIES
+
+    problems.extend(slo_vocabulary_problems(SLO_FAMILIES, METRIC_TABLE))
 
     # -- trace span/event vocabulary (same discipline, second table) --------
     from areal_tpu.observability.table import TRACE_TABLE

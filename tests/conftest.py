@@ -31,6 +31,26 @@ def pytest_configure(config):
     )
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Tier-1 per-test runtime guard: a PASSING non-``slow`` test whose
+    call phase ran past the per-test budget becomes a loud failure
+    naming the offender, instead of silently pushing the suite toward
+    its 870 s hard timeout (tests/helpers/runtime_guard.py)."""
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when != "call" or not rep.passed:
+        return
+    from tests.helpers.runtime_guard import over_budget_message
+
+    msg = over_budget_message(
+        item.nodeid, call.duration, is_slow="slow" in item.keywords
+    )
+    if msg is not None:
+        rep.outcome = "failed"
+        rep.longrepr = msg
+
+
 @pytest.fixture(autouse=True)
 def _fresh_globals():
     """Reset process-global state between tests."""

@@ -211,6 +211,59 @@ def spec_ab(tiny_cfg):
     )
 
 
+@pytest.fixture(scope="module")
+def slo_report(tiny_cfg):
+    """One tiny bench_slo_report run shared by the section + schema
+    tests (multi-turn replay across two 'servers', spec-decode arm,
+    SLO-tracking on/off overhead A/B)."""
+    import jax
+
+    from areal_tpu.models import transformer
+
+    params = transformer.init_params(tiny_cfg, jax.random.PRNGKey(2))
+    return bench.bench_slo_report(
+        tiny_cfg, params, n_sessions=2, turns=2, prompt_len=32,
+        user_len=8, max_new=12, page=16, chunk=4, overhead_reqs=2,
+        overhead_prompt=32, overhead_new=16, overhead_repeats=1,
+    )
+
+
+def test_slo_report_fleet_merged_percentiles_within_bound(slo_report):
+    """The acceptance criterion: fleet-merged TTFT/TPOT p50/p95/p99
+    present for both workloads, and the digest-merge cross-check against
+    the pooled raw records sits inside the documented error bound."""
+    from areal_tpu.observability.latency import SLO_REL_ERROR_BOUND
+
+    assert slo_report["error_bound"] == pytest.approx(
+        SLO_REL_ERROR_BOUND, abs=1e-4
+    )
+    for workload in ("multi_turn", "spec_decode"):
+        row = slo_report[workload]
+        assert row["records"] > 0, (workload, row)
+        for fam in ("ttft_s", "tpot_s"):
+            pct = row["fleet"][fam]
+            for k in ("p50", "p95", "p99"):
+                assert pct[k] is not None and pct[k] > 0, (workload, fam, k)
+            assert pct["p50"] <= pct["p95"] <= pct["p99"]
+            assert pct["count"] > 0
+        # THE error-bound assertion: merged digest vs pooled raw records
+        assert row["merge_within_bound"] is True, row
+    # two servers in the multi-turn arm, each attributable
+    assert sorted(slo_report["multi_turn"]["servers"]) == ["srv0", "srv1"]
+    for srow in slo_report["multi_turn"]["servers"].values():
+        assert srow["records"] > 0 and srow["ttft_p99"] > 0
+
+
+def test_slo_report_overhead_ab_reports_both_arms(slo_report):
+    """The on/off A/B carries both arms + the overhead fraction (the
+    <2% bar is asserted on TPU bench rounds; CPU smoke asserts shape
+    and sanity, not the noisy CPU ratio)."""
+    ab = slo_report["overhead_ab"]
+    assert ab["slo_on_toks_per_sec"] > 0
+    assert ab["slo_off_toks_per_sec"] > 0
+    assert -1.0 < ab["overhead_frac_vs_off"] < 1.0
+
+
 def test_spec_decode_ab_reports_required_fields(spec_ab):
     row = spec_ab["b2"]
     for arm in ("spec_off", "spec_on"):
@@ -238,6 +291,11 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
         prefix_cache_ab={"replay_wall_speedup": 1.5},
         trace_overhead_ab=None,
         spec_decode_ab=spec_ab,
+        slo_report={
+            "error_bound": 0.0905,
+            "multi_turn": {"fleet": {"ttft_s": {"p99": 0.5}}},
+            "overhead_ab": {"overhead_frac_vs_off": 0.01},
+        },
         sharded_serving={
             "n_chips": 2,
             "dense_tp": {"scaling_x": 1.7, "token_parity": True},
@@ -267,6 +325,8 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
     assert blob["paged_decode_ab"]["ctx2048_b16"] == [1.0, 2.0, 3.0]
     assert blob["dispatch_table"] == {"paged_min_cache_len": 2048}
     assert blob["sharded_serving"]["moe_ep"]["expert_shard_ok"] is True
+    assert blob["slo_report"]["multi_turn"]["fleet"]["ttft_s"]["p99"] == 0.5
+    assert blob["slo_report"]["overhead_ab"]["overhead_frac_vs_off"] == 0.01
     assert blob["weight_swap_ab"]["staged_below_full_all"] is True
     assert blob["weight_swap_ab"]["dense"]["staged_pause_ms"] < (
         blob["weight_swap_ab"]["dense"]["full_pause_ms"]

@@ -1,0 +1,45 @@
+"""Tier-1 per-test runtime guard.
+
+The tier-1 suite runs under a hard 870 s ``timeout`` (ROADMAP.md) and is
+already at ~690 s: one new slow test can push the whole suite into the
+kill window, where the failure mode is an opaque rc=124 instead of a
+named offender.  This guard makes creep fail LOUDLY: ``conftest.py``
+turns any PASSING non-``slow`` test whose call phase exceeded
+:data:`TIER1_TEST_BUDGET_S` into a failure naming the test and its
+duration (the verify command also passes ``--durations=15`` so the
+near-offenders are visible every run).
+
+Tests that legitimately need longer belong behind the ``slow`` marker —
+they run outside the tier-1 budget (``pytest -m slow``).
+
+The decision is a pure function so it is itself unit-tested
+(tests/base/test_runtime_guard.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: per-test wall budget (seconds) for the call phase of non-slow tests.
+#: Headroom check (2026-08): the slowest tier-1 test is ~35 s
+#: (test_async_ppo_e2e), so 60 s flags regressions without flaking the
+#: existing suite.
+TIER1_TEST_BUDGET_S = 60.0
+
+
+def over_budget_message(
+    nodeid: str,
+    duration_s: float,
+    is_slow: bool,
+    budget_s: float = TIER1_TEST_BUDGET_S,
+) -> Optional[str]:
+    """The guard decision: a failure message for a non-``slow`` test
+    whose call phase ran past the budget, else None."""
+    if is_slow or duration_s <= budget_s:
+        return None
+    return (
+        f"tier-1 runtime guard: {nodeid} took {duration_s:.1f}s, over "
+        f"the {budget_s:.0f}s per-test budget (suite hard-timeout is "
+        "870s total — see ROADMAP.md).  Make the test faster, or mark "
+        "it @pytest.mark.slow to move it out of tier-1."
+    )

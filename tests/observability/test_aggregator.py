@@ -103,6 +103,208 @@ def test_discovers_and_scrapes_three_live_workers(
     )
 
 
+def _slo_page_registry(values):
+    """A registry carrying an areal_slo_ttft_seconds digest over the
+    canonical fixed buckets (what a gen server's worker loop exports)."""
+    from areal_tpu.observability.latency import SLO_BUCKETS
+
+    reg = MetricsRegistry()
+    h = reg.histogram("areal_slo_ttft_seconds", buckets=SLO_BUCKETS)
+    for v in values:
+        h.observe(float(v), workload="rollout")
+    return reg
+
+
+def test_step_merges_slo_digests_into_fleet_rows(tmp_path):
+    """The acceptance-critical path: two gen servers exporting
+    areal_slo_* digests -> one aggregator step -> fleet-merged p50/95/99
+    rows in the sink dict AND the jsonl snapshot, equal to the pooled
+    single-stream digest (exact merge)."""
+    from areal_tpu.observability.latency import (
+        FLEET_TTFT_P99_KEY,
+        LatencyDigest,
+    )
+
+    fast, slow = [0.02] * 60, [2.5] * 20
+    servers = []
+    for name, vals in (("gen_server_0", fast), ("gen_server_1", slow)):
+        srv = MetricsServer(registry=_slo_page_registry(vals)).start()
+        srv.register(EXPR, TRIAL, name)
+        servers.append(srv)
+    snap = tmp_path / "cluster_metrics.jsonl"
+    agg = ClusterMetricsAggregator(EXPR, TRIAL, snapshot_path=str(snap))
+    try:
+        flat = agg.step(step=3)
+    finally:
+        agg.close()
+        for s in servers:
+            s.stop()
+    pooled = LatencyDigest()
+    for v in fast + slow:
+        pooled.observe(v)
+    assert flat[FLEET_TTFT_P99_KEY] == pooled.quantile(0.99)
+    assert (
+        flat["slo/areal_slo_ttft_seconds/rollout/p50"]
+        == pooled.quantile(0.50)
+    )
+    assert flat["slo/areal_slo_ttft_seconds/rollout/count"] == 80.0
+    # per-server attribution rides the same row
+    assert (
+        flat["slo/server/gen_server_1/areal_slo_ttft_seconds/rollout/p99"]
+        > flat["slo/server/gen_server_0/areal_slo_ttft_seconds/rollout/p99"]
+    )
+    row = json.loads(snap.read_text().splitlines()[0])
+    assert row[FLEET_TTFT_P99_KEY] == pooled.quantile(0.99)
+
+
+def test_slo_rows_are_windowed_per_scrape(three_live_workers):
+    """merge_slo diffs consecutive scrapes: the sink row's percentiles
+    describe THIS window, not lifetime — after a slow storm, a healthy
+    window reads healthy immediately (the watchdog's 'p99 right now')
+    and a window with no new samples emits no rows (counter-reset
+    fallback is covered below)."""
+    from areal_tpu.observability.latency import SLO_BUCKETS
+
+    reg = _slo_page_registry([2.0] * 50)  # scrape 1: a slow storm
+    srv = MetricsServer(registry=reg).start()
+    srv.register(EXPR, TRIAL, "gen_server_w")
+    agg = ClusterMetricsAggregator(EXPR, TRIAL)
+    try:
+        rows1 = agg.merge_slo(agg.scrape())
+        assert rows1["slo/areal_slo_ttft_seconds/rollout/count"] == 50.0
+        assert rows1["slo/areal_slo_ttft_seconds/rollout/p99"] > 1.0
+
+        # scrape 2: no new samples -> no rows (not "still storming")
+        assert agg.merge_slo(agg.scrape()) == {}
+
+        # scrape 3: 10 fast samples -> the window is ONLY those 10
+        h = reg.histogram("areal_slo_ttft_seconds", buckets=SLO_BUCKETS)
+        for _ in range(10):
+            h.observe(0.01, workload="rollout")
+        rows3 = agg.merge_slo(agg.scrape())
+        assert rows3["slo/areal_slo_ttft_seconds/rollout/count"] == 10.0
+        assert rows3["slo/areal_slo_ttft_seconds/rollout/p99"] < 0.1
+    finally:
+        srv.stop()
+        agg.close()
+
+
+def test_slo_window_counter_reset_falls_back_to_fresh_snapshot():
+    """digest_delta at the aggregator layer: a restarted worker's
+    smaller cumulative counts must yield the fresh snapshot, not a
+    negative window."""
+    from areal_tpu.observability.latency import (
+        LatencyDigest,
+        digest_delta,
+    )
+
+    big = LatencyDigest()
+    for _ in range(100):
+        big.observe(1.0)
+    small = LatencyDigest()
+    for _ in range(7):
+        small.observe(0.05)
+    delta = digest_delta(small, big)  # counters went DOWN: restart
+    assert delta.count == 7
+    assert delta.quantile(0.5) == small.quantile(0.5)
+    # and the normal monotone case is an exact subtraction
+    grown = LatencyDigest.from_dict(big.to_dict())
+    grown.observe(9.0)
+    d2 = digest_delta(grown, big)
+    assert d2.count == 1
+    assert abs(d2.quantile(0.5) - 9.0) / 9.0 < 0.1
+
+
+def test_slo_worker_appearing_mid_run(three_live_workers):
+    """A gen server registering mid-run joins the NEXT cycle's fleet
+    percentiles (same re-discovery path as plain metrics)."""
+    agg = ClusterMetricsAggregator(EXPR, TRIAL)
+    assert agg.merge_slo(agg.scrape()) == {}  # nobody exports SLO yet
+    srv = MetricsServer(registry=_slo_page_registry([0.1] * 10)).start()
+    srv.register(EXPR, TRIAL, "gen_server_9")
+    try:
+        rows = agg.merge_slo(agg.scrape())
+        assert rows["slo/areal_slo_ttft_seconds/rollout/count"] == 10.0
+        assert (
+            "slo/server/gen_server_9/areal_slo_ttft_seconds/rollout/p99"
+            in rows
+        )
+    finally:
+        srv.stop()
+
+
+def test_truncated_slo_page_never_poisons_the_merge(three_live_workers):
+    """A worker whose page is cut off mid-bucket fails the strict parse
+    and is skip-and-counted; the healthy workers' digests still merge.
+    (A digest rebuilt from HALF a bucket list would silently skew fleet
+    percentiles — rejection must happen at the parse.)"""
+    import http.server
+    import threading
+
+    from areal_tpu.observability.latency import SLO_BUCKETS
+
+    good = MetricsServer(registry=_slo_page_registry([0.2] * 5)).start()
+    good.register(EXPR, TRIAL, "gen_server_ok")
+
+    # render a real page, truncate it mid-bucket-line
+    full = _slo_page_registry([0.2] * 5).render()
+    cut = full[: full.index('le="' + repr(float(SLO_BUCKETS[40])))]
+
+    class Truncated(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(cut.encode())
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Truncated)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        from areal_tpu.base import names
+
+        name_resolve.add(
+            names.metric_server(EXPR, TRIAL, "gen_server", "gen_server_cut"),
+            f"127.0.0.1:{httpd.server_address[1]}",
+            replace=True,
+        )
+        agg = ClusterMetricsAggregator(EXPR, TRIAL, scrape_timeout=2.0)
+        scraped = agg.scrape()
+        assert "gen_server_cut" not in scraped  # strict parse rejected
+        rows = agg.merge_slo(scraped)
+        # the healthy worker's 5 samples are the whole fleet
+        assert rows["slo/areal_slo_ttft_seconds/rollout/count"] == 5.0
+        errs = agg._registry.counter("areal_aggregator_scrape_errors_total")
+        assert errs.value(endpoint="gen_server_cut") == 1.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        good.stop()
+
+
+def test_foreign_slo_named_histogram_is_skipped_not_merged(
+    three_live_workers,
+):
+    """An areal_slo_* family over the WRONG buckets (a stale worker from
+    a future/past bucket scheme) parses fine but must not merge — the
+    digest rebuild rejects the boundary mismatch and the family is
+    skipped for that worker."""
+    reg = MetricsRegistry()
+    reg.histogram(
+        "areal_slo_ttft_seconds", buckets=(0.1, 1.0, 10.0)
+    ).observe(0.5, workload="rollout")
+    srv = MetricsServer(registry=reg).start()
+    srv.register(EXPR, TRIAL, "gen_server_alien")
+    try:
+        agg = ClusterMetricsAggregator(EXPR, TRIAL)
+        scraped = agg.scrape()
+        assert "gen_server_alien" in scraped  # page itself is valid prom
+        assert agg.merge_slo(scraped) == {}  # but never merges
+    finally:
+        srv.stop()
+
+
 def test_dead_endpoint_counted_not_fatal(three_live_workers):
     # kill one worker but leave its name-resolve registration behind
     three_live_workers[0]._registered_key = None  # keep the stale key

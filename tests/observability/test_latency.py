@@ -1,0 +1,287 @@
+"""SLO latency plane: digest error bound, exact cross-worker merging,
+prom-page round trip (the scrape transport), LatencyRecord completeness,
+and the watchdog's percentile alarm (observability/latency.py)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from areal_tpu.observability import prom_text
+from areal_tpu.observability.latency import (
+    FLEET_TTFT_P99_KEY,
+    SLO_BUCKETS,
+    SLO_FAMILIES,
+    SLO_N_BUCKETS,
+    SLO_REL_ERROR_BOUND,
+    LatencyDigest,
+    LatencyRecord,
+    digest_from_bucket_samples,
+    digests_from_families,
+    fleet_slo_rows,
+)
+from areal_tpu.observability.registry import MetricsRegistry
+
+
+def _inverted_cdf(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, max(0, math.ceil(q * len(xs)) - 1))]
+
+
+def _digest_of(xs):
+    d = LatencyDigest()
+    for x in xs:
+        d.observe(float(x))
+    return d
+
+
+# -- digest: error bound ------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_quantiles_within_documented_error_bound(seed):
+    """p50/p95/p99 of a lognormal stream (spanning ~ms to ~minutes, the
+    realistic latency regime) sit within SLO_REL_ERROR_BOUND of the
+    empirical inverted-CDF quantiles — the documented contract."""
+    rng = np.random.default_rng(seed)
+    xs = np.exp(rng.normal(-2.0, 2.0, 5000))
+    d = _digest_of(xs)
+    for q in (0.50, 0.95, 0.99):
+        emp = _inverted_cdf(xs, q)
+        got = d.quantile(q)
+        assert abs(got - emp) / emp <= SLO_REL_ERROR_BOUND, (q, got, emp)
+
+
+def test_single_sample_and_empty_edge_cases():
+    empty = LatencyDigest()
+    assert empty.quantile(0.5) is None
+    assert empty.percentiles()["p99"] is None
+    assert empty.percentiles()["count"] == 0
+
+    one = _digest_of([0.0421])
+    p = one.percentiles()
+    assert p["count"] == 1
+    # a single sample IS every percentile, within the bucket bound
+    for k in ("p50", "p95", "p99"):
+        assert abs(p[k] - 0.0421) / 0.0421 <= SLO_REL_ERROR_BOUND
+
+
+def test_out_of_range_values_clamp_to_edge_buckets():
+    lo = _digest_of([0.0, 1e-9])
+    assert lo.quantile(0.99) <= SLO_BUCKETS[0]
+    hi = _digest_of([1e9])
+    assert hi.quantile(0.5) == SLO_BUCKETS[-1]
+
+
+# -- digest: exact merge ------------------------------------------------------
+
+
+def test_merge_is_exactly_the_pooled_stream():
+    """merge(A, B) must be BIT-IDENTICAL to streaming both series into
+    one digest (fixed shared boundaries) — so fleet percentiles equal
+    single-stream percentiles, not just approximate them."""
+    rng = np.random.default_rng(3)
+    a = np.exp(rng.normal(-3, 1.0, 1500))
+    b = np.exp(rng.normal(-1, 1.5, 700))
+    merged = _digest_of(a).merge(_digest_of(b))
+    pooled = _digest_of(np.concatenate([a, b]))
+    assert merged.counts == pooled.counts
+    assert merged.count == pooled.count
+    assert merged.sum == pytest.approx(pooled.sum)
+    for q in (0.5, 0.95, 0.99):
+        assert merged.quantile(q) == pooled.quantile(q)
+        emp = _inverted_cdf(np.concatenate([a, b]), q)
+        assert abs(merged.quantile(q) - emp) / emp <= SLO_REL_ERROR_BOUND
+
+
+def test_merge_with_empty_and_dict_round_trip():
+    d = _digest_of([0.01, 0.02, 0.5])
+    before = list(d.counts)
+    d.merge(LatencyDigest())  # empty merge is the identity
+    assert d.counts == before
+    rt = LatencyDigest.from_dict(d.to_dict())
+    assert rt.counts == d.counts and rt.count == d.count
+    with pytest.raises(ValueError):
+        LatencyDigest.from_dict({"counts": [0, 1], "count": 1, "sum": 1.0})
+
+
+# -- prom-page transport (the cross-worker path) ------------------------------
+
+
+def test_digest_round_trips_through_a_scraped_metrics_page():
+    """The full transport: registry histogram (SLO buckets) -> rendered
+    prom text -> strict parse -> digest_from_bucket_samples == the
+    digest built directly from the raw values.  This is what makes the
+    aggregator's fleet merge exact."""
+    rng = np.random.default_rng(11)
+    xs = np.exp(rng.normal(-2, 1.0, 400))
+    reg = MetricsRegistry()
+    hist = reg.histogram("areal_slo_ttft_seconds", buckets=SLO_BUCKETS)
+    for x in xs:
+        hist.observe(float(x), workload="rollout")
+    fams = prom_text.parse(reg.render())
+    digs = digests_from_families(fams)
+    got = digs[("areal_slo_ttft_seconds", "rollout")]
+    want = _digest_of(xs)
+    assert got.counts == want.counts
+    assert got.count == want.count
+    assert got.sum == pytest.approx(want.sum, rel=1e-9)
+
+
+def test_foreign_bucket_scheme_is_rejected():
+    with pytest.raises(ValueError):
+        digest_from_bucket_samples(
+            [(0.1, 1.0), (1.0, 2.0), (math.inf, 2.0)]
+        )
+    # right count, wrong boundaries
+    wrong = [(b * 1.5, float(i)) for i, b in enumerate(SLO_BUCKETS)]
+    wrong.append((math.inf, float(SLO_N_BUCKETS)))
+    with pytest.raises(ValueError):
+        digest_from_bucket_samples(wrong)
+
+
+def test_fleet_rows_merge_two_workers_exactly():
+    """fleet_slo_rows over two synthetic worker pages: the fleet p99
+    equals the pooled digest's, and per-server p99 rows attribute the
+    slow server."""
+    fast = np.full(300, 0.05)
+    slow = np.full(100, 3.0)
+
+    def page(xs):
+        reg = MetricsRegistry()
+        h = reg.histogram("areal_slo_ttft_seconds", buckets=SLO_BUCKETS)
+        for x in xs:
+            h.observe(float(x), workload="rollout")
+        return prom_text.parse(reg.render())
+
+    scraped = {"gen_server_0": page(fast), "gen_server_1": page(slow)}
+    rows = fleet_slo_rows(scraped)
+    pooled = _digest_of(np.concatenate([fast, slow]))
+    assert rows[
+        "slo/areal_slo_ttft_seconds/rollout/p99"
+    ] == pooled.quantile(0.99)
+    assert rows[FLEET_TTFT_P99_KEY] == pooled.quantile(0.99)
+    assert rows["slo/areal_slo_ttft_seconds/rollout/count"] == 400.0
+    # the slow server is attributable from the per-server rows
+    s0 = rows["slo/server/gen_server_0/areal_slo_ttft_seconds/rollout/p99"]
+    s1 = rows["slo/server/gen_server_1/areal_slo_ttft_seconds/rollout/p99"]
+    assert s1 > 10 * s0
+
+
+# -- LatencyRecord ------------------------------------------------------------
+
+
+def test_latency_record_completeness_gate():
+    rec = LatencyRecord(
+        qid="r0-0", server="gs0", mesh_devices=2,
+        schedule_wait_s=0.001, admission_wait_s=0.002, ttft_s=0.05,
+        tpot_s=0.01, stall_s=0.0, tokens=8,
+    )
+    assert rec.complete()
+    assert rec.as_dict()["ttft_s"] == 0.05
+    # each missing stage breaks completeness
+    import dataclasses
+
+    for field, bad in (
+        ("schedule_wait_s", None),
+        ("tpot_s", None),
+        ("ttft_s", 0.0),
+        ("server", ""),
+        ("tokens", 1),
+    ):
+        assert not dataclasses.replace(rec, **{field: bad}).complete(), field
+
+
+# -- SLO vocabulary lint helper ----------------------------------------------
+
+
+def test_slo_vocabulary_lint_matches_and_catches_mismatches():
+    import os
+    import sys
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        from check_metric_names import slo_vocabulary_problems
+    finally:
+        sys.path.pop(0)
+    from areal_tpu.observability.table import METRIC_TABLE, MetricSpec
+
+    # the live vocabulary is coherent
+    assert slo_vocabulary_problems(SLO_FAMILIES, METRIC_TABLE) == []
+    # a family missing from the table is caught
+    fams = dict(SLO_FAMILIES)
+    fams["areal_slo_made_up_seconds"] = "made_up_s"
+    assert any(
+        "areal_slo_made_up_seconds" in p
+        for p in slo_vocabulary_problems(fams, METRIC_TABLE)
+    )
+    # a table entry with the prefix but outside the plane is caught, and
+    # so is a family declared with the wrong shape
+    bad_table = list(METRIC_TABLE) + [
+        MetricSpec("areal_slo_rogue_seconds", "histogram", "x", ("workload",))
+    ]
+    assert any(
+        "rogue" in p
+        for p in slo_vocabulary_problems(SLO_FAMILIES, bad_table)
+    )
+    wrong_shape = [
+        MetricSpec(spec.name, "counter", "x", ())
+        if spec.name == "areal_slo_ttft_seconds"
+        else spec
+        for spec in METRIC_TABLE
+    ]
+    msgs = slo_vocabulary_problems(SLO_FAMILIES, wrong_shape)
+    assert any("histogram" in p for p in msgs)
+    assert any("workload" in p for p in msgs)
+
+
+# -- watchdog percentile alarm -----------------------------------------------
+
+
+def test_watchdog_slo_alarm_fires_once_after_n_breaches_and_rearms():
+    from areal_tpu.observability.registry import MetricsRegistry
+    from areal_tpu.observability.trace_collector import StallWatchdog
+    from areal_tpu.observability.tracing import TraceConfig
+
+    reg = MetricsRegistry()
+    wd = StallWatchdog(
+        TraceConfig(slo_ttft_p99_s=1.0, slo_breach_scrapes=3),
+        registry=reg,
+    )
+    stalls = reg.counter("areal_trace_stall_total")
+    # two breaches: armed but silent
+    assert not wd.check_slo(5.0)
+    assert not wd.check_slo(5.0)
+    assert stalls.value(kind="slo") == 0.0
+    # third consecutive breach fires ONCE
+    assert wd.check_slo(5.0)
+    assert not wd.check_slo(5.0)  # same episode: no re-fire
+    assert stalls.value(kind="slo") == 1.0
+    # recovery re-arms; a fresh episode fires again
+    assert not wd.check_slo(0.2)
+    for _ in range(2):
+        assert not wd.check_slo(9.0)
+    assert wd.check_slo(9.0)
+    assert stalls.value(kind="slo") == 2.0
+
+
+def test_watchdog_slo_alarm_disabled_and_missing_observations():
+    from areal_tpu.observability.registry import MetricsRegistry
+    from areal_tpu.observability.trace_collector import StallWatchdog
+    from areal_tpu.observability.tracing import TraceConfig
+
+    reg = MetricsRegistry()
+    off = StallWatchdog(TraceConfig(), registry=reg)  # no threshold
+    assert not off.check_slo(100.0)
+    wd = StallWatchdog(
+        TraceConfig(slo_ttft_p99_s=1.0, slo_breach_scrapes=2),
+        registry=reg,
+    )
+    assert not wd.check_slo(5.0)
+    # a scrape with no digests yet neither breaches NOR resets
+    assert not wd.check_slo(None)
+    assert wd.check_slo(5.0)  # second real breach fires
+    assert reg.counter("areal_trace_stall_total").value(kind="slo") == 1.0

@@ -2,6 +2,8 @@
 parser, name-resolve registration under the ``names.metric_server`` keys,
 and the WorkerServer substrate wiring (every worker type gets one)."""
 
+import json
+import time
 import urllib.request
 
 import pytest
@@ -53,7 +55,8 @@ def test_scrape_parses_with_strict_parser_and_registers():
         assert fams["areal_rollout_episodes_total"].series() == 5.0
 
         with _scrape(srv.port, "/healthz") as resp:
-            assert resp.read() == b"ok"
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
         with pytest.raises(urllib.error.HTTPError):
             _scrape(srv.port, "/nope")
     finally:
@@ -61,6 +64,51 @@ def test_scrape_parses_with_strict_parser_and_registers():
     # stop() deregisters the endpoint
     with pytest.raises(name_resolve.NameEntryNotFoundError):
         name_resolve.get(key)
+
+
+def test_healthz_reports_identity_uptime_and_activity():
+    """The /healthz probe (lease/liveness for ROADMAP item 4, dead-
+    endpoint triage today): worker id, uptime, and a last-activity
+    stamp the poll loop refreshes — 'HTTP up but wedged' is visible as
+    a growing last_activity_age_s."""
+    srv = MetricsServer(registry=MetricsRegistry()).start()
+    try:
+        srv.worker_name = "gen_server_0"
+        t0 = time.time()
+        with _scrape(srv.port, "/healthz") as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            h = json.loads(resp.read())
+        assert h["status"] == "ok"
+        assert h["worker"] == "gen_server_0"
+        assert h["uptime_s"] >= 0.0
+        assert abs(h["last_activity_ts"] - t0) < 5.0
+        assert h["last_activity_age_s"] >= 0.0
+        # a productive poll refreshes the stamp
+        srv.last_activity_ts = time.time() - 120.0
+        srv.note_activity()
+        with _scrape(srv.port, "/healthz") as resp:
+            h2 = json.loads(resp.read())
+        assert h2["last_activity_age_s"] < 60.0
+    finally:
+        srv.stop()
+
+
+def test_worker_server_healthz_carries_worker_identity():
+    from areal_tpu.system.worker_base import WorkerServer
+
+    ws = WorkerServer("rollout_worker_3", EXPR, TRIAL)
+    try:
+        port = ws.metrics_server.port
+        with _scrape(port, "/healthz") as resp:
+            h = json.loads(resp.read())
+        assert h["worker"] == "rollout_worker_3"
+        old = h["last_activity_ts"]
+        ws.note_activity()  # what Worker.run does on productive polls
+        with _scrape(port, "/healthz") as resp:
+            h2 = json.loads(resp.read())
+        assert h2["last_activity_ts"] >= old
+    finally:
+        ws.close()
 
 
 def test_every_worker_type_serves_metrics_via_worker_server():

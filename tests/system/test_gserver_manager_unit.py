@@ -121,6 +121,34 @@ def test_staleness_gate_units():
     assert m._allocate_rollout("c")["ok"]
 
 
+def test_gate_wait_digest_measures_reject_to_admit(monkeypatch):
+    """The SLO plane's schedule-wait digest: a rollout admitted on its
+    first try observes ~0; one that sat rejected observes first-reject
+    -> ok on the manager's clock; an abandoned rollout's stamp is swept
+    by finish (no leak, no pollution of a later same-qid rollout)."""
+    import time as _time
+
+    m = _manager(max_concurrent_rollouts=1, group_size=1,
+                 train_batch_size=100)
+    clock = [1000.0]
+    monkeypatch.setattr(_time, "monotonic", lambda: clock[0])
+    assert m._allocate_rollout("a")["ok"]  # immediate: observes 0
+    assert not m._allocate_rollout("b")["ok"]  # first reject stamps
+    clock[0] += 7.5
+    assert not m._allocate_rollout("b")["ok"]  # later rejects don't
+    clock[0] += 7.5
+    m._finish_rollout("a", accepted=True)
+    assert m._allocate_rollout("b")["ok"]  # waited 15s at the gate
+    total, count = m._m_slo_sched.snapshot(workload="rollout")
+    assert count == 2  # one per ADMITTED rollout, none per reject
+    assert total == pytest.approx(15.0)
+    assert "b" not in m._gate_first_reject  # stamp consumed
+    # abandoned rollout: stamp swept by finish, not leaked
+    assert not m._allocate_rollout("c")["ok"]
+    m._finish_rollout("c", accepted=False)
+    assert "c" not in m._gate_first_reject
+
+
 def test_capacity_gate():
     m = _manager(max_concurrent_rollouts=1, group_size=1, train_batch_size=100)
     assert m._allocate_rollout("a")["ok"]

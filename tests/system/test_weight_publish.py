@@ -39,6 +39,9 @@ def test_publish_to_generation_server_hot_swap(trial):
     model_abs = ModelAbstraction(
         "random", {"vocab_size": 64, "max_position_embeddings": 64}
     )
+    from areal_tpu.observability import tracing
+
+    trace_seq0 = tracing.get_tracer().snapshot(0)["seq"]
 
     server = GenerationServerWorker()
     st = threading.Thread(
@@ -113,6 +116,16 @@ def test_publish_to_generation_server_hot_swap(trial):
         assert stats["swaps_staged_total"] == 1, stats
         assert stats["swaps_total"] == 1, stats
         assert stats["stage_s"] > 0.0
+        # the staged sync left BOTH flight-recorder spans (force-sampled
+        # on the synthetic swap-v3 root): the restore-while-decoding
+        # window and the pointer-flip apply window
+        spans = {
+            (e["name"], e["ph"])
+            for e in tracing.get_tracer().snapshot(trace_seq0)["events"]
+            if e["root"] == "swap-v3"
+        }
+        assert ("swap.stage", "X") in spans, spans
+        assert ("swap.commit", "X") in spans, spans
     finally:
         manager.exit()
         server.exit()
