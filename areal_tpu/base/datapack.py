@@ -9,7 +9,7 @@ preserving for reproducibility) or bounded by a max token budget.
 
 from __future__ import annotations
 
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -116,10 +116,22 @@ def ffd_allocate(
     return [g for g in groups if g]
 
 
-def bin_pack_ffd(nums: Sequence[int], capacity: int) -> List[List[int]]:
+def bin_pack_ffd(
+    nums: Sequence[int], capacity: int, use_native: Optional[bool] = None
+) -> List[List[int]]:
     """First-fit-decreasing bin packing (non-contiguous), for packing variable
-    length sequences into fixed token-capacity batches."""
-    if len(nums) >= 64:
+    length sequences into fixed token-capacity batches (this is the bin
+    step of the train path's segment packing, ``batching.pack_batch``).
+
+    ``use_native``: None = auto (native C path for n >= 64, parity-tested
+    against the python loop); True forces native (returns via fallback if
+    the toolchain is unavailable); False forces the pure-python path.
+    Both paths are deterministic and produce IDENTICAL bins: the
+    decreasing order is a reversed stable ascending sort (so ties break
+    by DESCENDING original index), and first-fit scans bins in creation
+    order."""
+    native = use_native if use_native is not None else len(nums) >= 64
+    if native:
         from areal_tpu.base import _native
 
         packed = _native.ffd_pack(nums, capacity)

@@ -112,6 +112,12 @@ class TrainBackend(model_api.ModelBackend):
     optimizer: OptimizerConfig = dataclasses.field(
         default_factory=OptimizerConfig
     )
+    #: FFD segment packing of train/forward micro-batches (multi-segment
+    #: rows; see docs/parallelism.md "Training batch layout").  On by
+    #: default; pack_capacity raises the per-row token budget above the
+    #: longest sequence's bucket (0 = that bucket).
+    pack_sequences: bool = True
+    pack_capacity: int = 0
 
     def _initialize(self, model, spec):
         model.engine = TrainEngine(
@@ -121,6 +127,8 @@ class TrainBackend(model_api.ModelBackend):
             optimizer_cfg=self.optimizer,
             total_train_steps=max(1, spec.total_train_steps),
             name=str(model.name) if model.name else "",
+            pack_sequences=self.pack_sequences,
+            pack_capacity=self.pack_capacity,
         )
         model.init_params = None
         return model
@@ -140,6 +148,9 @@ class TrainBackend(model_api.ModelBackend):
 class InferenceBackend(model_api.ModelBackend):
     """Engine without optimizer state (reference: inference.py:230)."""
 
+    pack_sequences: bool = True
+    pack_capacity: int = 0
+
     def _initialize(self, model, spec):
         model.engine = TrainEngine(
             model.model_cfg,
@@ -147,6 +158,8 @@ class InferenceBackend(model_api.ModelBackend):
             model.init_params,
             optimizer_cfg=None,
             name=str(model.name) if model.name else "",
+            pack_sequences=self.pack_sequences,
+            pack_capacity=self.pack_capacity,
         )
         model.init_params = None
         return model

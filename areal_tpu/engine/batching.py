@@ -1,25 +1,41 @@
-"""Packed SequenceSample <-> padded-device-batch conversion.
+"""Packed SequenceSample <-> device-batch conversion.
 
 The data plane moves packed varlen numpy (areal_tpu/api/data.py); XLA wants
-static shapes.  This module is the boundary: sequences become rows of a
-``[B, T]`` batch with bucketed T (limiting recompilation) and B padded to a
-multiple of the mesh's dp shard count.  Per-token outputs convert back to
-packed arrays for the SequenceSample result.
+static shapes.  This module is the boundary, with two layouts:
 
-(The reference keeps 1-D packing all the way into flash-attn varlen kernels,
-realhf/api/core/data_api.py + realhf/impl/model/utils/padding.py; on TPU the
-padded layout with segment ids is the idiomatic equivalent, and token-budget
-micro-batching upstream keeps the padding waste bounded.)
+* :func:`pad_batch` — one sequence per row of a ``[B, T]`` batch with
+  bucketed T (limiting recompilation) and B padded to a multiple of the
+  mesh's dp shard count.
+* :func:`pack_batch` — MULTIPLE sequences per row: FFD bin packing
+  (base/datapack.py, native fast path) lays segments side by side under a
+  token-budget capacity, so a long-tail length distribution no longer pads
+  every row to the global max.  Per-row ``seg_ids`` are numbered 1..k and
+  ``positions`` restart at 0 per segment, so the transformer's
+  same-segment-causal mask and RoPE are correct by construction.
+
+Both produce the same :class:`PaddedBatch` dataclass, and both carry a
+**segment table** (``seg_rows``/``seg_starts``/``seg_lens``, flat ``[S]``
+arrays in ORIGINAL sequence order) so jitted code can gather per-segment
+quantities (last-token values, pair signs) without assuming
+one-sequence-per-row.  :func:`unpack_per_token` is the inverse, restoring
+the packed-1D order of per-token outputs.
+
+(The reference keeps 1-D packing all the way into flash-attn varlen
+kernels, realhf/api/core/data_api.py + realhf/impl/model/utils/padding.py;
+on TPU the segment-packed padded layout is the idiomatic equivalent — the
+Pallas flash kernel, the reference attention mask, and the MoE stat
+masking all consume ``seg_ids`` natively.)
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from areal_tpu.api.data import SequenceSample
+from areal_tpu.api.data import _SCALAR_KEYS, SequenceSample
+from areal_tpu.base import datapack
 
 DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
 
@@ -47,16 +63,29 @@ def pad_rows(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
 @dataclasses.dataclass
 class PaddedBatch:
-    """Device-ready arrays; one sequence per row.
+    """Device-ready arrays; one OR MORE sequences (segments) per row.
 
-    ``tokens``/``positions``/``seg_ids``: [B, T]; ``seq_lens``: [B] (0 for
-    padding rows).  ``extras`` holds per-key aligned arrays:
-      - full-length keys -> [B, T]
-      - transition keys (len L-1) -> [B, T] with entry t = transition t->t+1
-        (the T-1'th column is always 0)
-      - scalar keys -> [B]
+    ``tokens``/``positions``/``seg_ids``: [B, T]; ``seq_lens``: [B] (real
+    tokens per row, 0 for padding rows).  ``extras`` holds per-key aligned
+    arrays:
+      - full-length keys -> [B, T] at each segment's columns
+      - transition keys (len L-1) -> [B, T] with entry t = transition
+        t->t+1 (each segment's LAST column is always 0)
+      - scalar keys -> [n_real] (padded-mode, one segment per row) or
+        [S] segment-aligned (packed mode)
+
+    The segment table maps original sequence order to the layout:
+    segment ``s`` (the s-th flattened sequence of the sample) occupies
+    ``tokens[seg_rows[s], seg_starts[s] : seg_starts[s] + seg_lens[s]]``.
+    Arrays are sized [S] (``n_segs`` real entries, zero-padded) so jitted
+    consumers see a static shape; padding entries have ``seg_lens == 0``
+    and must be masked (they alias row 0 / column 0).
     """
 
     tokens: np.ndarray
@@ -65,10 +94,123 @@ class PaddedBatch:
     seq_lens: np.ndarray
     extras: Dict[str, np.ndarray]
     n_real: int  # number of real rows
+    seg_rows: np.ndarray  # [S] int32
+    seg_starts: np.ndarray  # [S] int32
+    seg_lens: np.ndarray  # [S] int32 (0 = padding segment)
+    n_segs: int  # number of real segments
 
     @property
     def shape(self):
         return self.tokens.shape
+
+    @property
+    def padded_slots(self) -> int:
+        """Total [B, T] slots this batch occupies on device."""
+        return int(self.tokens.size)
+
+
+def _extra_layout(key: str, lens: List[int], tok_lens: List[int]) -> str:
+    """Classify an extra key as ``full`` / ``transition`` / ``scalar`` by
+    comparing its per-sequence lengths to the token key's.
+
+    The registry of known scalar keys wins first: ``rewards`` et al. stay
+    scalars even in a degenerate batch of length-1 sequences.  For unknown
+    keys, FULL-length wins over scalar when every sequence has length 1 —
+    the old ``all(l == 1)`` heuristic silently laid a genuine per-token
+    key out as [B] whenever the batch happened to be all length-1.
+    """
+    if key in _SCALAR_KEYS:
+        if not all(l == 1 for l in lens):
+            raise ValueError(
+                f"scalar key {key!r} has non-unit lengths {lens[:8]}"
+            )
+        return "scalar"
+    if lens == tok_lens:
+        return "full"
+    if lens == [l - 1 for l in tok_lens]:
+        return "transition"
+    if all(l == 1 for l in lens):
+        return "scalar"
+    raise ValueError(
+        f"key {key!r} lengths match neither the token key ({tok_lens[:4]}...)"
+        f", its transitions, nor a scalar layout: {lens[:4]}..."
+    )
+
+
+def _layout_batch(
+    sample: SequenceSample,
+    token_key: str,
+    seqlens: List[int],
+    placement: List[Tuple[int, int]],  # per-seq (row, start col)
+    B: int,
+    T: int,
+    S: int,
+    scalar_per_segment: bool,
+) -> PaddedBatch:
+    """Shared layout engine for pad_batch/pack_batch: place sequence ``s``
+    at ``placement[s]``, build the segment table, and align extras."""
+    n = len(seqlens)
+    tokens = np.zeros((B, T), np.int32)
+    positions = np.zeros((B, T), np.int32)
+    seg_ids = np.zeros((B, T), np.int32)
+    seq_lens = np.zeros((B,), np.int32)
+    seg_rows = np.zeros((S,), np.int32)
+    seg_starts = np.zeros((S,), np.int32)
+    seg_lens = np.zeros((S,), np.int32)
+
+    offsets = np.concatenate([[0], np.cumsum(seqlens)])
+    data = sample.data[token_key]
+    next_seg = np.zeros((B,), np.int32)  # per-row running segment number
+    for s, L in enumerate(seqlens):
+        r, c = placement[s]
+        tokens[r, c : c + L] = data[offsets[s] : offsets[s + 1]]
+        positions[r, c : c + L] = np.arange(L)
+        next_seg[r] += 1
+        seg_ids[r, c : c + L] = next_seg[r]
+        seq_lens[r] += L
+        seg_rows[s], seg_starts[s], seg_lens[s] = r, c, L
+
+    extras: Dict[str, np.ndarray] = {}
+    for key in sample.keys:
+        if key == token_key or sample.data.get(key) is None:
+            continue
+        lens = [l for ls in sample.seqlens[key] for l in ls]
+        if len(lens) != len(seqlens):
+            # a key not aligned per member sequence (e.g. one scalar per
+            # GROUP id alongside multi-sequence groups) would land on the
+            # wrong segments after flattening — refuse rather than guess
+            raise ValueError(
+                f"key {key!r} has {len(lens)} sequences but {token_key!r} "
+                f"has {len(seqlens)}; per-group keys cannot align with "
+                "multi-sequence ids"
+            )
+        arr = sample.data[key]
+        offs = np.concatenate([[0], np.cumsum(lens)])
+        layout = _extra_layout(key, lens, seqlens)
+        if layout == "scalar":
+            out = np.zeros((S if scalar_per_segment else B,), arr.dtype)
+            out[:n] = arr[:n]
+        else:
+            out = np.zeros((B, T), arr.dtype)
+            for s in range(n):
+                r, c = placement[s]
+                Lk = lens[s]  # == seqlens[s], or seqlens[s]-1 (transition):
+                # a transition key fills only its L-1 columns, so each
+                # segment's last column stays 0 by construction
+                out[r, c : c + Lk] = arr[offs[s] : offs[s + 1]]
+        extras[key] = out
+    return PaddedBatch(
+        tokens=tokens,
+        positions=positions,
+        seg_ids=seg_ids,
+        seq_lens=seq_lens,
+        extras=extras,
+        n_real=int(max((r for r, _ in placement), default=-1)) + 1,
+        seg_rows=seg_rows,
+        seg_starts=seg_starts,
+        seg_lens=seg_lens,
+        n_segs=n,
+    )
 
 
 def pad_batch(
@@ -84,6 +226,8 @@ def pad_batch(
 
     ``fixed_rows``/``fixed_len`` force the output shape (so several
     micro-batches can share one compiled step / be stacked for a scan).
+    The segment table is the trivial one (segment s = row s, start 0),
+    sized [B] so per-segment gathers line up with per-row [B] arrays.
 
     Ids holding SEQUENCE GROUPS (e.g. the paired preference dataset packs
     [chosen, rejected, ...] under one id) flatten to one row per member
@@ -97,51 +241,68 @@ def pad_batch(
     if fixed_len:
         assert max(seqlens) <= fixed_len
         T = fixed_len
+    placement = [(i, 0) for i in range(len(seqlens))]
+    return _layout_batch(
+        sample, token_key, seqlens, placement, B, T, S=B,
+        scalar_per_segment=False,
+    )
 
-    tokens = np.zeros((B, T), np.int32)
-    positions = np.zeros((B, T), np.int32)
-    seg_ids = np.zeros((B, T), np.int32)
-    seq_lens = np.zeros((B,), np.int32)
 
-    offsets = np.concatenate([[0], np.cumsum(seqlens)])
-    data = sample.data[token_key]
-    for i, L in enumerate(seqlens):
-        tokens[i, :L] = data[offsets[i] : offsets[i + 1]]
-        positions[i, :L] = np.arange(L)
-        seg_ids[i, :L] = 1
-        seq_lens[i] = L
+def pack_batch(
+    sample: SequenceSample,
+    token_key: str = "packed_input_ids",
+    capacity: int = 0,
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+    row_multiple: int = 1,
+    min_rows: int = 1,
+    fixed_rows: int = 0,
+    fixed_len: int = 0,
+    fixed_segs: int = 0,
+    bins: Optional[List[List[int]]] = None,
+) -> PaddedBatch:
+    """FFD-bin sequences into multi-segment rows under a token budget.
 
-    extras: Dict[str, np.ndarray] = {}
-    for key in sample.keys:
-        if key == token_key or sample.data.get(key) is None:
-            continue
-        lens = [l for ls in sample.seqlens[key] for l in ls]
-        if len(lens) != len(seqlens):
-            # a key not aligned per member sequence (e.g. one scalar per
-            # GROUP id alongside multi-sequence groups) would land on the
-            # wrong rows after flattening — refuse rather than guess
-            raise ValueError(
-                f"key {key!r} has {len(lens)} sequences but {token_key!r} "
-                f"has {len(seqlens)}; per-group keys cannot align with "
-                "multi-sequence ids"
-            )
-        arr = sample.data[key]
-        offs = np.concatenate([[0], np.cumsum(lens)])
-        if all(l == 1 for l in lens):  # scalar per sequence
-            out = np.zeros((B,), arr.dtype)
-            out[: len(lens)] = arr[: len(lens)]
-        else:
-            out = np.zeros((B, T), arr.dtype)
-            for i, L in enumerate(lens):
-                out[i, :L] = arr[offs[i] : offs[i + 1]]
-        extras[key] = out
-    return PaddedBatch(
-        tokens=tokens,
-        positions=positions,
-        seg_ids=seg_ids,
-        seq_lens=seq_lens,
-        extras=extras,
-        n_real=len(seqlens),
+    Row width T is ``bucket_len(max(capacity, longest sequence))`` (or
+    ``fixed_len``); :func:`datapack.bin_pack_ffd` (native fast path) packs
+    sequences into rows of at most T tokens, so the padded-slot count
+    tracks the TOTAL token count instead of ``n_seqs x max_len``.  Within
+    a row, segments are laid out in ascending original-sequence order
+    with ``seg_ids`` 1..k and per-segment positions — attention masking
+    and RoPE need no layout-specific handling downstream.
+
+    ``fixed_segs`` forces the segment-table capacity S (default: the
+    next power of two of the sequence count, bounding compile variety).
+    ``bins`` passes precomputed ``bin_pack_ffd(seqlens, T)`` groups so a
+    caller that already binned (the engine sizes rows across micro-batches
+    first) does not pay the FFD pass twice.
+    """
+    seqlens = [l for ls in sample.seqlens[token_key] for l in ls]
+    max_len = max(seqlens)
+    T = fixed_len or bucket_len(max(capacity, max_len), buckets)
+    assert max_len <= T, (max_len, T)
+    if bins is None:
+        bins = datapack.bin_pack_ffd(seqlens, T)
+    # deterministic layout: rows ordered by their smallest member index,
+    # members within a row in ascending original order
+    bins = sorted((sorted(b) for b in bins), key=lambda b: b[0])
+    n_rows = len(bins)
+    B = max(pad_rows(max(n_rows, min_rows), row_multiple), min_rows)
+    if fixed_rows:
+        assert n_rows <= fixed_rows, (n_rows, fixed_rows)
+        B = fixed_rows
+    S = fixed_segs or next_pow2(len(seqlens))
+    assert len(seqlens) <= S, (len(seqlens), S)
+
+    placement: List[Optional[Tuple[int, int]]] = [None] * len(seqlens)
+    for r, members in enumerate(bins):
+        col = 0
+        for s in members:
+            placement[s] = (r, col)
+            col += seqlens[s]
+        assert col <= T
+    return _layout_batch(
+        sample, token_key, seqlens, placement, B, T, S=S,
+        scalar_per_segment=True,
     )
 
 
@@ -151,9 +312,26 @@ def unpad_per_token(
     n_real: int,
     shift: int = 0,  # 1 for transition-aligned outputs (length L-1)
 ) -> np.ndarray:
-    """Back to packed 1-D concat over real rows."""
+    """Back to packed 1-D concat over real rows (one-sequence-per-row
+    layout only; for packed batches use :func:`unpack_per_token`)."""
     parts: List[np.ndarray] = []
     for i in range(n_real):
         L = int(seq_lens[i]) - shift
         parts.append(out[i, :L])
+    return np.concatenate(parts, axis=0)
+
+
+def unpack_per_token(
+    out: np.ndarray,  # [B, T] per-token outputs
+    pb: PaddedBatch,
+    shift: int = 0,  # 1 for transition-aligned outputs (length L-1)
+) -> np.ndarray:
+    """Segment-table inverse of pad_batch/pack_batch: gather per-token
+    outputs back into the packed 1-D concat in ORIGINAL sequence order."""
+    parts: List[np.ndarray] = []
+    for s in range(pb.n_segs):
+        r = int(pb.seg_rows[s])
+        c = int(pb.seg_starts[s])
+        L = int(pb.seg_lens[s]) - shift
+        parts.append(out[r, c : c + L])
     return np.concatenate(parts, axis=0)

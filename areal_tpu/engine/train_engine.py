@@ -32,7 +32,7 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
-from areal_tpu.base import logging_
+from areal_tpu.base import datapack, logging_
 from areal_tpu.engine import batching
 from areal_tpu.engine.optimizer import OptimizerConfig, make_optimizer
 from areal_tpu.models.config import TransformerConfig
@@ -67,10 +67,19 @@ class TrainEngine:
         optimizer_cfg: Optional[OptimizerConfig] = None,
         total_train_steps: int = 1,
         name: str = "",
+        pack_sequences: bool = True,
+        pack_capacity: int = 0,
     ):
         self.model_cfg = model_cfg
         self.mesh = mesh
         self.optimizer_cfg = optimizer_cfg
+        # sequence packing (FFD segment packing, batching.pack_batch): rows
+        # hold multiple segments, so micro-batch [B, T] slots track the real
+        # token count instead of n_seqs x bucket(max_len).  pack_capacity
+        # raises the row token budget above the longest sequence's bucket
+        # (0 = bucket of the longest sequence in the batch).
+        self.pack_sequences = pack_sequences
+        self.pack_capacity = pack_capacity
         # metric label: co-hosted engines (actor + critic on one worker)
         # must not conflate their areal_train_* series
         self.name = name or "model"
@@ -132,6 +141,7 @@ class TrainEngine:
         self._m_tps = reg.gauge("areal_train_tokens_per_second")
         self._m_mfu = reg.gauge("areal_train_mfu")
         self._m_version = reg.gauge("areal_train_version")
+        self._m_pad_frac = reg.gauge("areal_train_padding_frac")
         self._peak_flops = (
             device_peak_flops(mesh.devices.flat[0]) * mesh.devices.size
         )
@@ -152,23 +162,45 @@ class TrainEngine:
             return self.dp_size * m
         return self.dp_size
 
-    def _device_batch(self, pb: batching.PaddedBatch) -> Dict[str, jax.Array]:
-        batch = {
+    @staticmethod
+    def _batch_dict(pb: batching.PaddedBatch) -> Dict[str, np.ndarray]:
+        """The device-batch dict: [B, T] arrays, per-row seq_lens, the
+        flat segment table, and the extras."""
+        return {
             "tokens": pb.tokens,
             "positions": pb.positions,
             "seg_ids": pb.seg_ids,
             "seq_lens": pb.seq_lens,
+            "seg_rows": pb.seg_rows,
+            "seg_starts": pb.seg_starts,
+            "seg_lens": pb.seg_lens,
+            **pb.extras,
         }
-        batch.update(pb.extras)
+
+    def _device_batch(self, pb: batching.PaddedBatch) -> Dict[str, jax.Array]:
+        rows = pb.tokens.shape[0]
         out = {}
-        for k, v in batch.items():
-            sharding = (
-                self.batch_sharding if v.ndim >= 2 else self.row_sharding
-            )
+        for k, v in self._batch_dict(pb).items():
+            if v.ndim >= 2:
+                sharding = self.batch_sharding
+            elif v.shape[0] == rows:
+                sharding = self.row_sharding
+            else:
+                # segment-table / per-segment arrays whose length is not
+                # the (dp-divisible) row count: replicate
+                sharding = self.scalar_sharding
             out[k] = self._dist.put_global(np.asarray(v), sharding)
         return out
 
     def _pad(self, sample: SequenceSample, token_key: str) -> batching.PaddedBatch:
+        if self.pack_sequences:
+            return batching.pack_batch(
+                sample,
+                token_key=token_key,
+                capacity=self.pack_capacity,
+                row_multiple=self.row_quantum,
+                min_rows=self.row_quantum,
+            )
         return batching.pad_batch(
             sample,
             token_key=token_key,
@@ -246,35 +278,64 @@ class TrainEngine:
         return self._train_step_cache[key][0]
 
     def _stack_batches(self, mbs, token_key: str):
-        """Pad every micro-batch to a common [B, T] and stack to [n, B, T]."""
+        """Lay every micro-batch out at a common [B, T] and stack to
+        [n, B, T].
+
+        Padded mode: one sequence per row, T = the GLOBAL max bucket —
+        one 8k-token trace in a batch of short rows pads every stacked
+        slot to 8192.  Packing mode (``pack_sequences``): FFD segment
+        packing bounds each row by ``bucket_len(max(pack_capacity,
+        longest))``, so the stacked row count tracks total tokens and a
+        micro-batch token budget maps ~1:1 to real compute."""
         seqlens = [
             [l for ls in mb.seqlens[token_key] for l in ls] for mb in mbs
         ]
-        rows = max(
-            batching.pad_rows(max(len(s) for s in seqlens), self.row_quantum),
-            self.row_quantum,
-        )
-        T = batching.bucket_len(max(max(s) for s in seqlens))
-        pbs = [
-            batching.pad_batch(
-                mb, token_key=token_key, fixed_rows=rows, fixed_len=T
+        if self.pack_sequences:
+            T = batching.bucket_len(
+                max(self.pack_capacity, max(max(s) for s in seqlens))
             )
-            for mb in mbs
-        ]
-        batches = [
-            {
-                "tokens": pb.tokens,
-                "positions": pb.positions,
-                "seg_ids": pb.seg_ids,
-                "seq_lens": pb.seq_lens,
-                **pb.extras,
-            }
-            for pb in pbs
-        ]
+            # pre-bin (deterministic, native fast path) to find the shared
+            # row count before the layout pass; the bins are handed to
+            # pack_batch so FFD runs once per micro-batch
+            all_bins = [datapack.bin_pack_ffd(s, T) for s in seqlens]
+            rows = max(
+                batching.pad_rows(
+                    max(len(b) for b in all_bins), self.row_quantum
+                ),
+                self.row_quantum,
+            )
+            seg_cap = batching.next_pow2(max(len(s) for s in seqlens))
+            pbs = [
+                batching.pack_batch(
+                    mb,
+                    token_key=token_key,
+                    fixed_rows=rows,
+                    fixed_len=T,
+                    fixed_segs=seg_cap,
+                    bins=b,
+                )
+                for mb, b in zip(mbs, all_bins)
+            ]
+        else:
+            rows = max(
+                batching.pad_rows(
+                    max(len(s) for s in seqlens), self.row_quantum
+                ),
+                self.row_quantum,
+            )
+            T = batching.bucket_len(max(max(s) for s in seqlens))
+            pbs = [
+                batching.pad_batch(
+                    mb, token_key=token_key, fixed_rows=rows, fixed_len=T
+                )
+                for mb in mbs
+            ]
+        batches = [self._batch_dict(pb) for pb in pbs]
         # bucket the micro-batch count to the next power of two so
         # token-budget splitting (data-dependent n_mbs) hits a bounded set
         # of compiled steps; padding batches are all-zero (seg_ids 0 ->
-        # zero loss, zero denom, zero grads)
+        # zero loss, zero denom, zero grads; seg_lens 0 -> every segment
+        # masked out of per-segment gathers)
         n_bucket = 1 << (len(batches) - 1).bit_length()
         for _ in range(n_bucket - len(batches)):
             batches.append(
@@ -285,9 +346,12 @@ class TrainEngine:
         }
         out = {}
         for k, v in stacked.items():
-            spec = (
-                self.batch_sharding.spec if v.ndim >= 3 else self.row_sharding.spec
-            )
+            if v.ndim >= 3:
+                spec = self.batch_sharding.spec
+            elif v.shape[1] == rows:
+                spec = self.row_sharding.spec
+            else:  # segment table / per-segment scalars: replicate
+                spec = P()
             sharding = NamedSharding(self.mesh, P(None, *spec))
             out[k] = self._dist.put_global(v, sharding)
         return out, pbs
@@ -305,8 +369,18 @@ class TrainEngine:
         assert self.tx is not None, "engine built without an optimizer"
         tik = time.perf_counter()
         mbs, *_ = sample.split(mb_spec)
-        batch, _ = self._stack_batches(mbs, token_key)
+        batch, pbs = self._stack_batches(mbs, token_key)
         n_mbs = next(iter(batch.values())).shape[0]  # bucketed count
+        # padding waste of this step's device layout: stacked [n, B, T]
+        # slots (INCLUDING all-zero bucketing micro-batches — they burn
+        # the same compute) vs real tokens
+        slots = n_mbs * pbs[0].padded_slots
+        real_tokens = sum(
+            int(l) for per_id in sample.seqlens[token_key] for l in per_id
+        )
+        self.last_padded_slots = slots
+        self.last_padding_frac = 1.0 - real_tokens / max(slots, 1)
+        self._m_pad_frac.set(self.last_padding_frac, model=self.name)
         step = self._get_train_step(loss_fn, n_mbs)
         self.params, self.opt_state, out = step(
             self.params, self.opt_state, batch
@@ -335,9 +409,11 @@ class TrainEngine:
             host_stats["mfu"] = self.last_mfu
         return host_stats
 
-    #: last step's throughput/MFU (also exported as gauges)
+    #: last step's throughput/MFU/padding waste (also exported as gauges)
     last_tokens_per_sec: float = 0.0
     last_mfu: float = 0.0
+    last_padding_frac: float = 0.0
+    last_padded_slots: int = 0
 
     def _record_step_metrics(
         self,
@@ -401,15 +477,31 @@ class TrainEngine:
         mbs, fwd_idx, bwd_idx = sample.split(mb_spec)
         step = self._get_fwd_step(fwd_fn)
         packed_parts = []
+        # dispatch micro-batch N+1 BEFORE gathering micro-batch N: jax
+        # dispatch is async, so mb N's fetch RTT (tunnel/PCIe) rides under
+        # mb N+1's device time instead of serializing the chain (the
+        # ref-logprob and critic passes were host-sync chains before)
+        pending = None  # (device output, PaddedBatch) of the previous mb
         for mb in mbs:
             pb = self._pad(mb, token_key)
             batch = self._device_batch(pb)
-            out = self._dist.host_gather(step(self.params, batch))
-            packed_parts.append(
-                batching.unpad_per_token(
-                    out, pb.seq_lens, pb.n_real, shift=output_shift
+            out_dev = step(self.params, batch)
+            if pending is not None:
+                prev_out, prev_pb = pending
+                packed_parts.append(
+                    batching.unpack_per_token(
+                        self._dist.host_gather(prev_out),
+                        prev_pb,
+                        shift=output_shift,
+                    )
                 )
+            pending = (out_dev, pb)
+        prev_out, prev_pb = pending
+        packed_parts.append(
+            batching.unpack_per_token(
+                self._dist.host_gather(prev_out), prev_pb, shift=output_shift
             )
+        )
         packed = np.concatenate(packed_parts, axis=0)
         expected = [
             [l - output_shift for l in ls]

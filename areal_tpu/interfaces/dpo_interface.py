@@ -26,6 +26,7 @@ import numpy as np
 from areal_tpu.api import model_api
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.base import logging_, stats_tracker
+from areal_tpu.engine.batching import next_pow2
 from areal_tpu.interfaces.ppo_interface import (
     _response_mask,
     model_logprobs_fwd,
@@ -37,8 +38,9 @@ from areal_tpu.ops.loss import per_token_logprobs_entropy
 logger = logging_.getLogger("dpo_interface")
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1).bit_length())
+# rm_interface imports the _next_pow2 alias; the single implementation
+# lives with the other shape-bucketing helpers in engine/batching.py
+_next_pow2 = next_pow2
 
 
 def dpo_loss_fn(beta: float, n_pairs: int):
@@ -66,7 +68,11 @@ def dpo_loss_fn(beta: float, n_pairs: int):
 
         mask = _response_mask(batch)
         # sign/pair are per-token constants of their segment; align to the
-        # TARGET token of each transition (same shift as the labels)
+        # TARGET token of each transition (same shift as the labels).  In
+        # a multi-segment packed row the shift drags segment k+1's first
+        # sign/pair onto segment k's last column — harmless, because
+        # ``mask`` (same-segment transitions only) zeroes exactly those
+        # columns before the pairwise segment-sum
         def tgt(a):
             return jnp.pad(a[:, 1:], ((0, 0), (0, 1)))
 

@@ -10,7 +10,10 @@ On TPU the fusion win is real concurrency, not just fewer dispatches: the
 reward verifier is host-side CPU work (sympy / sandboxed code execution)
 while the ref forward occupies the chip — threading overlaps them, and the
 single MFC halves the data-plane transfers for the shared
-``packed_input_ids`` payload.
+``packed_input_ids`` payload.  (The ref forward itself additionally
+pipelines its micro-batches — ``TrainEngine.forward_batch`` dispatches
+mb N+1 before fetching mb N — so the fused dispatch is overlap on top of
+overlap.)
 """
 
 from __future__ import annotations

@@ -36,6 +36,15 @@ from areal_tpu.ops.loss import per_token_logprobs_entropy
 logger = logging_.getLogger("ppo_interface")
 
 
+def _segment_last_gather(values: jax.Array, batch: Dict) -> jax.Array:
+    """[S] value at each segment's LAST token, via the segment table
+    (``seg_rows``/``seg_starts``/``seg_lens``) every engine batch carries.
+    Padding segments (``seg_lens == 0``) alias row 0 / col 0 — callers
+    must mask on ``seg_lens > 0`` before trusting those entries."""
+    last = batch["seg_starts"] + jnp.maximum(batch["seg_lens"] - 1, 0)
+    return values[batch["seg_rows"], last]
+
+
 def _transition_mask(batch: Dict) -> jax.Array:
     """[B, T] 1.0 on transitions t->t+1 inside the same real segment."""
     seg = batch["seg_ids"]
@@ -157,10 +166,13 @@ class PPOActorInterface(model_api.ModelInterface):
             values = batch["values"].astype(jnp.float32)
         else:
             values = jnp.zeros_like(trans_mask)
-        # bootstrap with the value at the last token iff truncated
-        seq_lens = batch["seq_lens"]
-        last_idx = jnp.maximum(seq_lens - 1, 0)
-        v_last = jnp.take_along_axis(values, last_idx[:, None], axis=1)[:, 0]
+        # bootstrap with the value at each sequence's last token iff
+        # truncated — a segment-table gather (segment s ends at
+        # seg_starts[s] + seg_lens[s] - 1), not a per-row seq_lens-1
+        # gather, so the same code is layout-agnostic.  Prep runs on the
+        # one-sequence-per-row layout (GAE's reverse scan wants rows =
+        # episodes), where the table is trivial and [S] == [B].
+        v_last = _segment_last_gather(values, batch)
         bootstrap = v_last * no_eos
         adv, ret = gae_advantages_returns(
             rewards, values, bootstrap, trans_mask, self.discount, self.gae_lambda
@@ -173,6 +185,9 @@ class PPOActorInterface(model_api.ModelInterface):
     def _prepare_batch(self, sample: SequenceSample) -> Dict[str, float]:
         """Compute advantages/returns for the whole batch, amend the sample
         with packed keys, and apply advantage normalization."""
+        # advantage/GAE prep stays on the cheap UNPACKED layout even when
+        # the engine trains packed: the reverse scan wants one episode per
+        # row, and this pass is a single whole-batch jit, not the hot path
         pb = batching.pad_batch(
             sample, token_key=self.token_key, row_multiple=1
         )
@@ -181,6 +196,9 @@ class PPOActorInterface(model_api.ModelInterface):
             "positions": pb.positions,
             "seg_ids": pb.seg_ids,
             "seq_lens": pb.seq_lens,
+            "seg_rows": pb.seg_rows,
+            "seg_starts": pb.seg_starts,
+            "seg_lens": pb.seg_lens,
             **pb.extras,
         }
         adv, ret, loss_mask, kl_sum = self._prep_jit(

@@ -30,7 +30,10 @@ from areal_tpu.api import model_api
 from areal_tpu.api.data import MicroBatchSpec, SequenceSample
 from areal_tpu.base import logging_, stats_tracker
 from areal_tpu.interfaces.dpo_interface import DPOInterface, _next_pow2
-from areal_tpu.interfaces.ppo_interface import critic_values_fwd
+from areal_tpu.interfaces.ppo_interface import (
+    _segment_last_gather,
+    critic_values_fwd,
+)
 from areal_tpu.models.transformer import forward
 from areal_tpu.ops.dpo import dpo_pair_loss
 
@@ -46,13 +49,18 @@ def rm_pairwise_loss_fn(n_pairs: int):
         values = forward(
             params, cfg, batch["tokens"], batch["positions"], batch["seg_ids"]
         ).astype(jnp.float32)  # [B, T]
-        seq_lens = batch["seq_lens"]
-        last_idx = jnp.maximum(seq_lens - 1, 0)
-        score = jnp.take_along_axis(values, last_idx[:, None], axis=1)[:, 0]
-        real = seq_lens > 0  # padding rows score 0 into pair 0, masked below
+        # per-SEGMENT gathers via the segment table: a row may hold
+        # several packed sequences (engine pack_sequences), so "the
+        # sequence's last token" is seg_starts + seg_lens - 1 on
+        # seg_rows, not column seq_lens-1 of its own row.  sign/pair are
+        # per-token constants of their segment -> read the first column.
+        rows, starts = batch["seg_rows"], batch["seg_starts"]
+        slens = batch["seg_lens"]
+        score = _segment_last_gather(values, batch)  # [S]
+        real = slens > 0  # padding segments alias (0, 0), masked below
 
-        sign = batch["dpo_sign"][:, 0].astype(jnp.float32) * real
-        pair = batch["dpo_pair"][:, 0].astype(jnp.int32)
+        sign = batch["dpo_sign"][rows, starts].astype(jnp.float32) * real
+        pair = batch["dpo_pair"][rows, starts].astype(jnp.int32)
         pair_margin = jax.ops.segment_sum(
             score * sign, pair, num_segments=n_pairs
         )

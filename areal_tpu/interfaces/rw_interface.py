@@ -38,6 +38,8 @@ class MultiTaskRewardInterface(model_api.ModelInterface):
     ) -> SequenceSample:
         tok = model.tokenizer
         assert tok is not None, "reward interface needs a tokenizer"
+        # host-side over the packed 1-D varlen layout — unaffected by the
+        # engine's device-batch packing (which only changes [B, T] layout)
         seqlens = [l[0] for l in data.seqlens[self.token_key]]
         offsets = np.concatenate([[0], np.cumsum(seqlens)])
         packed = data.data[self.token_key]

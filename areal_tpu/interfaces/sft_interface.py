@@ -35,7 +35,12 @@ def sft_loss_fn(params, cfg, batch):
     w = head_weight(params, cfg).astype(hidden.dtype)
     labels = batch["tokens"][:, 1:]  # [B, T-1]
     h = hidden[:, :-1].reshape(-1, D)
-    # valid transition: current & next token in same non-pad segment
+    # valid transition: current & next token in same non-pad segment.
+    # This is already multi-segment-correct: when the engine packs
+    # several sequences into one row (pack_sequences), the column where
+    # segment k ends and k+1 begins has seg_ids k != k+1, so the
+    # cross-sequence "transition" drops out of the loss and denominator
+    # exactly as right-padding does
     valid = (batch["seg_ids"][:, 1:] != 0) & (
         batch["seg_ids"][:, :-1] == batch["seg_ids"][:, 1:]
     )

@@ -349,6 +349,14 @@ METRIC_TABLE = [
         ("model",),
     ),
     MetricSpec(
+        "areal_train_padding_frac",
+        "gauge",
+        "Fraction of the most recent train step's stacked [n, B, T] "
+        "device slots that held padding (incl. all-zero bucketing "
+        "micro-batches) — the waste sequence packing exists to shrink",
+        ("model",),
+    ),
+    MetricSpec(
         "areal_train_version",
         "gauge",
         "Optimizer-step count of this engine (published weight version)",

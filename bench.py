@@ -1732,6 +1732,7 @@ SUMMARY_REQUIRED_KEYS = (
     "slo_report",
     "sharded_serving",
     "weight_swap_ab",
+    "train_packing_ab",
     "paged_decode_ab",
     "dispatch_table",
     "sections",
@@ -1747,6 +1748,7 @@ def build_summary(
     slo_report=None,
     sharded_serving=None,
     weight_swap_ab=None,
+    train_packing_ab=None,
     decode_ab=None,
     pipeline_depth=2,
 ):
@@ -1782,6 +1784,7 @@ def build_summary(
         "slo_report": slo_report,
         "sharded_serving": sharded_serving,
         "weight_swap_ab": weight_swap_ab,
+        "train_packing_ab": train_packing_ab,
         "paged_decode_ab": (
             {
                 k: [
@@ -2080,6 +2083,120 @@ DEFAULT_SWEEP_CELLS = (
     ("attn_out", "bf16_mu_nu"),
     ("attn_out", "factored"),
 )
+
+
+def bench_train_packing_ab(
+    cfg,
+    n_seqs=64,
+    len_range=(64, 8192),
+    sigma=1.0,
+    max_tokens_per_mb=16384,
+    timed_steps=2,
+    seed=0,
+    lr=1e-5,
+):
+    """Sequence-packing A/B on a long-tail RL-shaped workload: per-row
+    padded vs FFD segment-packed train steps (engine ``pack_sequences``).
+
+    RL response lengths are long-tail by nature — one 8k reasoning trace
+    in a batch of mostly-short rows pads the whole padded [n, B, T] stack
+    to T=8192.  Lengths are lognormal (median ~4x the floor) clipped to
+    ``len_range``; both arms run the SAME sample and token budget through
+    TrainEngine.train_batch (sft loss), so the reported padded-slot count,
+    padding fraction, tok/s, and MFU isolate the batch layout.  The two
+    arms' first-step losses must agree (same objective, different layout)
+    — reported as ``loss_parity_abs``.  CPU-smoke capable at tiny shapes;
+    tok/s and MFU are data for the TPU re-run."""
+    import gc
+
+    import jax
+
+    from areal_tpu.api.data import MicroBatchSpec, SequenceSample
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.engine.train_engine import TrainEngine
+    from areal_tpu.interfaces.sft_interface import sft_loss_fn
+    from areal_tpu.models import transformer
+
+    lmin, lmax = len_range
+    rng = np.random.default_rng(seed)
+    lens = np.clip(
+        np.round(np.exp(rng.normal(np.log(lmin * 4.0), sigma, n_seqs))),
+        lmin,
+        lmax,
+    ).astype(int)
+    total_tokens = int(lens.sum())
+    sample = SequenceSample.from_default(
+        seqlens=lens.tolist(),
+        ids=[f"p{i}" for i in range(n_seqs)],
+        data={
+            "packed_input_ids": rng.integers(
+                0, cfg.vocab_size, (total_tokens,)
+            ).astype(np.int64),
+            "prompt_mask": np.zeros((total_tokens,), bool),
+        },
+    )
+    mb_spec = MicroBatchSpec(max_tokens_per_mb=max_tokens_per_mb)
+    peak_tf = peak_flops(jax.devices()[0]) / 1e12
+
+    def run_arm(pack):
+        # arms run SEQUENTIALLY and free their engine: two resident
+        # 0.5B fp32-adam states would not share a v5e with the other
+        # sections' remnants
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        mesh = MeshSpec().make_mesh(jax.devices()[:1])
+        eng = TrainEngine(
+            cfg,
+            mesh,
+            params,
+            optimizer_cfg=OptimizerConfig(lr=lr),
+            total_train_steps=100,
+            pack_sequences=pack,
+        )
+        first = eng.train_batch(sample, sft_loss_fn, mb_spec)  # compile
+        eng.train_batch(sample, sft_loss_fn, mb_spec)  # donation settles
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            eng.train_batch(sample, sft_loss_fn, mb_spec)
+        dt = (time.perf_counter() - t0) / timed_steps
+        tps = total_tokens / dt
+        row = {
+            "padded_slots": eng.last_padded_slots,
+            "padding_frac": round(eng.last_padding_frac, 4),
+            "toks_per_sec": round(tps, 1),
+            "tok_per_sec_per_tflop": round(tps / peak_tf, 3),
+            "first_step_loss": round(float(first["loss"]), 6),
+            "n_mbs": first["n_mbs"],
+        }
+        if eng.last_mfu > 0:
+            row["mfu"] = round(eng.last_mfu, 4)
+        del eng, params
+        gc.collect()
+        return row
+
+    padded = run_arm(False)
+    packed = run_arm(True)
+    return {
+        "workload": {
+            "n_seqs": n_seqs,
+            "total_tokens": total_tokens,
+            "len_min": int(lens.min()),
+            "len_p50": int(np.median(lens)),
+            "len_max": int(lens.max()),
+            "max_tokens_per_mb": max_tokens_per_mb,
+        },
+        "padded": padded,
+        "packed": packed,
+        "padded_slots_ratio": round(
+            padded["padded_slots"] / max(packed["padded_slots"], 1), 2
+        ),
+        "toks_per_sec_speedup": round(
+            packed["toks_per_sec"] / max(padded["toks_per_sec"], 1e-9), 3
+        ),
+        "loss_parity_abs": round(
+            abs(padded["first_step_loss"] - packed["first_step_loss"]), 6
+        ),
+    }
 
 
 def bench_train_sweep(
@@ -2584,6 +2701,28 @@ def main():
     ours_per_tflop = effective_tok_s / (peak_flops(dev) / 1e12)
     del eng, engine, params  # free HBM before the 1.5B section
 
+    # sequence-packing A/B: padded vs FFD segment-packed train steps on a
+    # long-tail (lognormal) RL-shaped length distribution — padded-slot
+    # count, padding fraction, tok/s, MFU per arm.  Runs off-TPU too
+    # (tiny shapes) so the summary always carries the >=2x slot-reduction
+    # acceptance number; each arm builds and frees its own engine.
+    mark("train packing A/B")
+    train_packing_ab = _section(
+        bench_train_packing_ab,
+        cfg,
+        name="train_packing_ab",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                n_seqs=24,
+                len_range=(16, 256),
+                max_tokens_per_mb=512,
+                timed_steps=1,
+            )
+        ),
+    )
+
     # chunked-prefill decode-stall A/B (0.5B; the mechanism under test is
     # the engine's admission scheduling, not model-size-dependent)
     mark("chunked prefill")
@@ -2667,6 +2806,7 @@ def main():
         slo_report=slo_report,
         sharded_serving=sharded_serving,
         weight_swap_ab=weight_swap_ab,
+        train_packing_ab=train_packing_ab,
         decode_ab=decode_ab,
     )
 
@@ -2706,6 +2846,7 @@ def main():
                         mfu_attn(train_toks_per_sec, seq_len), 4
                     ),
                     "train_long_ctx": train_long,
+                    "train_packing_ab": train_packing_ab,
                     "train_remat_moment_sweep": train_sweep,
                     "train_toks_per_sec": round(train_toks_per_sec, 1),
                     "n_params": n_params,

@@ -55,3 +55,39 @@ def test_bin_pack_ffd():
     for b in bins:
         assert sum(nums[i] for i in b) <= 7
     assert sorted(flat2d(bins)) == list(range(5))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_bin_pack_ffd_native_vs_python_parity(seed):
+    """The two FFD implementations behind ``bin_pack_ffd`` (native C fast
+    path vs the pure-python loop) must produce IDENTICAL bins on the same
+    input — the train path's segment packing (batching.pack_batch) relies
+    on the choice being an invisible performance detail."""
+    from areal_tpu.base import _native
+
+    if _native.get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(seed)
+    nums = rng.integers(1, 300, 200).tolist()
+    py = bin_pack_ffd(nums, capacity=512, use_native=False)
+    native = bin_pack_ffd(nums, capacity=512, use_native=True)
+    assert py == native
+    # capacity respected on both (no singleton exceeds 512 here)
+    for b in py:
+        assert sum(nums[i] for i in b) <= 512
+    assert sorted(flat2d(py)) == list(range(len(nums)))
+
+
+@pytest.mark.parametrize("use_native", [False, None])
+def test_bin_pack_ffd_deterministic(use_native):
+    """Same input -> same bins, call after call (ties broken by stable
+    sort), including across the auto native/python threshold."""
+    rng = np.random.default_rng(7)
+    # heavy ties: many equal lengths exercise the tie-break contract
+    nums = rng.integers(1, 8, 100).tolist()
+    a = bin_pack_ffd(nums, capacity=16, use_native=use_native)
+    b = bin_pack_ffd(nums, capacity=16, use_native=use_native)
+    assert a == b
+    # and the auto path (n >= 64 -> native when available) agrees with
+    # the forced-python path bin-for-bin
+    assert a == bin_pack_ffd(nums, capacity=16, use_native=False)

@@ -1,0 +1,198 @@
+"""batching.pad_batch / pack_batch unit tests: segment-table invariants,
+pack/unpack round trips, transition-key boundary zeroing, and the extras
+classification fix (per-token keys in an all-length-1 batch)."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data import SequenceSample
+from areal_tpu.engine import batching
+
+
+def make_sample(seqlens, vocab=100, seed=0, extra_keys=()):
+    rng = np.random.RandomState(seed)
+    total = sum(seqlens)
+    data = {
+        "packed_input_ids": rng.randint(1, vocab, size=total).astype(np.int32)
+    }
+    if "prompt_mask" in extra_keys:  # full-length
+        data["prompt_mask"] = rng.rand(total) < 0.3
+    if "packed_logprobs" in extra_keys:  # transition (L-1)
+        data["packed_logprobs"] = -rng.rand(
+            total - len(seqlens)
+        ).astype(np.float32)
+    if "rewards" in extra_keys:  # scalar
+        data["rewards"] = rng.rand(len(seqlens)).astype(np.float32)
+    return SequenceSample.from_default(
+        seqlens, [f"s{i}" for i in range(len(seqlens))], data
+    )
+
+
+LENS = [12, 9, 30, 4, 17, 8, 25, 6]
+
+
+def test_pack_batch_segment_invariants():
+    sample = make_sample(LENS, seed=1)
+    pb = batching.pack_batch(sample, capacity=32)
+    B, T = pb.shape
+    assert T == 32
+    # every original sequence appears verbatim at its table slot
+    offs = np.concatenate([[0], np.cumsum(LENS)])
+    packed = sample.data["packed_input_ids"]
+    assert pb.n_segs == len(LENS)
+    for s, L in enumerate(LENS):
+        r, c = int(pb.seg_rows[s]), int(pb.seg_starts[s])
+        assert int(pb.seg_lens[s]) == L
+        np.testing.assert_array_equal(
+            pb.tokens[r, c : c + L], packed[offs[s] : offs[s + 1]]
+        )
+        # positions restart at 0 per segment (RoPE correct by construction)
+        np.testing.assert_array_equal(
+            pb.positions[r, c : c + L], np.arange(L)
+        )
+        # one seg id covers the whole segment, nonzero
+        ids = pb.seg_ids[r, c : c + L]
+        assert ids.min() == ids.max() > 0
+    for r in range(pb.n_real):
+        row_ids = pb.seg_ids[r][pb.seg_ids[r] != 0]
+        ks = np.unique(row_ids)
+        # seg ids numbered 1..k per row
+        np.testing.assert_array_equal(ks, np.arange(1, len(ks) + 1))
+        # capacity respected
+        assert int(pb.seq_lens[r]) == (pb.seg_ids[r] != 0).sum() <= T
+    # packing actually packs: fewer rows than sequences
+    assert pb.n_real < len(LENS)
+    # slots shrink vs one-sequence-per-row at the same bucket
+    padded = batching.pad_batch(sample)
+    assert pb.padded_slots < padded.padded_slots
+
+
+def test_pad_batch_trivial_segment_table():
+    sample = make_sample(LENS, seed=2)
+    pb = batching.pad_batch(sample, row_multiple=4)
+    B = pb.shape[0]
+    assert pb.seg_rows.shape == (B,)  # [S] == [B]: per-row arrays line up
+    np.testing.assert_array_equal(pb.seg_rows[: len(LENS)], np.arange(len(LENS)))
+    np.testing.assert_array_equal(pb.seg_starts, np.zeros(B, np.int32))
+    np.testing.assert_array_equal(pb.seg_lens, pb.seq_lens)
+
+
+@pytest.mark.parametrize("packer", ["pad", "pack"])
+def test_pack_unpack_round_trip_original_order(packer):
+    sample = make_sample(
+        LENS, seed=3,
+        extra_keys=("prompt_mask", "packed_logprobs", "rewards"),
+    )
+    if packer == "pack":
+        pb = batching.pack_batch(sample, capacity=32, row_multiple=4)
+    else:
+        pb = batching.pad_batch(sample, row_multiple=4)
+    # full-length round trip
+    got = batching.unpack_per_token(pb.tokens, pb)
+    np.testing.assert_array_equal(got, sample.data["packed_input_ids"])
+    got = batching.unpack_per_token(pb.extras["prompt_mask"], pb)
+    np.testing.assert_array_equal(got, sample.data["prompt_mask"])
+    # transition-aligned round trip (shift=1)
+    got = batching.unpack_per_token(pb.extras["packed_logprobs"], pb, shift=1)
+    np.testing.assert_array_equal(got, sample.data["packed_logprobs"])
+
+
+def test_transition_key_zero_at_segment_boundaries():
+    sample = make_sample(LENS, seed=4, extra_keys=("packed_logprobs",))
+    pb = batching.pack_batch(sample, capacity=64)
+    lp = pb.extras["packed_logprobs"]
+    for s in range(pb.n_segs):
+        r, c, L = (
+            int(pb.seg_rows[s]),
+            int(pb.seg_starts[s]),
+            int(pb.seg_lens[s]),
+        )
+        # the segment's LAST column carries no transition value — packed
+        # next to another segment or not
+        assert lp[r, c + L - 1] == 0.0
+    # everything outside real segments is zero too
+    mask = np.zeros_like(lp, bool)
+    for s in range(pb.n_segs):
+        r, c, L = (
+            int(pb.seg_rows[s]),
+            int(pb.seg_starts[s]),
+            int(pb.seg_lens[s]),
+        )
+        mask[r, c : c + L - 1] = True
+    assert np.all(lp[~mask] == 0.0)
+
+
+def test_scalar_extras_per_segment_in_pack_mode():
+    sample = make_sample(LENS, seed=5, extra_keys=("rewards",))
+    pb = batching.pack_batch(sample, capacity=32)
+    r = pb.extras["rewards"]
+    assert r.ndim == 1 and r.shape[0] == pb.seg_rows.shape[0]
+    np.testing.assert_array_equal(
+        r[: pb.n_segs], sample.data["rewards"]
+    )
+
+
+def test_all_length_one_batch_keeps_per_token_keys_per_token():
+    """The old ``all(l == 1)`` heuristic silently laid a genuine
+    per-token key out as [B] when every sequence had length 1; the
+    classifier now compares against the token key's lengths."""
+    n = 5
+    sample = SequenceSample.from_default(
+        [1] * n,
+        [f"s{i}" for i in range(n)],
+        {
+            "packed_input_ids": np.arange(1, n + 1, dtype=np.int32),
+            # per-token key (lens == token lens == all ones)
+            "prompt_mask": np.ones(n, bool),
+            # registered scalar key: stays [B] even in this degenerate batch
+            "rewards": np.arange(n, dtype=np.float32),
+        },
+    )
+    pb = batching.pad_batch(sample)
+    assert pb.extras["prompt_mask"].shape == pb.tokens.shape  # [B, T], not [B]
+    np.testing.assert_array_equal(
+        pb.extras["prompt_mask"][:n, 0], np.ones(n, bool)
+    )
+    assert pb.extras["rewards"].shape == (pb.shape[0],)
+
+
+def test_length_two_transition_key_not_misread_as_scalar():
+    """L-1 == 1 transition keys in an all-length-2 batch were scalar
+    under the old heuristic; they must lay out [B, T] with column 1
+    zeroed."""
+    n = 4
+    sample = SequenceSample.from_default(
+        [2] * n,
+        [f"s{i}" for i in range(n)],
+        {
+            "packed_input_ids": np.arange(1, 2 * n + 1, dtype=np.int32),
+            "packed_logprobs": -np.arange(1, n + 1, dtype=np.float32),
+        },
+    )
+    pb = batching.pad_batch(sample)
+    lp = pb.extras["packed_logprobs"]
+    assert lp.shape == pb.tokens.shape
+    np.testing.assert_array_equal(lp[:n, 0], -np.arange(1, n + 1))
+    assert np.all(lp[:, 1:] == 0.0)
+
+
+def test_pack_batch_fixed_shapes_and_row_padding():
+    sample = make_sample(LENS, seed=6)
+    pb = batching.pack_batch(
+        sample, capacity=32, fixed_rows=8, fixed_len=64, fixed_segs=16
+    )
+    assert pb.shape == (8, 64)
+    assert pb.seg_rows.shape == (16,)
+    assert np.all(pb.seg_lens[pb.n_segs :] == 0)
+    # padding rows are all-zero
+    assert np.all(pb.tokens[pb.n_real :] == 0)
+    assert np.all(pb.seg_ids[pb.n_real :] == 0)
+
+
+def test_pack_batch_capacity_below_longest_is_raised_to_fit():
+    sample = make_sample([40, 3, 3], seed=7)
+    pb = batching.pack_batch(sample, capacity=8)
+    # the longest sequence dictates the bucket; shorter ones pack beside it
+    assert pb.shape[1] == batching.bucket_len(40)
+    got = batching.unpack_per_token(pb.tokens, pb)
+    np.testing.assert_array_equal(got, sample.data["packed_input_ids"])

@@ -276,6 +276,30 @@ def test_spec_decode_ab_reports_required_fields(spec_ab):
     assert 0.0 <= row["derived_min_accept_rate"] <= 1.0
 
 
+@pytest.mark.slow  # ~19s: four engine builds; the >=2x slot-reduction
+# claim itself stays tier-1 via test_packed_training.py's dense arm
+def test_train_packing_ab_smoke(tiny_cfg):
+    """The packing A/B's acceptance bar at tiny CPU shapes: >= 2x fewer
+    padded slots on the long-tail workload, first-step loss parity
+    between the arms, and every reported field present for the TPU
+    re-run's diff."""
+    out = bench.bench_train_packing_ab(
+        tiny_cfg,
+        n_seqs=16,
+        len_range=(8, 96),
+        max_tokens_per_mb=256,
+        timed_steps=1,
+    )
+    assert out["padded_slots_ratio"] >= 2.0, out
+    assert out["packed"]["padding_frac"] < out["padded"]["padding_frac"]
+    assert out["loss_parity_abs"] < 1e-4, out
+    for arm in ("padded", "packed"):
+        assert out[arm]["toks_per_sec"] > 0
+        assert out[arm]["padded_slots"] > 0
+    assert out["workload"]["len_max"] <= 96
+    json.dumps(out)  # wire-format safe
+
+
 def test_summary_schema_round_trips_with_required_keys(spec_ab):
     """The machine-parseable summary contract: json round-trip + every
     SUMMARY_REQUIRED_KEYS entry present (None for sections that did not
@@ -291,6 +315,11 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
         prefix_cache_ab={"replay_wall_speedup": 1.5},
         trace_overhead_ab=None,
         spec_decode_ab=spec_ab,
+        train_packing_ab={
+            "padded_slots_ratio": 3.3,
+            "padded": {"padding_frac": 0.8},
+            "packed": {"padding_frac": 0.38},
+        },
         slo_report={
             "error_bound": 0.0905,
             "multi_turn": {"fleet": {"ttft_s": {"p99": 0.5}}},
@@ -328,6 +357,7 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
     assert blob["slo_report"]["multi_turn"]["fleet"]["ttft_s"]["p99"] == 0.5
     assert blob["slo_report"]["overhead_ab"]["overhead_frac_vs_off"] == 0.01
     assert blob["weight_swap_ab"]["staged_below_full_all"] is True
+    assert blob["train_packing_ab"]["padded_slots_ratio"] == 3.3
     assert blob["weight_swap_ab"]["dense"]["staged_pause_ms"] < (
         blob["weight_swap_ab"]["dense"]["full_pause_ms"]
     )
