@@ -194,6 +194,17 @@ class GenServerConfig:
     prefix_cache: bool = True
     prefix_cache_capacity_frac: float = 0.5
     prefix_cache_min_match_tokens: int = 64
+    # host spill tier below the HBM radix cache (the SGLang
+    # hierarchical-cache / HiCache direction): evicted full-block
+    # entries copy their KV into host buffers (batched device_get per
+    # reclamation round) instead of dying, and a match on a spilled
+    # prefix swaps the blocks back in on an async dispatch riding the
+    # decode ring's overlap (admission requeued until the step after
+    # dispatch — SPMD-deterministic).  Bytes-budgeted: effective cache
+    # capacity multiplies by roughly host-RAM/HBM.  0 = off (default);
+    # weight swaps always flush both tiers.  Single-process engines
+    # only (multi-host SPMD serving auto-disables with a warning).
+    prefix_cache_host_bytes: int = 0
     # self-speculative n-gram decoding on the paged path (default off);
     # maps SGLang's ngram speculative mode / vLLM's ngram
     # speculative_config — see SpecDecodeConfig + docs

@@ -400,6 +400,7 @@ class ContinuousBatchingEngine:
         prefix_cache: bool = True,
         prefix_cache_capacity_frac: float = 0.5,
         prefix_cache_min_tokens: int = 1,
+        prefix_cache_host_bytes: int = 0,
         spec_decode_params: Optional[spec_decode.SpecDecodeParams] = None,
         slo_tracking: bool = True,
         server_name: str = "",
@@ -457,6 +458,19 @@ class ContinuousBatchingEngine:
         (it is the first reclamation tier, before parked-row eviction and
         preemption) and the whole cache flushes on ``update_weights`` —
         KV computed under old weights is never reused after a swap.
+
+        ``prefix_cache_host_bytes`` > 0 adds the HOST SPILL TIER below
+        the HBM cache (the SGLang hierarchical/HiCache direction):
+        evicted full-block entries copy their KV to host buffers (one
+        batched device_get per reclamation round) instead of dying, and
+        a match on a spilled prefix swaps the blocks back in on an
+        async dispatch that rides the decode ring's overlap — the
+        admission requeues until the step after the swap-in dispatch
+        (step-keyed, never a readiness probe, so SPMD lockstep holds).
+        Effective cache capacity multiplies by roughly host-RAM/HBM;
+        weight swaps flush both tiers.  Single-process engines only
+        (multi-process SPMD serving disables the tier with a warning —
+        host buffers would cover just the local pool shard).
         """
         self.cfg = cfg
         self.device = device
@@ -469,6 +483,7 @@ class ContinuousBatchingEngine:
         self._prefix_cache_enabled = bool(prefix_cache)
         self._prefix_cache_capacity_frac = prefix_cache_capacity_frac
         self._prefix_cache_min_tokens = prefix_cache_min_tokens
+        self._prefix_cache_host_bytes = max(0, int(prefix_cache_host_bytes))
         self.paged = cache_mode == "paged" or (
             cache_mode == "auto"
             and kv_cache_len >= self.dispatch_table.paged_min_cache_len
@@ -633,6 +648,10 @@ class ContinuousBatchingEngine:
         self.prefill_tokens_total = 0  # unique-prompt tokens actually run
         self.prefill_calls = 0
         self.resumed_total = 0  # continuations resumed with zero prefill
+        # host-tier rounds: batched spill gathers (one device_get each)
+        # and batched swap-in dispatches (one async scatter each)
+        self.host_spill_rounds_total = 0
+        self.host_restore_rounds_total = 0
         # decode-loop time attribution (cumulative seconds): host = admit/
         # bookkeeping/dispatch-enqueue, device = blocked waiting for chunk
         # compute, fetch = device->host transfer after completion.  The
@@ -741,6 +760,21 @@ class ContinuousBatchingEngine:
         # incref/decref, so its evictions can never recycle a block a
         # live row still pins)
         if self._prefix_cache_enabled:
+            host_bytes = self._prefix_cache_host_bytes
+            if host_bytes > 0 and jax.process_count() > 1:
+                logger.warning(
+                    "prefix-cache host tier disabled: spill buffers are "
+                    "per-process host memory, but this engine's pool is "
+                    "sharded across %d SPMD processes (a local gather "
+                    "would cover only this process's kv-head shard)",
+                    jax.process_count(),
+                )
+                host_bytes = 0
+            # one full block's k+v footprint — the host budget's unit
+            block_bytes = int(
+                2 * cfg.n_layers * cfg.n_kv_heads * BS * cfg.head_dim
+                * jnp.dtype(self.k_pool.dtype).itemsize
+            )
             self._prefix_cache = RadixPrefixCache(
                 page_size=BS,
                 capacity_blocks=int(
@@ -749,6 +783,28 @@ class ContinuousBatchingEngine:
                 acquire=self._incref_blocks,
                 release=self._free_block_list,
                 min_match_tokens=self._prefix_cache_min_tokens,
+                host_bytes_budget=host_bytes,
+                block_bytes=block_bytes,
+                spill_fetch=self._spill_gather if host_bytes > 0 else None,
+            )
+            # the effective knobs, logged once: the config default for
+            # min_match_tokens (64) and the engine default (1) differ,
+            # and a caller bypassing GenServerConfig silently gets the
+            # engine's — make the value a fleet actually runs visible
+            logger.info(
+                "radix prefix cache: capacity=%d/%d pool blocks "
+                "(frac=%.2f), min_match_tokens=%d (effective), host "
+                "tier=%s",
+                self._prefix_cache.capacity_blocks,
+                self.n_blocks,
+                self._prefix_cache_capacity_frac,
+                self._prefix_cache.min_match_tokens,
+                (
+                    f"{host_bytes} bytes (~{host_bytes // block_bytes} "
+                    "blocks)"
+                    if host_bytes > 0
+                    else "off"
+                ),
             )
         # stable closures: paged_decode_chunk caches its jit on their ids
         sampling_ref = self.sampling
@@ -811,17 +867,20 @@ class ContinuousBatchingEngine:
         return len(self._free_blocks)
 
     def _alloc_blocks_reclaiming(
-        self, n: int, keep_qids=()
+        self, n: int, keep_qids=(), protect_step: Optional[int] = None
     ) -> Optional[List[int]]:
         """``_alloc_blocks`` with tiered reclamation: prefix-cache entries
         first (pure recompute insurance — the cache always yields to live
-        rows), then parked rows.  Returns None only when both tiers are
-        exhausted (the caller may then preempt or requeue)."""
+        rows; with the host tier on, "yield" means spill, not die), then
+        parked rows.  Returns None only when both tiers are exhausted
+        (the caller may then preempt or requeue).  ``protect_step``
+        spares cache nodes touched at that step — the swap-in path
+        allocates while the nodes it is restoring sit freshly matched."""
         blocks = self._alloc_blocks(n)
         while blocks is None:
             deficit = n - len(self._free_blocks)
             if self._prefix_cache is not None and self._prefix_cache.evict(
-                deficit
+                deficit, protect_step=protect_step
             ):
                 pass
             elif self._evict_parked(keep_qids=keep_qids) is not None:
@@ -832,6 +891,62 @@ class ContinuousBatchingEngine:
         return blocks
 
     # -- cross-request prefix cache ----------------------------------------
+
+    def _spill_gather(self, blocks: List[int]):
+        """Batched device->host gather of whole pool blocks (the cache's
+        ``spill_fetch``): one jitted gather + one blocking ``device_get``
+        per reclamation round, power-of-two padded so repeated rounds
+        reuse a handful of compiled shapes.  Returns host (k, v) arrays
+        indexed ``[i] -> blocks[i]``."""
+        n = len(blocks)
+        n_pad = 1 << (n - 1).bit_length()
+        idx = np.zeros((n_pad,), np.int32)
+        idx[:n] = blocks
+        k, v = paged.gather_blocks(
+            self.k_pool, self.v_pool, jnp.asarray(idx)
+        )
+        k, v = jax.device_get((k, v))
+        self.host_spill_rounds_total += 1
+        return np.asarray(k)[:n], np.asarray(v)[:n]
+
+    def _restore_spilled(self, nodes, keep_qids=()) -> bool:
+        """Swap spilled prefix blocks back into the pool: allocate fresh
+        blocks (reclamation protected from eating the nodes being
+        restored), dispatch ONE batched async scatter of the host
+        payloads (paged.restore_blocks — the transfer rides under the
+        decode chunks queued in the in-flight ring), and mark the nodes
+        usable from the NEXT engine step.  The triggering admission
+        requeues meanwhile; its re-match next step lands resident.
+        False when the pool cannot provide the blocks — the caller falls
+        back to the resident-only prefix."""
+        n = len(nodes)
+        blocks = self._alloc_blocks_reclaiming(
+            n, keep_qids=keep_qids, protect_step=self._step_seq
+        )
+        if blocks is None:
+            return False
+        payloads = self._prefix_cache.begin_restore(nodes)
+        n_pad = 1 << (n - 1).bit_length()
+        L, NB, Hkv, BS, hd = self.k_pool.shape
+        kh = np.zeros((n_pad, L, Hkv, BS, hd), self.k_pool.dtype)
+        vh = np.zeros_like(kh)
+        dst = np.full((n_pad,), self.n_blocks, np.int32)  # pad -> dropped
+        for i, (kb, vb) in enumerate(payloads):
+            kh[i] = kb
+            vh[i] = vb
+            dst[i] = blocks[i]
+        self.k_pool, self.v_pool = paged.restore_blocks(
+            self.k_pool,
+            self.v_pool,
+            jnp.asarray(kh),
+            jnp.asarray(vh),
+            jnp.asarray(dst),
+        )
+        self._prefix_cache.complete_restore(
+            nodes, blocks, ready_step=self._step_seq + 1
+        )
+        self.host_restore_rounds_total += 1
+        return True
 
     def _cache_insert(self, seq: List[int], blocks: List[int]):
         """Register ``seq``'s KV-bearing blocks in the radix cache (full
@@ -859,9 +974,29 @@ class ContinuousBatchingEngine:
         — the donor row may still be appending to it), and ``fill_pos``
         starts past the reused prefix so only the suffix is prefilled.
         Returns None when the pool cannot provide the non-cached blocks
-        even after reclamation (caller requeues)."""
+        even after reclamation (caller requeues), or when the match
+        landed on host-spilled blocks — their swap-in is dispatched (or
+        already riding the ring) and the requeued admission re-matches
+        into a resident prefix at the next engine step."""
         n_blocks = max(1, -(-len(seq) // self.page_size))
         m = self._match_prefix(seq)
+        if m.restore_nodes or m.pending:
+            restored = False
+            if m.restore_nodes:
+                restored = self._restore_spilled(
+                    m.restore_nodes, keep_qids=keep_qids
+                )
+            if restored or m.pending:
+                return None  # requeue: resident next step (step-keyed)
+            # the pool couldn't serve the swap-in: fall back to the
+            # resident-only prefix this match already carries (its tail
+            # scan was skipped — correctness unaffected, just a shorter
+            # reuse).  The match's floor gate passed on resident +
+            # spilled tokens together; the resident part alone must
+            # re-clear min_match_tokens or the fallback would pin a
+            # reuse below the configured floor and count it as a hit
+            if m.n_tokens < self._prefix_cache.min_match_tokens:
+                m = PrefixMatch()
         # pin everything the match returned BEFORE allocating: the
         # allocation may evict cache entries, and an unpinned matched
         # block could be recycled into our own allocation

@@ -41,12 +41,34 @@ Design constraints, in order:
   concurrent insert of pre-swap KV is rejected.  Stale-KV reuse across
   a swap would be a silent correctness bug — the engine's test suite
   pins this.
+
+**Host spill tier** (``host_bytes_budget`` > 0): the cache is
+hierarchical — HBM blocks on top, host RAM below.  When eviction would
+drop a full-block node, the node instead SPILLS: the engine's
+``spill_fetch`` callback gathers the victims' block KV into host
+buffers (one batched ``device_get`` per reclamation round), the device
+references are released, and the trie node stays alive in a ``spilled``
+state carrying its host payload.  A later ``match()`` that lands on
+spilled nodes reports them in ``PrefixMatch.restore_nodes``; the engine
+allocates fresh pool blocks, dispatches an async scatter of the host
+payloads back into them (the swap-in rides the decode ring's overlap),
+and hands the blocks back via :meth:`complete_restore` — the node is
+usable again from ``ready_step`` on (a step-keyed gate, never a device
+readiness probe, so SPMD lockstep replay stays deterministic).  LRU
+spans both tiers: device eviction picks (last_use, seq)-LRU residents,
+and a spill that overflows ``host_bytes_budget`` first trims the
+LRU spilled entry — admitting the newcomer only if something older
+yields.  On any root-to-leaf path residents precede spilled nodes (a
+node spills only once every child has), so a spilled chain is always
+restorable top-down.  ``flush()`` drops BOTH tiers — stale KV across a
+weight swap stays impossible, host copies included.
 """
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass
@@ -59,12 +81,24 @@ class PrefixMatch:
     ``tail_tokens`` tokens extend the match; the caller must COPY that
     block into one it owns (copy-on-write) — the donor may still be
     appending to it.  ``n_tokens`` is the total matched prefix length
-    (``len(blocks) * page_size + tail_tokens``)."""
+    (``len(blocks) * page_size + tail_tokens``).
+
+    Host-tier extension: ``restore_nodes`` are spilled trie nodes that
+    would extend the resident match by ``restore_tokens`` more tokens
+    once swapped back in (the caller starts the restore and requeues the
+    admission).  ``pending`` is True when a node on the path has a
+    swap-in already dispatched but not yet usable (its ``ready_step`` is
+    in the future) — the caller requeues WITHOUT starting a new restore.
+    When either is set the resident fields above cover only the usable
+    resident prefix and the tail scan was skipped."""
 
     blocks: List[int] = dataclasses.field(default_factory=list)
     n_tokens: int = 0
     tail_block: Optional[int] = None
     tail_tokens: int = 0
+    restore_nodes: List["_Node"] = dataclasses.field(default_factory=list)
+    restore_tokens: int = 0
+    pending: bool = False
 
 
 @dataclasses.dataclass
@@ -88,10 +122,15 @@ TAILS_PER_NODE = 4
 
 class _Node:
     """One full block of one cached sequence.  ``key`` is the block's
-    ``page_size``-token tuple; children extend the prefix by one block."""
+    ``page_size``-token tuple; children extend the prefix by one block.
+
+    ``spilled`` nodes hold their KV in ``host_kv`` (a host (k, v) pair
+    the engine's spill_fetch produced) instead of a pool block;
+    ``ready_step`` gates a freshly restored node until the engine step
+    after its swap-in dispatch (step-keyed, SPMD-deterministic)."""
 
     __slots__ = ("key", "block", "children", "parent", "last_use", "seq",
-                 "tails")
+                 "tails", "spilled", "host_kv", "ready_step")
 
     def __init__(self, key, block, parent, last_use, seq):
         self.key: Tuple[int, ...] = key
@@ -102,6 +141,16 @@ class _Node:
         self.seq: int = seq  # insertion order: deterministic LRU tie-break
         # first token -> cached partial tail (bounded by TAILS_PER_NODE)
         self.tails: Dict[int, _TailEntry] = {}
+        self.spilled: bool = False
+        self.host_kv: Optional[Tuple[Any, Any]] = None
+        self.ready_step: int = 0
+
+
+def _insort_lru(cands: List[_Node], node: _Node):
+    """Insert ``node`` into an LRU-sorted ``(last_use, seq)`` candidate
+    list, keeping order (the host-trim list shared across one
+    reclamation round)."""
+    bisect.insort(cands, node, key=lambda n: (n.last_use, n.seq))
 
 
 class RadixPrefixCache:
@@ -112,6 +161,12 @@ class RadixPrefixCache:
     disables insertion entirely.  ``min_match_tokens`` suppresses matches
     shorter than the configured floor — pinning and COW-copying for a
     handful of cached tokens costs more than it saves.
+
+    ``host_bytes_budget`` > 0 enables the host spill tier (see module
+    docstring): ``block_bytes`` is one full block's k+v footprint (the
+    budget's accounting unit) and ``spill_fetch(blocks) -> (k, v)`` is
+    the engine's batched device->host gather, returning per-block host
+    payloads indexed ``[i] -> blocks[i]``.
     """
 
     def __init__(
@@ -121,6 +176,9 @@ class RadixPrefixCache:
         acquire: Callable[[List[int]], None],
         release: Callable[[List[int]], None],
         min_match_tokens: int = 1,
+        host_bytes_budget: int = 0,
+        block_bytes: int = 0,
+        spill_fetch: Optional[Callable[[List[int]], Tuple[Any, Any]]] = None,
     ):
         assert page_size >= 1
         self.page_size = page_size
@@ -128,10 +186,15 @@ class RadixPrefixCache:
         self.min_match_tokens = max(1, int(min_match_tokens))
         self._acquire = acquire
         self._release = release
+        self.host_bytes_budget = max(0, int(host_bytes_budget))
+        self.block_bytes = max(0, int(block_bytes))
+        self._spill_fetch = spill_fetch
         self._root = _Node(key=(), block=-1, parent=None, last_use=0, seq=0)
         self._seq = 0
         self.version = 0
         self.blocks_held = 0
+        self.host_bytes_held = 0
+        self.host_blocks_held = 0
         # stats (cumulative; the engine mirrors them into the registry)
         self.hits_total = 0
         self.misses_total = 0
@@ -139,6 +202,17 @@ class RadixPrefixCache:
         self.insertions_total = 0
         self.evictions_total = 0
         self.flushes_total = 0
+        self.spilled_blocks_total = 0
+        self.restored_blocks_total = 0
+        self.host_dropped_blocks_total = 0
+
+    @property
+    def _host_enabled(self) -> bool:
+        return (
+            self.host_bytes_budget > 0
+            and self.block_bytes > 0
+            and self._spill_fetch is not None
+        )
 
     # -- lookup -------------------------------------------------------------
 
@@ -153,22 +227,47 @@ class RadixPrefixCache:
         ``min_match_tokens`` — callers that may re-match the same
         request (a requeued admission retries every engine step) pass
         ``record=False`` and call :meth:`record` once the match is
-        actually consumed, so stats count served tokens, not attempts."""
+        actually consumed, so stats count served tokens, not attempts.
+
+        A walk that lands on host-tier nodes returns a BLOCKED match:
+        ``restore_nodes``/``pending`` set (see :class:`PrefixMatch`),
+        resident fields covering only the usable resident prefix, and
+        no stats recorded (the caller requeues and re-matches)."""
         BS = self.page_size
         max_match = len(tokens) - 1
         node = self._root
         out = PrefixMatch()
         depth = 0
+        blocked = False
         while (depth + 1) * BS <= max_match:
             key = tuple(tokens[depth * BS : (depth + 1) * BS])
             child = node.children.get(key)
             if child is None:
                 break
             child.last_use = step
-            out.blocks.append(child.block)
+            if not blocked and not child.spilled and child.ready_step <= step:
+                out.blocks.append(child.block)
+            else:
+                # the resident run ends at the first spilled/not-yet-ready
+                # node; everything past it (resident or not) counts only
+                # as extension tokens the restore would unlock
+                blocked = True
+                if child.spilled:
+                    out.restore_nodes.append(child)
+                elif child.ready_step > step:
+                    out.pending = True
+                out.restore_tokens += BS
             node = child
             depth += 1
-        out.n_tokens = depth * BS
+        out.n_tokens = len(out.blocks) * BS
+        if blocked:
+            # gate on the full potential: a restore is only worth
+            # triggering when the unblocked match would clear the floor
+            if out.n_tokens + out.restore_tokens < self.min_match_tokens:
+                if record:
+                    self.misses_total += 1
+                return PrefixMatch()
+            return out
         # partial extension of the deepest matched node: its cached
         # partial tail, or the head of a FULL child block (a shorter or
         # diverging prompt re-using part of a longer cached sequence).
@@ -198,6 +297,10 @@ class RadixPrefixCache:
             cands.append((tail.tokens, tail.block, None))
         for child in node.children.values():
             if child.key[0] != first:
+                continue
+            if child.spilled or child.ready_step > step:
+                # host-tier blocks have no device block to COW from, and
+                # a restoring one isn't usable until its ready step
                 continue
             cands.append((child.key, child.block, child))
         best_block, best_lcp, best_node = None, 0, None
@@ -287,6 +390,17 @@ class RadixPrefixCache:
                 self.blocks_held += 1
                 added += 1
                 node.children[key] = child
+            elif child.spilled:
+                # repatriate for free: the donor just recomputed this
+                # block's KV on device, so adopt its block and drop the
+                # host copy (resident beats spilled for the same prefix)
+                self._drop_host_payload(child)
+                child.block = int(blocks[i])
+                child.ready_step = 0
+                self._acquire([child.block])
+                self.blocks_held += 1
+                added += 1
+                child.last_use = step
             else:
                 child.last_use = step
             node = child
@@ -337,29 +451,153 @@ class RadixPrefixCache:
     # -- eviction -----------------------------------------------------------
 
     def _evictable(self, protect_step: Optional[int]) -> List[_Node]:
-        """Every currently-evictable node, sorted LRU-first by
-        (last_use, seq): a LEAF (no children), or any node carrying tail
-        entries — evicting an interior node would orphan its children's
-        prefix.  A node with tails is one candidate per round (each
-        selection drops its LRU tail)."""
+        """Every node holding a device unit that may be reclaimed, sorted
+        LRU-first by (last_use, seq): any node carrying tail entries, or
+        a RESIDENT node none of whose children are resident — evicting a
+        node with resident children would orphan their prefix, while
+        all-spilled children survive a spill (the chain stays walkable)
+        but not a drop (see :meth:`_drop_node`).  A node with tails is
+        one candidate per round (each selection drops its LRU tail)."""
         out: List[_Node] = []
         stack = [self._root]
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
-            if n is self._root and not n.tails:
-                continue
-            if not ((not n.children) or n.tails):
-                continue
             if protect_step is not None and n.last_use >= protect_step:
+                continue
+            if n.tails:
+                out.append(n)
+                continue
+            if n is self._root or n.spilled:
+                continue  # no device block of its own to reclaim
+            if any(not c.spilled for c in n.children.values()):
                 continue
             out.append(n)
         out.sort(key=lambda n: (n.last_use, n.seq))
         return out
 
+    def _drop_host_payload(self, node: _Node):
+        """Release a node's host-tier accounting (payload + spilled
+        flag).  Keyed on ``spilled``, not the payload: a victim marked
+        mid-round counts bytes before its batched gather lands, and must
+        release them if trimmed in that same window."""
+        if node.spilled:
+            node.spilled = False
+            node.host_kv = None
+            self.host_bytes_held -= self.block_bytes
+            self.host_blocks_held -= 1
+
+    def _drop_node(self, victim: _Node):
+        """Remove ``victim`` from the trie, releasing its device block.
+        Its children (all spilled by selection) lose their prefix with
+        it: the whole spilled subtree's host payloads and tail blocks
+        are dropped too."""
+        self._release([victim.block])
+        self.blocks_held -= 1
+        self.evictions_total += 1
+        if victim.parent is not None:
+            del victim.parent.children[victim.key]
+        stack = list(victim.children.values())
+        victim.children.clear()
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.spilled:
+                self._drop_host_payload(n)
+                self.host_dropped_blocks_total += 1
+            else:  # unreachable by the selection invariant; stay safe
+                self._release([n.block])
+                self.blocks_held -= 1
+                self.evictions_total += 1
+            if n.tails:
+                self._release([t.block for t in n.tails.values()])
+                self.blocks_held -= len(n.tails)
+                self.evictions_total += len(n.tails)
+                n.tails.clear()
+
+    def _spilled_leaves_lru(self) -> List[_Node]:
+        """Spilled nodes with no children, LRU-first — the host tier's
+        trim candidates (dropping a childless spilled node orphans
+        nothing; its parent becomes the next candidate)."""
+        out: List[_Node] = []
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.spilled and not n.children:
+                out.append(n)
+        out.sort(key=lambda n: (n.last_use, n.seq))
+        return out
+
+    def _trim_host_one(
+        self,
+        before: Optional[Tuple[int, int]] = None,
+        cands: Optional[List[_Node]] = None,
+    ) -> bool:
+        """Drop the LRU childless spilled node from the host tier; with
+        ``before`` only if it is strictly LRU-older than that
+        (last_use, seq) key — the cross-tier LRU gate for admitting a
+        new spill into a full budget.  Returns True iff dropped.
+
+        ``cands`` is a mutable LRU list one reclamation round reuses
+        across its trims (entries are re-validated before use, and a
+        parent that just became a childless spilled leaf is pushed back
+        in) — without it every saturated-budget spill would pay a full
+        trie DFS + sort on the admission hot path."""
+        if cands is None:
+            cands = self._spilled_leaves_lru()
+        while cands:
+            victim = cands[0]
+            if not (
+                victim.spilled
+                and not victim.children
+                and victim.parent is not None
+                and victim.parent.children.get(victim.key) is victim
+            ):
+                cands.pop(0)  # stale: dropped/repatriated since collected
+                continue
+            if before is not None and (
+                victim.last_use, victim.seq
+            ) >= before:
+                return False
+            cands.pop(0)
+            self._drop_host_payload(victim)
+            self.host_dropped_blocks_total += 1
+            if victim.tails:
+                self._release([t.block for t in victim.tails.values()])
+                self.blocks_held -= len(victim.tails)
+                self.evictions_total += len(victim.tails)
+                victim.tails.clear()
+            parent = victim.parent
+            del parent.children[victim.key]
+            if parent.spilled and not parent.children:
+                _insort_lru(cands, parent)
+            return True
+        return False
+
+    def _spill_admissible(
+        self, victim: _Node, cands: Optional[List[_Node]] = None
+    ) -> bool:
+        """May ``victim``'s block enter the host tier?  Yes while the
+        byte budget has headroom; on a full budget only by trimming a
+        strictly LRU-older spilled entry first (LRU spans both tiers —
+        a newcomer never displaces a hotter host entry).  ``cands`` is
+        the round's shared trim list (see :meth:`_trim_host_one`)."""
+        if not self._host_enabled or victim.block < 0:
+            return False
+        while (
+            self.host_bytes_held + self.block_bytes > self.host_bytes_budget
+        ):
+            if not self._trim_host_one(
+                before=(victim.last_use, victim.seq), cands=cands
+            ):
+                return False
+        return True
+
     def _evict_node(self, victim: _Node):
         """Drop ONE unit from ``victim``: its LRU tail entry if any, else
-        the (leaf) node itself."""
+        the node itself (back-compat single-unit path — ``evict`` routes
+        block-holding victims through the spill batch instead)."""
         if victim.tails:
             k = min(
                 victim.tails,
@@ -368,18 +606,23 @@ class RadixPrefixCache:
                 ),
             )
             self._release([victim.tails.pop(k).block])
+            self.blocks_held -= 1
+            self.evictions_total += 1
         else:
-            self._release([victim.block])
-            if victim.parent is not None:
-                del victim.parent.children[victim.key]
-        self.blocks_held -= 1
-        self.evictions_total += 1
+            self._drop_node(victim)
 
     def evict(self, n_blocks: int, protect_step: Optional[int] = None) -> int:
-        """Drop up to ``n_blocks`` cached units LRU-first, releasing the
-        cache's references; returns how many were freed (0 = nothing
-        evictable).  ONE trie walk serves a whole reclamation round —
-        the per-victim-DFS cost of repeated single evictions was
+        """Reclaim up to ``n_blocks`` device units LRU-first, releasing
+        the cache's references; returns how many were freed (0 = nothing
+        evictable).  With the host tier enabled, full-block victims
+        SPILL instead of dying: they are marked spilled during selection
+        and their KV is gathered to host in ONE batched ``spill_fetch``
+        per call (per reclamation round) before the device references
+        are released.  Tail entries never spill (they are by-value
+        partial blocks) and victims the budget rejects are dropped.
+
+        ONE trie walk serves a whole reclamation round — the
+        per-victim-DFS cost of repeated single evictions was
         O(evicted x trie) on the admission hot path.  A round's
         evictions can make parents newly evictable, so the walk repeats
         only while short AND progressing.  Only the cache's own
@@ -387,14 +630,92 @@ class RadixPrefixCache:
         resident in the pool until those rows finish — evicting a
         pinned prefix cannot corrupt it."""
         freed = 0
+        spill_nodes: List[_Node] = []
+        spill_blocks: List[int] = []
+        # the round's shared host-trim LRU list, built lazily on the
+        # first saturated-budget spill and maintained incrementally —
+        # one DFS+sort per round, not one per victim
+        trim_cands: Optional[List[_Node]] = None
         while freed < n_blocks:
             cands = self._evictable(protect_step)
             if not cands:
                 break
             for victim in cands[: n_blocks - freed]:
-                self._evict_node(victim)
+                if victim.tails:
+                    self._evict_node(victim)
+                    freed += 1
+                    continue
+                if (
+                    trim_cands is None
+                    and self._host_enabled
+                    and self.host_bytes_held + self.block_bytes
+                    > self.host_bytes_budget
+                ):
+                    trim_cands = self._spilled_leaves_lru()
+                if self._spill_admissible(victim, cands=trim_cands):
+                    # mark now so the next walk sees the parent as
+                    # spill-eligible; the payload lands in the batched
+                    # gather below and the device ref is released there
+                    victim.spilled = True
+                    victim.ready_step = 0
+                    spill_nodes.append(victim)
+                    spill_blocks.append(victim.block)
+                    self.host_bytes_held += self.block_bytes
+                    self.host_blocks_held += 1
+                    self.blocks_held -= 1
+                    if trim_cands is not None and not victim.children:
+                        # a later same-round spill may LRU-displace it
+                        _insort_lru(trim_cands, victim)
+                else:
+                    self._drop_node(victim)
                 freed += 1
+        if spill_nodes:
+            k_host, v_host = self._spill_fetch(spill_blocks)
+            for i, node in enumerate(spill_nodes):
+                if node.spilled:  # a later trim in this round may have
+                    # dropped it.  Per-block COPIES, not views: a view
+                    # would pin the round's whole padded gather buffer
+                    # for as long as ONE sibling survives, letting real
+                    # RSS outgrow host_bytes_held without bound under
+                    # trim churn
+                    node.host_kv = (k_host[i].copy(), v_host[i].copy())
+            self._release(spill_blocks)
+            self.spilled_blocks_total += len(spill_nodes)
         return freed
+
+    # -- host-tier restore (swap-in) ----------------------------------------
+
+    def begin_restore(self, nodes: Sequence[_Node]) -> List[Tuple[Any, Any]]:
+        """Host (k, v) payloads for ``nodes`` (an admission's
+        ``PrefixMatch.restore_nodes``), in order — the engine stacks
+        them, allocates destination pool blocks, and dispatches one
+        batched scatter (the async swap-in)."""
+        assert all(n.spilled and n.host_kv is not None for n in nodes)
+        return [n.host_kv for n in nodes]
+
+    def complete_restore(
+        self, nodes: Sequence[_Node], blocks: Sequence[int], ready_step: int
+    ):
+        """Hand restored ``nodes`` their fresh pool ``blocks`` (ownership
+        of the engine-allocated references transfers to the cache) and
+        gate their use on ``ready_step`` — the engine step after the
+        swap-in dispatch, so the requeued admission re-matches into a
+        resident prefix deterministically (step-keyed, never a device
+        readiness probe)."""
+        for node, blk in zip(nodes, blocks):
+            self._drop_host_payload(node)
+            node.block = int(blk)
+            node.ready_step = int(ready_step)
+        self.blocks_held += len(nodes)
+        self.restored_blocks_total += len(nodes)
+        if self.blocks_held > self.capacity_blocks:
+            # restores can overshoot the device budget; trim LRU-first but
+            # never what this very restore touched (ready_step - 1 is the
+            # step the triggering match stamped on the path)
+            self.evict(
+                self.blocks_held - self.capacity_blocks,
+                protect_step=int(ready_step) - 1,
+            )
 
     def evict_one(self, protect_step: Optional[int] = None) -> bool:
         """Drop the single LRU cached unit; False when nothing is
@@ -402,7 +723,8 @@ class RadixPrefixCache:
         return self.evict(1, protect_step=protect_step) == 1
 
     def flush(self, new_version: Optional[int] = None):
-        """Drop every entry (weight swap: all cached KV is stale) and move
+        """Drop every entry IN BOTH TIERS (weight swap: all cached KV —
+        device-resident and host-spilled alike — is stale) and move
         ``version`` (to ``new_version``, else +1) so inserts tagged with
         the pre-swap version are rejected."""
         blocks: List[int] = []
@@ -412,12 +734,17 @@ class RadixPrefixCache:
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
-            blocks.append(n.block)
+            if n.spilled:
+                self._drop_host_payload(n)
+                self.host_dropped_blocks_total += 1
+            else:
+                blocks.append(n.block)
             blocks.extend(t.block for t in n.tails.values())
         if blocks:
             self._release(blocks)
         self._root.children.clear()
         self.blocks_held = 0
+        assert self.host_bytes_held == 0 and self.host_blocks_held == 0
         self.version = (
             self.version + 1 if new_version is None else int(new_version)
         )
@@ -438,6 +765,18 @@ class RadixPrefixCache:
             "flushes_total": self.flushes_total,
             "blocks_held": self.blocks_held,
             "version": self.version,
+            # host spill tier (all zero while host_bytes_budget == 0)
+            "spilled_blocks_total": self.spilled_blocks_total,
+            "restored_blocks_total": self.restored_blocks_total,
+            "host_dropped_blocks_total": self.host_dropped_blocks_total,
+            "host_bytes_held": self.host_bytes_held,
+            "host_blocks_held": self.host_blocks_held,
+            # effective configuration — a mis-tuned fleet (e.g. the
+            # config-vs-engine min_match default split) is diagnosable
+            # from the metrics RPC instead of invisible at runtime
+            "min_match_tokens": self.min_match_tokens,
+            "capacity_blocks": self.capacity_blocks,
+            "host_bytes_budget": self.host_bytes_budget,
         }
 
     @staticmethod
@@ -453,4 +792,12 @@ class RadixPrefixCache:
             "flushes_total": 0,
             "blocks_held": 0,
             "version": 0,
+            "spilled_blocks_total": 0,
+            "restored_blocks_total": 0,
+            "host_dropped_blocks_total": 0,
+            "host_bytes_held": 0,
+            "host_blocks_held": 0,
+            "min_match_tokens": 0,
+            "capacity_blocks": 0,
+            "host_bytes_budget": 0,
         }

@@ -453,6 +453,46 @@ def paged_decode_chunk(
             budgets, rng)
 
 
+@jax.jit
+def gather_blocks(
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    src: jax.Array,  # [n] pool block ids to gather (pad with any valid id)
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather whole blocks out of the pool as ``[n, L, Hkv, BS, hd]``
+    pairs — the device half of a host-tier SPILL (the engine
+    ``device_get``s the result into host buffers, one batched fetch per
+    reclamation round).  NOT donated: the pool stays live."""
+    src = jnp.clip(src, 0, k_pool.shape[1] - 1)
+    return (
+        jnp.take(k_pool, src, axis=1).swapaxes(0, 1),
+        jnp.take(v_pool, src, axis=1).swapaxes(0, 1),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def restore_blocks(
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    k_host: jax.Array,  # [n, L, Hkv, BS, hd] spilled payloads (host-built)
+    v_host: jax.Array,
+    dst: jax.Array,  # [n] destination pool block ids (NB entries drop)
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter host-spilled block KV back into freshly allocated pool
+    blocks — the device half of a host-tier swap-in.  Dispatched async
+    like every pool op: the host->device transfer and scatter ride
+    under the decode chunks queued behind it in the in-flight ring, and
+    any later op consuming the (donated) pool is sequenced after it by
+    data dependence."""
+    k_pool = k_pool.at[:, dst].set(
+        k_host.swapaxes(0, 1).astype(k_pool.dtype), mode="drop"
+    )
+    v_pool = v_pool.at[:, dst].set(
+        v_host.swapaxes(0, 1).astype(v_pool.dtype), mode="drop"
+    )
+    return k_pool, v_pool
+
+
 @partial(jax.jit, donate_argnums=(0, 1))
 def copy_blocks(
     k_pool: jax.Array,

@@ -111,6 +111,37 @@ METRIC_TABLE = [
         "Pool blocks currently referenced by the radix prefix cache",
     ),
     MetricSpec(
+        "areal_inference_prefix_host_spilled_blocks_total",
+        "counter",
+        "Radix-cache blocks spilled from HBM into the host tier instead "
+        "of dying on eviction (batched device-to-host gather per "
+        "reclamation round)",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_host_restored_blocks_total",
+        "counter",
+        "Host-tier blocks swapped back into freshly allocated pool "
+        "blocks after a prefix match landed on a spilled entry (async "
+        "dispatch riding the decode ring's overlap)",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_host_dropped_blocks_total",
+        "counter",
+        "Host-tier entries dropped outright (byte-budget LRU trims, "
+        "orphaned spilled subtrees, weight-swap flushes)",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_host_bytes",
+        "gauge",
+        "Host memory currently held by spilled prefix-cache blocks "
+        "(bounded by prefix_cache_host_bytes)",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_host_blocks",
+        "gauge",
+        "Prefix-cache blocks currently resident in the host tier",
+    ),
+    MetricSpec(
         "areal_inference_spec_draft_tokens_total",
         "counter",
         "Draft tokens proposed by self-speculative n-gram drafting "

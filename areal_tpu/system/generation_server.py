@@ -135,6 +135,9 @@ class GenerationServerWorker(worker_base.Worker):
             prefix_cache=config.prefix_cache,
             prefix_cache_capacity_frac=config.prefix_cache_capacity_frac,
             prefix_cache_min_tokens=config.prefix_cache_min_match_tokens,
+            prefix_cache_host_bytes=getattr(
+                config, "prefix_cache_host_bytes", 0
+            ),
             spec_decode_params=resolve_spec_params(
                 getattr(config, "spec_decode", None)
             ),
@@ -246,6 +249,15 @@ class GenerationServerWorker(worker_base.Worker):
             "prefix_evictions": reg.counter(
                 "areal_inference_prefix_cache_evictions_total"
             ),
+            "prefix_host_spilled": reg.counter(
+                "areal_inference_prefix_host_spilled_blocks_total"
+            ),
+            "prefix_host_restored": reg.counter(
+                "areal_inference_prefix_host_restored_blocks_total"
+            ),
+            "prefix_host_dropped": reg.counter(
+                "areal_inference_prefix_host_dropped_blocks_total"
+            ),
             "spec_drafted": reg.counter(
                 "areal_inference_spec_draft_tokens_total"
             ),
@@ -277,6 +289,12 @@ class GenerationServerWorker(worker_base.Worker):
             "ring_depth": reg.gauge("areal_inference_ring_depth"),
             "inflight_chunks": reg.gauge("areal_inference_inflight_chunks"),
             "prefix_blocks": reg.gauge("areal_inference_prefix_cache_blocks"),
+            "prefix_host_bytes": reg.gauge(
+                "areal_inference_prefix_host_bytes"
+            ),
+            "prefix_host_blocks": reg.gauge(
+                "areal_inference_prefix_host_blocks"
+            ),
             "mesh_devices": reg.gauge("areal_inference_mesh_devices"),
         }
         self._obs_accept_hist = reg.histogram(
@@ -322,6 +340,11 @@ class GenerationServerWorker(worker_base.Worker):
             "prefix_misses": float(pstats["misses_total"]),
             "prefix_cached_tokens": float(pstats["cached_tokens_total"]),
             "prefix_evictions": float(pstats["evictions_total"]),
+            "prefix_host_spilled": float(pstats["spilled_blocks_total"]),
+            "prefix_host_restored": float(pstats["restored_blocks_total"]),
+            "prefix_host_dropped": float(
+                pstats["host_dropped_blocks_total"]
+            ),
             "spec_drafted": float(sstats["drafted_total"]),
             "spec_accepted": float(sstats["accepted_total"]),
             "spec_rejected": float(sstats["rejected_total"]),
@@ -354,6 +377,8 @@ class GenerationServerWorker(worker_base.Worker):
         self._obs["ring_depth"].set(eng.pipeline_depth)
         self._obs["inflight_chunks"].set(eng.inflight_chunks)
         self._obs["prefix_blocks"].set(pstats["blocks_held"])
+        self._obs["prefix_host_bytes"].set(pstats["host_bytes_held"])
+        self._obs["prefix_host_blocks"].set(pstats["host_blocks_held"])
         self._obs["mesh_devices"].set(eng.mesh_devices)
 
     # -- API ---------------------------------------------------------------

@@ -579,6 +579,175 @@ def bench_prefix_cache_ab(
     }
 
 
+def bench_prefix_cache_hier(
+    cfg,
+    params,
+    counts=(2, 8),
+    turns=2,
+    prompt_len=128,
+    user_len=24,
+    max_new=24,
+    page=32,
+    chunk=32,
+    capacity_frac=0.2,
+    pool_rows=4,
+    host_bytes=1 << 30,
+):
+    """Hierarchical prefix cache: cached-token-frac vs CONVERSATION COUNT
+    curves, host spill tier on vs off (engine/prefix_cache.py host tier).
+
+    The HBM radix cache is capped (``capacity_frac`` of a FIXED pool
+    sized for ``pool_rows`` rows), so as the conversation count grows
+    the working set of sessions evicts itself — exactly the chat-scale
+    failure the host tier exists for.  Sessions replay round-robin, one
+    at a time (pressure comes from the CACHE working set, not batch
+    concurrency), every turn re-sending the whole conversation under a
+    fresh qid.  With the tier OFF, overflowed prefixes die and returning
+    sessions re-prefill; ON, they spill to host and swap back in, so
+    ``cached_token_frac`` stays high as the count crosses the HBM
+    capacity — the curve pair IS the win.
+
+    Sub-arms are never silently capped: a (count, arm) cell that raises
+    is recorded as ``{"error": ...}`` and named in ``dropped``; parity
+    for that count is then reported as unverified, not assumed."""
+    import zlib
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine.sampling import SamplingParams
+
+    final_prompt = prompt_len + (turns - 1) * (max_new + user_len)
+    pool_tokens = pool_rows * bench_gen_cache_len(final_prompt, max_new)
+
+    def replay(eng, n_conv, tag):
+        """Round-robin conversation replay; returns (streams, row)."""
+        rngs = [
+            np.random.default_rng(zlib.crc32(f"{tag}s{s}".encode()))
+            for s in range(n_conv)
+        ]
+        convs = [
+            rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+            for rng in rngs
+        ]
+        streams = {}
+        prompt_toks = 0
+        gen_toks = 0
+        t0 = time.perf_counter()
+        for j in range(turns):
+            for s in range(n_conv):
+                qid = f"{tag}s{s}t{j}"
+                prompt_toks += len(convs[s])
+                eng.submit(
+                    APIGenerateInput(
+                        qid=qid,
+                        prompt_ids=convs[s],
+                        input_ids=convs[s],
+                        gconfig=GenerationHyperparameters(
+                            max_new_tokens=max_new, greedy=True
+                        ),
+                    )
+                )
+                while eng.has_work:
+                    eng.step()
+                out = eng.drain_results()[qid]
+                streams[(s, j)] = list(out.output_ids)
+                gen_toks += len(out.output_ids)
+                convs[s] = (
+                    convs[s]
+                    + list(out.output_ids)
+                    + rngs[s].integers(
+                        0, cfg.vocab_size, (user_len,)
+                    ).tolist()
+                )
+        return streams, {
+            "replay_s": round(time.perf_counter() - t0, 3),
+            "generated_tokens": int(gen_toks),
+            "prompt_tokens_submitted": int(prompt_toks),
+        }
+
+    def arm(n_conv, tier_bytes, tag):
+        eng = make_engine(
+            cfg, params, 2, final_prompt, max_new, chunk=chunk,
+            cache_mode="paged",
+            page_size=page,
+            kv_pool_tokens=pool_tokens,
+            prefix_cache=True,
+            prefix_cache_capacity_frac=capacity_frac,
+            prefix_cache_host_bytes=tier_bytes,
+            sampling=SamplingParams(greedy=True),
+        )
+        # parked rows would mask cache pressure (fresh-qid turns never
+        # resume them); TTL 0 releases a row the step after it parks
+        eng.park_ttl_steps = 0
+        streams, row = replay(eng, n_conv, tag)
+        st = eng.prefix_cache_stats()
+        row.update(
+            cached_token_frac=round(
+                st["cached_tokens_total"]
+                / max(row["prompt_tokens_submitted"], 1),
+                3,
+            ),
+            prefill_tokens=int(eng.prefill_tokens_total),
+            spilled_blocks=int(st["spilled_blocks_total"]),
+            restored_blocks=int(st["restored_blocks_total"]),
+            host_dropped_blocks=int(st["host_dropped_blocks_total"]),
+            evictions=int(st["evictions_total"]),
+        )
+        # leak audit: drain parked rows, flush both tiers, and require
+        # the pool pristine + zero host bytes (tier-1 asserts this)
+        eng.step()
+        eng.step()
+        eng._prefix_cache.flush()
+        st = eng.prefix_cache_stats()
+        row["leak_free"] = bool(
+            eng.free_pool_blocks == eng.n_blocks
+            and st["host_bytes_held"] == 0
+            and st["host_blocks_held"] == 0
+        )
+        cap = eng._prefix_cache.capacity_blocks
+        del eng
+        return streams, row, cap
+
+    out = {
+        "counts": list(counts),
+        "turns": turns,
+        "prompt_len": prompt_len,
+        "user_len": user_len,
+        "max_new": max_new,
+        "page_size": page,
+        "capacity_frac": capacity_frac,
+        "pool_tokens": pool_tokens,
+        "host_bytes": host_bytes,
+        "sweep": {},
+        "dropped": [],
+    }
+    for n_conv in counts:
+        cell = {}
+        arms = {}
+        for name, tier_bytes in (("host_on", host_bytes), ("host_off", 0)):
+            try:
+                streams, row, cap = arm(n_conv, tier_bytes, f"c{n_conv}")
+                arms[name] = streams
+                cell[name] = row
+                out["capacity_blocks"] = cap
+            except Exception as e:  # noqa: BLE001 - a cell is data
+                cell[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                out["dropped"].append(f"c{n_conv}/{name}")
+        if len(arms) == 2:
+            cell["token_parity"] = arms["host_on"] == arms["host_off"]
+            cell["cached_token_frac_gain"] = round(
+                cell["host_on"]["cached_token_frac"]
+                - cell["host_off"]["cached_token_frac"],
+                3,
+            )
+        else:
+            cell["token_parity"] = None  # unverified, not assumed
+        out["sweep"][f"c{n_conv}"] = cell
+    return out
+
+
 def bench_slo_report(
     cfg,
     params,
@@ -1727,6 +1896,7 @@ SUMMARY_REQUIRED_KEYS = (
     "ring_ab",
     "prefill_ab",
     "prefix_cache_ab",
+    "prefix_cache_hier",
     "trace_overhead_ab",
     "spec_decode_ab",
     "slo_report",
@@ -1743,6 +1913,7 @@ def build_summary(
     gen,
     prefill_ab=None,
     prefix_cache_ab=None,
+    prefix_cache_hier=None,
     trace_overhead_ab=None,
     spec_decode_ab=None,
     slo_report=None,
@@ -1779,6 +1950,7 @@ def build_summary(
         else None,
         "prefill_ab": prefill_ab,
         "prefix_cache_ab": prefix_cache_ab,
+        "prefix_cache_hier": prefix_cache_hier,
         "trace_overhead_ab": trace_overhead_ab,
         "spec_decode_ab": spec_decode_ab,
         "slo_report": slo_report,
@@ -2539,6 +2711,27 @@ def main():
         ),
     )
 
+    # hierarchical prefix cache: cached-token-frac vs conversation-count
+    # curves with the host spill tier on vs off, on a sweep that
+    # overflows the HBM cache.  Runs off-TPU too — tiny shapes — so the
+    # summary always carries the curve pair.
+    mark("prefix cache hier")
+    prefix_cache_hier = _section(
+        bench_prefix_cache_hier,
+        cfg,
+        gen_params,
+        name="prefix_cache_hier",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                counts=(2, 4), turns=2, prompt_len=48, user_len=8,
+                max_new=8, page=16, chunk=16, capacity_frac=0.1,
+                pool_rows=3,
+            )
+        ),
+    )
+
     # request-level SLO report: fleet-merged TTFT/TPOT percentiles under
     # the multi-turn replay + spec-decode workloads, digest-merge
     # cross-check, and the SLO-tracking on/off overhead A/B (<2% bar).
@@ -2801,6 +2994,7 @@ def main():
         gen,
         prefill_ab=prefill_ab,
         prefix_cache_ab=prefix_cache_ab,
+        prefix_cache_hier=prefix_cache_hier,
         trace_overhead_ab=trace_overhead_ab,
         spec_decode_ab=spec_decode_ab,
         slo_report=slo_report,
@@ -2861,6 +3055,7 @@ def main():
                     "interruption": interruption,
                     "prefix_reuse": prefix_reuse,
                     "prefix_cache_ab": prefix_cache_ab,
+                    "prefix_cache_hier": prefix_cache_hier,
                     "trace_overhead_ab": trace_overhead_ab,
                     "spec_decode_ab": spec_decode_ab,
                     "slo_report": slo_report,

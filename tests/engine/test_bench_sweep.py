@@ -313,6 +313,17 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
         gen,
         prefill_ab=None,
         prefix_cache_ab={"replay_wall_speedup": 1.5},
+        prefix_cache_hier={
+            "sweep": {
+                "c8": {
+                    "host_on": {"cached_token_frac": 0.61},
+                    "host_off": {"cached_token_frac": 0.22},
+                    "token_parity": True,
+                    "cached_token_frac_gain": 0.39,
+                }
+            },
+            "dropped": [],
+        },
         trace_overhead_ab=None,
         spec_decode_ab=spec_ab,
         train_packing_ab={
@@ -358,6 +369,13 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
     assert blob["slo_report"]["overhead_ab"]["overhead_frac_vs_off"] == 0.01
     assert blob["weight_swap_ab"]["staged_below_full_all"] is True
     assert blob["train_packing_ab"]["padded_slots_ratio"] == 3.3
+    hier = blob["prefix_cache_hier"]["sweep"]["c8"]
+    assert hier["token_parity"] is True
+    assert (
+        hier["host_on"]["cached_token_frac"]
+        > hier["host_off"]["cached_token_frac"]
+    )
+    assert blob["prefix_cache_hier"]["dropped"] == []
     assert blob["weight_swap_ab"]["dense"]["staged_pause_ms"] < (
         blob["weight_swap_ab"]["dense"]["full_pause_ms"]
     )

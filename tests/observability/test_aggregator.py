@@ -40,6 +40,11 @@ def three_live_workers():
     gen.counter("areal_inference_host_seconds_total").inc(0.25)
     gen.counter("areal_inference_device_seconds_total").inc(1.5)
     gen.counter("areal_inference_fetch_seconds_total").inc(0.5)
+    # hierarchical prefix cache: the host-tier series a gen server
+    # exports (spill/restore counters + resident-bytes gauge)
+    gen.counter("areal_inference_prefix_host_spilled_blocks_total").inc(6)
+    gen.counter("areal_inference_prefix_host_restored_blocks_total").inc(2)
+    gen.gauge("areal_inference_prefix_host_bytes").set(4096.0)
 
     servers = []
     for wname, reg in (
@@ -89,6 +94,25 @@ def test_discovers_and_scrapes_three_live_workers(
     assert (
         flat["cluster/gen_server_0/areal_inference_device_seconds_total"]
         == 1.5
+    )
+    # the host-tier spill/restore/bytes series survive the scrape cycle
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_prefix_host_spilled_blocks_total"
+        ]
+        == 6.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_prefix_host_restored_blocks_total"
+        ]
+        == 2.0
+    )
+    assert (
+        flat["cluster/gen_server_0/areal_inference_prefix_host_bytes"]
+        == 4096.0
     )
     # histogram buckets are dropped from the flat view (sum/count kept)
     assert not any("_bucket" in k for k in flat)

@@ -4,6 +4,7 @@ post-train weight publication hot-swapping the generation servers
 (the reference's boba asynchronous pipeline, SURVEY.md §3.1/3.2)."""
 
 import numpy as np
+import pytest
 
 from tests.fixtures import (  # noqa: F401
     dataset,
@@ -40,6 +41,9 @@ def test_async_ppo_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch):
     assert "actor_train/kl" in s
 
 
+@pytest.mark.slow  # ~37s full e2e; tier-1 keeps test_async_ppo_e2e as the
+# launch-path smoke and tests/verifiers/test_code_verify.py as the
+# sandboxed-verifier smoke
 def test_async_ppo_mixed_math_code(
     mixed_dataset_path, tokenizer_path, tmp_path, monkeypatch
 ):
@@ -63,6 +67,9 @@ def test_async_ppo_mixed_math_code(
     assert np.isfinite(master.stats_history[-1]["actor_train/loss"])
 
 
+@pytest.mark.slow  # ~63s full e2e (tripped the 60s runtime guard);
+# tier-1 keeps test_async_ppo_e2e as the launch-path smoke and
+# tests/agents/test_math_multi_turn_agent.py as the multi-turn smoke
 def test_async_ppo_multi_turn_agent(
     dataset_path, tokenizer_path, tmp_path, monkeypatch
 ):

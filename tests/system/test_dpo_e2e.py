@@ -3,6 +3,7 @@
 master/model-worker machinery (same harness as test_sft_e2e)."""
 
 import numpy as np
+import pytest
 
 from tests.fixtures import (  # noqa: F401
     dataset,
@@ -13,6 +14,9 @@ from tests.fixtures import (  # noqa: F401
 )
 
 
+@pytest.mark.slow  # ~35s full e2e; tier-1 keeps the DPO training math in
+# tests/engine/test_dpo_interface.py and the same master/model-worker
+# launch harness in test_sft_e2e / test_async_ppo_e2e
 def test_dpo_experiment_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch):
     monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
     monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
