@@ -179,6 +179,16 @@ class GenServerConfig:
     cache_mode: str = "auto"
     page_size: int = 1024
     kv_pool_tokens: Optional[int] = None
+    # paged KV storage dtype (the SGLang/vLLM --kv-cache-dtype knob):
+    # "auto" stores blocks at model dtype (bit-for-bit today's
+    # behavior); "int8" stores quantized pools with per-(block, head,
+    # slot) f32 scales alongside — ~half the HBM per cached token (~2x
+    # live rows / prefix-cache capacity / half-cost host spills at the
+    # same budget), reads dequantize inline so the error is
+    # storage-only.  Quality is MEASURED, not assumed: bench.py's
+    # kv_quant_ab section reports the greedy divergence rate per
+    # workload and the fleet exports areal_inference_kv_quant_* series.
+    kv_cache_dtype: str = "auto"
     prefill_chunk_tokens: int = 1024
     # cross-request radix prefix cache over the paged pool (default on
     # for paged mode; engine/prefix_cache.py): finished/parked sequences'

@@ -394,6 +394,7 @@ class ContinuousBatchingEngine:
         cache_mode: str = "auto",
         page_size: int = 1024,
         kv_pool_tokens: Optional[int] = None,
+        kv_cache_dtype: str = "auto",
         prefill_chunk_tokens: int = 1024,
         pipeline_depth: int = 2,
         dispatch_table: Optional[PagedDispatchTable] = None,
@@ -459,6 +460,19 @@ class ContinuousBatchingEngine:
         preemption) and the whole cache flushes on ``update_weights`` —
         KV computed under old weights is never reused after a swap.
 
+        ``kv_cache_dtype`` ("auto" | "int8", paged mode only): "auto"
+        stores KV blocks at model dtype (today's behavior, bit-for-bit);
+        "int8" stores the pools quantized with per-(block, head, slot)
+        float32 scales alongside (models/paged.py) — roughly half the
+        HBM per cached token, so ~2x live rows / prefix-cache capacity
+        at the same pool budget, at the cost of storage-rounding error
+        (reads dequantize inline; attention math stays in model dtype).
+        Every pool path carries the scales: fill/decode/verify writes
+        quantize at the scatter, COW tail copies, host-tier spills, and
+        swap-ins move int8 bytes + scales together.  The bench's
+        kv_quant_ab section measures the token-quality delta; dense
+        mode ignores the knob with a warning.
+
         ``prefix_cache_host_bytes`` > 0 adds the HOST SPILL TIER below
         the HBM cache (the SGLang hierarchical/HiCache direction):
         evicted full-block entries copy their KV to host buffers (one
@@ -489,6 +503,26 @@ class ContinuousBatchingEngine:
             and kv_cache_len >= self.dispatch_table.paged_min_cache_len
             and cfg.sliding_window is None
         )
+        assert kv_cache_dtype in ("auto", "int8"), kv_cache_dtype
+        if kv_cache_dtype == "int8" and not self.paged:
+            logger.warning(
+                "kv_cache_dtype='int8' requested but cache_mode resolved "
+                "to dense; quantized KV storage lives on the paged path "
+                "only — serving at model dtype"
+            )
+            kv_cache_dtype = "auto"
+        self.kv_cache_dtype = kv_cache_dtype
+        self._kv_quant = kv_cache_dtype == "int8"
+        # scale pools exist only for int8 paged storage; None everywhere
+        # else so every pool call site can pass them unconditionally
+        self.k_scale: Optional[jax.Array] = None
+        self.v_scale: Optional[jax.Array] = None
+        # quantized-serving quality counters: external parity harnesses
+        # (bench kv_quant_ab, tests) fold their greedy divergence checks
+        # in here so the fleet's metrics carry measured quality, not
+        # assumptions
+        self.kv_quant_divergence_checks_total = 0
+        self.kv_quant_divergence_diverged_total = 0
         if self.paged and cfg.sliding_window is not None:
             raise ValueError(
                 "paged cache serves global-attention models; sliding-window "
@@ -497,6 +531,7 @@ class ContinuousBatchingEngine:
         self._param_shardings = None
         self._cache_sharding = None
         self._pool_sharding = None
+        self._pool_scale_sharding = None
         if mesh is not None:
             assert device is None, "pass mesh OR device, not both"
             from jax.sharding import NamedSharding
@@ -545,6 +580,10 @@ class ContinuousBatchingEngine:
             # paged pool [L, NB, Hkv, BS, hd]: shard the kv-head axis too
             self._pool_sharding = NamedSharding(
                 mesh, P(None, None, kv_axis, None, None)
+            )
+            # int8 scale pools [L, NB, Hkv, BS] shard the same head axis
+            self._pool_scale_sharding = NamedSharding(
+                mesh, P(None, None, kv_axis, None)
             )
         elif device is not None:
             params = jax.device_put(params, device)
@@ -732,14 +771,27 @@ class ContinuousBatchingEngine:
         self._use_paged_kernel = (
             jax.default_backend() == "tpu" and cfg.head_dim % 128 == 0
         )
+        kv_dtype = self.kv_cache_dtype
         if self._pool_sharding is not None:
-            self.k_pool, self.v_pool = jax.jit(
-                lambda: paged.pool_zeros(cfg, self.n_blocks, BS),
-                out_shardings=(self._pool_sharding, self._pool_sharding),
-            )()
+            shardings = (self._pool_sharding, self._pool_sharding)
+            if self._kv_quant:
+                shardings += (
+                    self._pool_scale_sharding, self._pool_scale_sharding
+                )
+            else:
+                shardings += (None, None)  # None leaves: no sharding slot
+            alloc = jax.jit(
+                lambda: paged.alloc_kv_pool(
+                    cfg, self.n_blocks, BS, kv_cache_dtype=kv_dtype
+                ),
+                out_shardings=shardings,
+            )
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale) = alloc()
         else:
-            self.k_pool, self.v_pool = paged.pool_zeros(
-                cfg, self.n_blocks, BS
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale) = (
+                paged.alloc_kv_pool(
+                    cfg, self.n_blocks, BS, kv_cache_dtype=kv_dtype
+                )
             )
         self.kv_lengths = jnp.zeros((max_batch,), jnp.int32)
         self._tables_np = np.zeros(
@@ -770,11 +822,12 @@ class ContinuousBatchingEngine:
                     jax.process_count(),
                 )
                 host_bytes = 0
-            # one full block's k+v footprint — the host budget's unit
-            block_bytes = int(
-                2 * cfg.n_layers * cfg.n_kv_heads * BS * cfg.head_dim
-                * jnp.dtype(self.k_pool.dtype).itemsize
-            )
+            # one full block's k+v footprint — the host budget's unit.
+            # Derived from the POOL ARRAYS' actual itemsize (not the
+            # model dtype): an int8 pool's block is half the bytes and
+            # carries its f32 scale slices, so spilled prefixes cost
+            # their true host RAM and the budget admits ~2x the blocks.
+            block_bytes = self._pool_block_bytes()
             self._prefix_cache = RadixPrefixCache(
                 page_size=BS,
                 capacity_blocks=int(
@@ -828,6 +881,67 @@ class ContinuousBatchingEngine:
 
         self._paged_sample_fn = _sample
         self._paged_stop_fn = _stop
+
+    # -- quantized KV storage helpers ---------------------------------------
+
+    def _pool_arrays(self) -> List[jax.Array]:
+        """The paged pool's storage arrays: (k, v) plus the scale pools
+        when the storage is int8-quantized."""
+        arrs = [self.k_pool, self.v_pool]
+        if self.k_scale is not None:
+            arrs += [self.k_scale, self.v_scale]
+        return arrs
+
+    def _pool_block_bytes(self) -> int:
+        """One pool block's true byte footprint, derived from the
+        allocated arrays' itemsize (int8 data + f32 scales for quantized
+        pools, model dtype otherwise) — the unit every byte account
+        (host spill budget, capacity math) must use."""
+        return sum(int(a.nbytes) for a in self._pool_arrays()) // max(
+            self.n_blocks, 1
+        )
+
+    def _copy_pool_blocks(self, src: np.ndarray, dst: np.ndarray):
+        """COW block copies (group tails, prefix-cache tail matches);
+        int8 pools carry the scale slices with the bytes."""
+        out = paged.copy_blocks(
+            self.k_pool, self.v_pool, jnp.asarray(src), jnp.asarray(dst),
+            k_scale=self.k_scale, v_scale=self.v_scale,
+        )
+        if self._kv_quant:
+            self.k_pool, self.v_pool, self.k_scale, self.v_scale = out
+        else:
+            self.k_pool, self.v_pool = out
+
+    def note_kv_divergence_check(self, checked: int, diverged: int):
+        """Fold a measured greedy-divergence check (bench kv_quant_ab /
+        parity tests compare an int8 arm against an fp arm token by
+        token) into the engine's cumulative quality counters — the
+        ``areal_inference_kv_quant_*`` divergence series."""
+        self.kv_quant_divergence_checks_total += int(checked)
+        self.kv_quant_divergence_diverged_total += int(diverged)
+
+    def kv_quant_stats(self) -> Dict[str, int]:
+        """Quantized-KV storage counters (worker scrape + metrics RPC)."""
+        if self.paged:
+            bits = int(jnp.dtype(self.k_pool.dtype).itemsize) * 8
+            held = (
+                self.n_blocks - len(self._free_blocks)
+                if self._kv_quant
+                else 0
+            )
+        else:
+            bits = int(jnp.dtype(self.cache.k.dtype).itemsize) * 8
+            held = 0
+        return {
+            "quantized": int(self._kv_quant),
+            "storage_bits": bits,
+            "quantized_blocks_held": int(held),
+            "divergence_checks_total": self.kv_quant_divergence_checks_total,
+            "divergence_diverged_total": (
+                self.kv_quant_divergence_diverged_total
+            ),
+        }
 
     def _alloc_blocks(self, n: int) -> Optional[List[int]]:
         if len(self._free_blocks) < n:
@@ -896,18 +1010,21 @@ class ContinuousBatchingEngine:
         """Batched device->host gather of whole pool blocks (the cache's
         ``spill_fetch``): one jitted gather + one blocking ``device_get``
         per reclamation round, power-of-two padded so repeated rounds
-        reuse a handful of compiled shapes.  Returns host (k, v) arrays
-        indexed ``[i] -> blocks[i]``."""
+        reuse a handful of compiled shapes.  Returns host (k, v[, ks,
+        vs]) arrays indexed ``[i] -> blocks[i]`` — int8 pools spill the
+        quantized bytes plus their scale slices, half or less the host
+        RAM of a model-dtype spill."""
         n = len(blocks)
         n_pad = 1 << (n - 1).bit_length()
         idx = np.zeros((n_pad,), np.int32)
         idx[:n] = blocks
-        k, v = paged.gather_blocks(
-            self.k_pool, self.v_pool, jnp.asarray(idx)
+        out = paged.gather_blocks(
+            self.k_pool, self.v_pool, jnp.asarray(idx),
+            k_scale=self.k_scale, v_scale=self.v_scale,
         )
-        k, v = jax.device_get((k, v))
+        out = jax.device_get(out)
         self.host_spill_rounds_total += 1
-        return np.asarray(k)[:n], np.asarray(v)[:n]
+        return tuple(np.asarray(a)[:n] for a in out)
 
     def _restore_spilled(self, nodes, keep_qids=()) -> bool:
         """Swap spilled prefix blocks back into the pool: allocate fresh
@@ -927,21 +1044,31 @@ class ContinuousBatchingEngine:
             return False
         payloads = self._prefix_cache.begin_restore(nodes)
         n_pad = 1 << (n - 1).bit_length()
-        L, NB, Hkv, BS, hd = self.k_pool.shape
-        kh = np.zeros((n_pad, L, Hkv, BS, hd), self.k_pool.dtype)
-        vh = np.zeros_like(kh)
+        # stack each payload component (k, v[, k_scale, v_scale]) into
+        # one batched host buffer; component shapes/dtypes come from the
+        # payloads themselves so int8+scale spills restore bit-identically
+        stacked = []
+        for c, proto in enumerate(payloads[0]):
+            buf = np.zeros((n_pad,) + proto.shape, proto.dtype)
+            for i, payload in enumerate(payloads):
+                buf[i] = payload[c]
+            stacked.append(jnp.asarray(buf))
         dst = np.full((n_pad,), self.n_blocks, np.int32)  # pad -> dropped
-        for i, (kb, vb) in enumerate(payloads):
-            kh[i] = kb
-            vh[i] = vb
-            dst[i] = blocks[i]
-        self.k_pool, self.v_pool = paged.restore_blocks(
-            self.k_pool,
-            self.v_pool,
-            jnp.asarray(kh),
-            jnp.asarray(vh),
-            jnp.asarray(dst),
-        )
+        dst[:n] = blocks
+        if self._kv_quant:
+            kh, vh, ksh, vsh = stacked
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale) = (
+                paged.restore_blocks(
+                    self.k_pool, self.v_pool, kh, vh, jnp.asarray(dst),
+                    k_scale=self.k_scale, v_scale=self.v_scale,
+                    k_scale_host=ksh, v_scale_host=vsh,
+                )
+            )
+        else:
+            kh, vh = stacked
+            self.k_pool, self.v_pool = paged.restore_blocks(
+                self.k_pool, self.v_pool, kh, vh, jnp.asarray(dst)
+            )
         self._prefix_cache.complete_restore(
             nodes, blocks, ready_step=self._step_seq + 1
         )
@@ -1017,9 +1144,7 @@ class ContinuousBatchingEngine:
             # the donor's garbage and our suffix fill overwrites them)
             src = np.array([m.tail_block], np.int32)
             dst = np.array([blocks[0]], np.int32)
-            self.k_pool, self.v_pool = paged.copy_blocks(
-                self.k_pool, self.v_pool, jnp.asarray(src), jnp.asarray(dst)
-            )
+            self._copy_pool_blocks(src, dst)
             self._free_block_list([m.tail_block])  # copy taken: unpin
         return _Fill(
             key=tuple(seq),
@@ -1618,7 +1743,7 @@ class ContinuousBatchingEngine:
             starts[i] = f.fill_pos
             cls[i] = take
             tables[i, : len(f.blocks)] = f.blocks
-        logits, self.k_pool, self.v_pool = paged.paged_fill_chunk(
+        out = paged.paged_fill_chunk(
             self.params,
             self.k_pool,
             self.v_pool,
@@ -1630,7 +1755,14 @@ class ContinuousBatchingEngine:
             use_kernel=self._use_paged_kernel,
             mesh=self.mesh,
             kv_axis=getattr(self, "_kv_axis", None),
+            k_scale=self.k_scale,
+            v_scale=self.v_scale,
         )
+        if self._kv_quant:
+            (logits, self.k_pool, self.v_pool, self.k_scale,
+             self.v_scale) = out
+        else:
+            logits, self.k_pool, self.v_pool = out
         self.prefill_calls += 1
         self.prefill_tokens_total += int(cls.sum())
         completed, idxs = [], []
@@ -1761,9 +1893,7 @@ class ContinuousBatchingEngine:
             dst = np.full((n_pad,), self.n_blocks, np.int32)  # pad -> drop
             src[: len(copy_src)] = copy_src
             dst[: len(copy_dst)] = copy_dst
-            self.k_pool, self.v_pool = paged.copy_blocks(
-                self.k_pool, self.v_pool, jnp.asarray(src), jnp.asarray(dst)
-            )
+            self._copy_pool_blocks(src, dst)
         if sample_targets:
             n = len(sample_targets)
             n_pad = 1 << (n - 1).bit_length()
@@ -2093,18 +2223,7 @@ class ContinuousBatchingEngine:
         if self._tables_dirty:
             self._tables = jnp.asarray(self._tables_np)
             self._tables_dirty = False
-        (
-            self.k_pool,
-            self.v_pool,
-            self.kv_lengths,
-            out_t,
-            out_l,
-            emitted,
-            cur,
-            self.active,
-            self.budgets,
-            _,
-        ) = paged.paged_decode_chunk(
+        out = paged.paged_decode_chunk(
             self.params,
             self.k_pool,
             self.v_pool,
@@ -2126,7 +2245,23 @@ class ContinuousBatchingEngine:
             kv_axis=getattr(self, "_kv_axis", None),
             deep_kernel=self._use_deep_kernel(),
             row_seeds=self.row_seeds,
+            k_scale=self.k_scale,
+            v_scale=self.v_scale,
         )
+        if self._kv_quant:
+            self.k_scale, self.v_scale = out[10], out[11]
+        (
+            self.k_pool,
+            self.v_pool,
+            self.kv_lengths,
+            out_t,
+            out_l,
+            emitted,
+            cur,
+            self.active,
+            self.budgets,
+            _,
+        ) = out[:10]
         self.cur_tokens = cur
         self._enqueue_chunk(
             out_t, out_l, emitted, self.active, self.cur_tokens, snapshot
@@ -2243,17 +2378,7 @@ class ContinuousBatchingEngine:
         if self._tables_dirty:
             self._tables = jnp.asarray(self._tables_np)
             self._tables_dirty = False
-        (
-            self.k_pool,
-            self.v_pool,
-            self.kv_lengths,
-            out_t,
-            out_l,
-            emitted,
-            cur,
-            self.active,
-            self.budgets,
-        ) = spec_decode.paged_verify_chunk(
+        out = spec_decode.paged_verify_chunk(
             self.params,
             self.k_pool,
             self.v_pool,
@@ -2273,7 +2398,22 @@ class ContinuousBatchingEngine:
             max_len=self.kv_cache_len,
             mesh=self.mesh,
             kv_axis=getattr(self, "_kv_axis", None),
+            k_scale=self.k_scale,
+            v_scale=self.v_scale,
         )
+        if self._kv_quant:
+            self.k_scale, self.v_scale = out[9], out[10]
+        (
+            self.k_pool,
+            self.v_pool,
+            self.kv_lengths,
+            out_t,
+            out_l,
+            emitted,
+            cur,
+            self.active,
+            self.budgets,
+        ) = out[:9]
         self.cur_tokens = cur
         self.spec_verify_chunks_total += 1
         self.spec_drafted_total += int(draft_lens.sum())

@@ -163,10 +163,13 @@ class RadixPrefixCache:
     handful of cached tokens costs more than it saves.
 
     ``host_bytes_budget`` > 0 enables the host spill tier (see module
-    docstring): ``block_bytes`` is one full block's k+v footprint (the
-    budget's accounting unit) and ``spill_fetch(blocks) -> (k, v)`` is
-    the engine's batched device->host gather, returning per-block host
-    payloads indexed ``[i] -> blocks[i]``.
+    docstring): ``block_bytes`` is one full block's TRUE storage
+    footprint (derived by the engine from the pool arrays' itemsize —
+    int8 data + scales for quantized pools — the budget's accounting
+    unit) and ``spill_fetch(blocks)`` is the engine's batched
+    device->host gather, returning a tuple of per-block host arrays
+    (``(k, v)``, plus scale components for quantized pools) indexed
+    ``[i] -> blocks[i]``; the cache round-trips the tuple opaquely.
     """
 
     def __init__(
@@ -670,7 +673,10 @@ class RadixPrefixCache:
                     self._drop_node(victim)
                 freed += 1
         if spill_nodes:
-            k_host, v_host = self._spill_fetch(spill_blocks)
+            # component tuple: (k, v) for model-dtype pools, (k, v,
+            # k_scale, v_scale) for int8 pools — the cache is agnostic
+            # and round-trips whatever the engine's gather produced
+            payload = self._spill_fetch(spill_blocks)
             for i, node in enumerate(spill_nodes):
                 if node.spilled:  # a later trim in this round may have
                     # dropped it.  Per-block COPIES, not views: a view
@@ -678,7 +684,7 @@ class RadixPrefixCache:
                     # for as long as ONE sibling survives, letting real
                     # RSS outgrow host_bytes_held without bound under
                     # trim churn
-                    node.host_kv = (k_host[i].copy(), v_host[i].copy())
+                    node.host_kv = tuple(a[i].copy() for a in payload)
             self._release(spill_blocks)
             self.spilled_blocks_total += len(spill_nodes)
         return freed

@@ -224,6 +224,7 @@ class SpecRowState:
         "max_len", "mesh", "kv_axis",
     ),
     donate_argnums=(1, 2),
+    donate_argnames=("k_scale", "v_scale"),
 )
 def paged_verify_chunk(
     params,
@@ -245,6 +246,8 @@ def paged_verify_chunk(
     max_len: int,
     mesh=None,
     kv_axis=None,
+    k_scale=None,  # [L, NB, Hkv, BS] int8-pool scales (None = fp pool)
+    v_scale=None,
 ):
     """Batched draft verification: ONE paged-prefill pass over each
     participating row's window ``[cur, d_1..d_k]`` with greedy targets,
@@ -252,11 +255,13 @@ def paged_verify_chunk(
     verify chunk chains through the engine's in-flight ring exactly like
     a decode chunk (same output signature/semantics: ``out_t``/``out_l``
     /``emitted`` columns are the emitted tokens in order, ``cur``/
-    ``active``/``budgets``/``lengths`` advance for the next dispatch).
+    ``active``/``budgets``/``lengths`` advance for the next dispatch;
+    ``(k_scale, v_scale)`` append on a quantized pool).
 
     Non-participant rows pass through untouched.  Window KV scatters
-    into the rows' own pre-covered blocks; positions at/beyond
-    ``max_len`` are masked (never clipped into a foreign block).
+    into the rows' own pre-covered blocks (quantized at the scatter on
+    an int8 pool, like any fill); positions at/beyond ``max_len`` are
+    masked (never clipped into a foreign block).
     """
     B = cur_tokens.shape[0]
     C = max_draft + 1
@@ -268,9 +273,10 @@ def paged_verify_chunk(
         & (iot[None, :] <= draft_lens[:, None])
         & ((lengths[:, None] + iot[None, :]) < max_len)
     )  # [B, C] positions forwarded + scattered
-    x, k_pool, v_pool = paged.paged_window_forward(
+    x, k_pool, v_pool, k_scale, v_scale = paged.paged_window_forward(
         params, k_pool, v_pool, cfg, window, lengths, valid, tables,
         use_kernel=use_kernel, mesh=mesh, kv_axis=kv_axis,
+        k_scale=k_scale, v_scale=v_scale,
     )
 
     # greedy targets + behavioral logprobs per window position, scanned
@@ -335,7 +341,10 @@ def paged_verify_chunk(
     new_active = jnp.where(participants, cont, active)
     out_t = jnp.where(emitted, tgt, 0)
     out_l = jnp.where(emitted, logp, 0.0)
-    return (
+    base = (
         k_pool, v_pool, new_lengths, out_t, out_l, emitted, new_cur,
         new_active, new_budgets,
     )
+    if k_scale is None:
+        return base
+    return base + (k_scale, v_scale)

@@ -25,6 +25,22 @@ allocator; this module owns the device-side compute:
 Every function threads the pool through donated jit args; the layered
 kernel entry reads blocks straight from the stacked pool so no per-layer
 pool slice is ever materialized.
+
+**Quantized KV storage** (``kv_cache_dtype="int8"``): the k/v pools
+store int8 with a float32 scale pool ``[L, NB, Hkv, BS]`` alongside —
+one absmax scale per (block, head, page slot).  The slot axis is what
+makes append-only pages exact: a single per-(block, head) scale would
+need a read-modify-write requantization of the whole block every time
+decode appends one token to the tail page, while per-slot scales let
+every write path quantize just the values it scatters.  Writes quantize
+at insert (:func:`quantize_kv` before the pool scatter in
+:func:`paged_window_forward` / :func:`paged_decode_chunk`'s chunk-end
+merge); reads dequantize inline right after the block gather (the jnp
+reference path and both Pallas kernels multiply by scales before the
+attention dots), so attention math stays in model dtype and the
+accuracy loss is storage-only.  Every function below accepts optional
+``k_scale``/``v_scale`` operands (None = unquantized, today's
+behavior) and returns them updated whenever it returns the pools.
 """
 
 from __future__ import annotations
@@ -70,12 +86,70 @@ def pool_zeros(
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+#: int8 symmetric absmax range (one sign bit + 7 magnitude bits; -128 is
+#: never produced so quantize/dequantize round-trips are symmetric)
+KV_QUANT_MAX = 127.0
+
+
+def alloc_kv_pool(
+    cfg: TransformerConfig,
+    n_blocks: int,
+    block_size: int,
+    kv_cache_dtype: str = "auto",
+    dtype=None,
+) -> Tuple[jax.Array, jax.Array, Optional[jax.Array], Optional[jax.Array]]:
+    """Allocate the paged KV storage: ``(k_pool, v_pool, k_scale,
+    v_scale)``.
+
+    ``kv_cache_dtype="auto"`` keeps today's model-dtype pools (scales are
+    None); ``"int8"`` allocates int8 pools plus float32 scale pools
+    ``[L, NB, Hkv, BS]`` — one absmax scale per (block, head, page slot),
+    so the storage cost per cached token-head drops from ``2 * hd *
+    itemsize(model dtype)`` to ``2 * (hd + 4)`` bytes."""
+    if kv_cache_dtype == "auto":
+        k, v = pool_zeros(cfg, n_blocks, block_size, dtype=dtype)
+        return k, v, None, None
+    if kv_cache_dtype != "int8":
+        raise ValueError(
+            f"kv_cache_dtype must be 'auto' or 'int8', got {kv_cache_dtype!r}"
+        )
+    shape = (
+        cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim
+    )
+    sshape = shape[:-1]
+    return (
+        jnp.zeros(shape, jnp.int8),
+        jnp.zeros(shape, jnp.int8),
+        jnp.zeros(sshape, jnp.float32),
+        jnp.zeros(sshape, jnp.float32),
+    )
+
+
+def quantize_kv(vals: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 quantization over the trailing head_dim
+    axis: returns ``(int8 values, float32 scales)`` with scales shaped
+    like ``vals`` minus its last axis.  All-zero vectors quantize to
+    zeros with scale 0 (the dequant multiply reproduces them exactly)."""
+    v32 = vals.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(v32), axis=-1) / KV_QUANT_MAX
+    q = v32 / jnp.maximum(scale, 1e-30)[..., None]
+    q = jnp.clip(
+        jnp.round(q), -KV_QUANT_MAX, KV_QUANT_MAX
+    ).astype(jnp.int8)
+    return q, scale
+
+
 def _prefix_partials(
     q, k_pool, v_pool, tables, lengths, layer, use_kernel,
-    mesh=None, kv_axis=None, deep=False,
+    mesh=None, kv_axis=None, deep=False, k_scale=None, v_scale=None,
 ):
     """Paged-attention partials over each row's cached prefix.  ``q`` is
     [B, Q, Hq, hd]; returns (acc, m, l) with Q query tokens per row.
+
+    ``k_scale``/``v_scale`` mark an int8-quantized pool: both the kernel
+    and the jnp reference dequantize (multiply by the per-(block, head,
+    slot) scales) right after the block gather, so attention math is
+    identical to the unquantized path up to storage rounding.
 
     On a TP serving mesh the Pallas kernel has no SPMD partitioning rule,
     so it runs under an explicit ``shard_map``: the pool's kv-head axis
@@ -97,41 +171,78 @@ def _prefix_partials(
                 if layered
                 else P(None, kv_axis, None, None)
             )
+            scale_spec = (
+                P(None, None, kv_axis, None)
+                if layered
+                else P(None, kv_axis, None)
+            )
+            out_specs = (
+                P(None, None, kv_axis, None),
+                P(None, None, kv_axis),
+                P(None, None, kv_axis),
+            )
+            common = dict(mesh=mesh, out_specs=out_specs, check_rep=False)
+            if k_scale is None:
 
-            def kern(qq, kk, vv, tb, ln, ly):
+                def kern(qq, kk, vv, tb, ln, ly):
+                    return kernel_fn(
+                        qq, kk, vv, tb, ln, layer=ly, interpret=interp
+                    )
+
+                fn = shard_map(
+                    kern,
+                    in_specs=(
+                        P(None, None, kv_axis, None),
+                        pool_spec,
+                        pool_spec,
+                        P(None, None),
+                        P(None),
+                        P(None),
+                    ),
+                    **common,
+                )
+                return fn(
+                    q, k_pool, v_pool, tables, lengths,
+                    jnp.asarray(layer, jnp.int32).reshape(1),
+                )
+
+            def kern_q(qq, kk, vv, ks, vs, tb, ln, ly):
                 return kernel_fn(
-                    qq, kk, vv, tb, ln, layer=ly, interpret=interp
+                    qq, kk, vv, tb, ln, layer=ly, interpret=interp,
+                    k_scale=ks, v_scale=vs,
                 )
 
             fn = shard_map(
-                kern,
-                mesh=mesh,
+                kern_q,
                 in_specs=(
                     P(None, None, kv_axis, None),
                     pool_spec,
                     pool_spec,
+                    scale_spec,
+                    scale_spec,
                     P(None, None),
                     P(None),
                     P(None),
                 ),
-                out_specs=(
-                    P(None, None, kv_axis, None),
-                    P(None, None, kv_axis),
-                    P(None, None, kv_axis),
-                ),
-                check_rep=False,
+                **common,
             )
             return fn(
-                q, k_pool, v_pool, tables, lengths,
+                q, k_pool, v_pool, k_scale, v_scale, tables, lengths,
                 jnp.asarray(layer, jnp.int32).reshape(1),
             )
         return kernel_fn(
             q, k_pool, v_pool, tables, lengths, layer=layer,
-            interpret=interp,
+            interpret=interp, k_scale=k_scale, v_scale=v_scale,
         )
     kl = jax.lax.dynamic_index_in_dim(k_pool, layer, 0, keepdims=False)
     vl = jax.lax.dynamic_index_in_dim(v_pool, layer, 0, keepdims=False)
-    return reference_paged_partials(q, kl, vl, tables, lengths)
+    ksl = vsl = None
+    if k_scale is not None:
+        ksl = jax.lax.dynamic_index_in_dim(k_scale, layer, 0, keepdims=False)
+        vsl = jax.lax.dynamic_index_in_dim(v_scale, layer, 0, keepdims=False)
+    return reference_paged_partials(
+        q, kl, vl, tables, lengths, k_scale=ksl, v_scale=vsl
+    )
 
 
 def paged_window_forward(
@@ -146,7 +257,10 @@ def paged_window_forward(
     use_kernel: bool,
     mesh=None,
     kv_axis=None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    k_scale: Optional[jax.Array] = None,  # [L, NB, Hkv, BS] (int8 pool)
+    v_scale: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, Optional[jax.Array],
+           Optional[jax.Array]]:
     """Forward a short token WINDOW for F rows over their cached paged
     prefixes: in-window causal self-attention merged online with the
     paged kernel's partials over ``[0, start)``, window KV scattered into
@@ -155,7 +269,12 @@ def paged_window_forward(
     verify step (engine/spec_decode.py) — verify IS a batched paged
     prefill of the draft window, so both paths ride the same attention
     math and the same pool scatter.  Returns ``(x [F, C, D], k_pool,
-    v_pool)`` with ``x`` the final hidden states (pre-head).
+    v_pool, k_scale, v_scale)`` with ``x`` the final hidden states
+    (pre-head); the scales pass through as None on unquantized pools.
+
+    On an int8 pool the window KV is computed in model dtype, quantized
+    per (token, head) right before the scatter, and its scales land in
+    the scale pools through the same (pid, off) coordinates.
 
     Callers jit this (it is not jitted itself); the pools thread through
     donated args of the enclosing jit."""
@@ -187,13 +306,13 @@ def paged_window_forward(
     scale = 1.0 / np.sqrt(hd)
 
     def body(carry, xs):
-        x, k_pool, v_pool = carry
+        x, k_pool, v_pool, k_scale, v_scale = carry
         lp, l = xs
         h = _norm(x, lp["attn_norm"], cfg)
         q, k, v = _attn_qkv(cfg, lp, h, positions, rope_cs)
         acc_p, m_p, l_p = _prefix_partials(
             q, k_pool, v_pool, tables, read_lens, l, use_kernel,
-            mesh=mesh, kv_axis=kv_axis,
+            mesh=mesh, kv_axis=kv_axis, k_scale=k_scale, v_scale=v_scale,
         )
         # in-chunk causal scores (C <= ~1k keeps [F,Hq,C,C] small)
         qg = q.reshape(F, C, Hkv, r, hd)
@@ -228,26 +347,37 @@ def paged_window_forward(
         x = x + mlp_out
         # scatter chunk KV into the pool (in-place on the donated carry);
         # advanced indices split by the Hkv slice -> result [F, C, Hkv, hd]
-        k_pool = k_pool.at[l, pid, :, off].set(
-            k.astype(k_pool.dtype), mode="drop"
-        )
-        v_pool = v_pool.at[l, pid, :, off].set(
-            v.astype(v_pool.dtype), mode="drop"
-        )
-        return (x, k_pool, v_pool), None
+        if k_scale is not None:
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            k_pool = k_pool.at[l, pid, :, off].set(kq, mode="drop")
+            v_pool = v_pool.at[l, pid, :, off].set(vq, mode="drop")
+            # scale pools [L, NB, Hkv, BS]: same (pid, off) coordinates,
+            # advanced indices split by the Hkv slice -> [F, C, Hkv]
+            k_scale = k_scale.at[l, pid, :, off].set(ks, mode="drop")
+            v_scale = v_scale.at[l, pid, :, off].set(vs, mode="drop")
+        else:
+            k_pool = k_pool.at[l, pid, :, off].set(
+                k.astype(k_pool.dtype), mode="drop"
+            )
+            v_pool = v_pool.at[l, pid, :, off].set(
+                v.astype(v_pool.dtype), mode="drop"
+            )
+        return (x, k_pool, v_pool, k_scale, v_scale), None
 
-    (x, k_pool, v_pool), _ = jax.lax.scan(
+    (x, k_pool, v_pool, k_scale, v_scale), _ = jax.lax.scan(
         body,
-        (x, k_pool, v_pool),
+        (x, k_pool, v_pool, k_scale, v_scale),
         (params["layers"], jnp.arange(L)),
     )
-    return x, k_pool, v_pool
+    return x, k_pool, v_pool, k_scale, v_scale
 
 
 @partial(
     jax.jit,
     static_argnames=("cfg", "use_kernel", "mesh", "kv_axis"),
     donate_argnums=(1, 2),
+    donate_argnames=("k_scale", "v_scale"),
 )
 def paged_fill_chunk(
     params: Params,
@@ -261,29 +391,36 @@ def paged_fill_chunk(
     use_kernel: bool,
     mesh=None,
     kv_axis=None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    k_scale: Optional[jax.Array] = None,  # [L, NB, Hkv, BS] (int8 pool)
+    v_scale: Optional[jax.Array] = None,
+):
     """One prefill chunk for F filling rows.
 
     Each row's chunk tokens attend causally within the chunk AND over the
     row's already-cached prefix ``[0, start)`` via paged partials — an
     exact continuation of the row's prefill no matter how the prompt was
     split into chunks.  Chunk KV is scattered into the rows' pool blocks
-    (the engine pre-allocated blocks covering ``start + chunk_len``).
+    (the engine pre-allocated blocks covering ``start + chunk_len``);
+    int8 pools quantize at the scatter and land scales alongside.
 
-    Returns ``(last_logits [F, V], k_pool, v_pool)`` — logits at each
-    row's LAST valid chunk position (only meaningful on a row's final
-    chunk, where the engine samples the first generated token).
+    Returns ``(last_logits [F, V], k_pool, v_pool)`` — plus ``(k_scale,
+    v_scale)`` when the pool is quantized — logits at each row's LAST
+    valid chunk position (only meaningful on a row's final chunk, where
+    the engine samples the first generated token).
     """
     C = tokens.shape[1]
     valid = jnp.arange(C)[None, :] < chunk_lens[:, None]  # [F, C]
-    x, k_pool, v_pool = paged_window_forward(
+    x, k_pool, v_pool, k_scale, v_scale = paged_window_forward(
         params, k_pool, v_pool, cfg, tokens, starts, valid, tables,
         use_kernel=use_kernel, mesh=mesh, kv_axis=kv_axis,
+        k_scale=k_scale, v_scale=v_scale,
     )
     last_idx = jnp.maximum(chunk_lens - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)
     logits = _head(params, cfg, x_last)[:, 0]  # [F, V]
-    return logits, k_pool, v_pool
+    if k_scale is None:
+        return logits, k_pool, v_pool
+    return logits, k_pool, v_pool, k_scale, v_scale
 
 
 @partial(
@@ -293,6 +430,7 @@ def paged_fill_chunk(
         "stop_fn", "mesh", "kv_axis", "deep_kernel",
     ),
     donate_argnums=(1, 2),
+    donate_argnames=("k_scale", "v_scale"),
 )
 def paged_decode_chunk(
     params: Params,
@@ -314,20 +452,26 @@ def paged_decode_chunk(
     kv_axis=None,
     deep_kernel: bool = False,
     row_seeds: Optional[jax.Array] = None,  # [B] per-request sampler keys
+    k_scale: Optional[jax.Array] = None,  # [L, NB, Hkv, BS] (int8 pool)
+    v_scale: Optional[jax.Array] = None,
 ):
     """Generate up to ``chunk_size`` tokens for all active rows device-side
     over the paged pool (the paged twin of ``transformer.decode_chunk``).
 
     In-chunk KV goes to a [L, W, B, Hkv, hd] window written at scalar
-    offsets; prefix attention streams each row's valid blocks through the
-    paged kernel (inactive rows read ZERO blocks — their read length is
-    masked, unlike the dense path whose cost scaled with the padded
-    bucket); the window merges into pool blocks ONCE per chunk through
-    the block tables.  The engine guarantees every active row's table
-    covers ``length + chunk_size`` slots before dispatch.
+    offsets — always in MODEL dtype, even over an int8 pool, so in-chunk
+    attention pays zero quantization error; prefix attention streams each
+    row's valid blocks through the paged kernel (inactive rows read ZERO
+    blocks — their read length is masked, unlike the dense path whose
+    cost scaled with the padded bucket); the window merges into pool
+    blocks ONCE per chunk through the block tables (int8 pools quantize
+    at that merge, scales landing through the same coordinates).  The
+    engine guarantees every active row's table covers ``length +
+    chunk_size`` slots before dispatch.
 
     Returns (k_pool, v_pool, lengths, out_t [B,W], out_l [B,W],
-    emitted [B,W], cur_tokens, active, budgets, rng).
+    emitted [B,W], cur_tokens, active, budgets, rng) — with
+    ``(k_scale, v_scale)`` appended when the pool is quantized.
     """
     assert cfg.sliding_window is None, (
         "paged decode serves global-attention models; sliding-window "
@@ -342,8 +486,11 @@ def paged_decode_chunk(
     read_lens = jnp.where(active, base_lens, 0)
     scale = 1.0 / np.sqrt(hd)
 
-    wk = jnp.zeros((L, W, B, Hkv, hd), k_pool.dtype)
-    wv = jnp.zeros((L, W, B, Hkv, hd), v_pool.dtype)
+    win_dtype = (
+        jnp.dtype(cfg.dtype) if k_scale is not None else k_pool.dtype
+    )
+    wk = jnp.zeros((L, W, B, Hkv, hd), win_dtype)
+    wv = jnp.zeros((L, W, B, Hkv, hd), win_dtype)
     wvalid0 = jnp.zeros((W, B), bool)
 
     def step(i, st):
@@ -384,6 +531,7 @@ def paged_decode_chunk(
             acc, m_main, l_main = _prefix_partials(
                 q, k_pool, v_pool, tables, read_lens, l, use_kernel,
                 mesh=mesh, kv_axis=kv_axis, deep=deep_kernel,
+                k_scale=k_scale, v_scale=v_scale,
             )
             acc = acc.reshape(B, Hkv, r, hd)
             m_main = m_main.reshape(B, Hkv, r)
@@ -447,6 +595,16 @@ def paged_decode_chunk(
     # advanced indices split by the Hkv slice -> result [W, B, L, Hkv, hd]
     val_k = wk.transpose(1, 2, 0, 3, 4)
     val_v = wv.transpose(1, 2, 0, 3, 4)
+    if k_scale is not None:
+        kq, ks = quantize_kv(val_k)
+        vq, vs = quantize_kv(val_v)
+        k_pool = k_pool.at[:, pid, :, off].set(kq, mode="drop")
+        v_pool = v_pool.at[:, pid, :, off].set(vq, mode="drop")
+        # scale pools [L, NB, Hkv, BS]: same coordinates -> [W, B, L, Hkv]
+        k_scale = k_scale.at[:, pid, :, off].set(ks, mode="drop")
+        v_scale = v_scale.at[:, pid, :, off].set(vs, mode="drop")
+        return (k_pool, v_pool, lengths_, out_t, out_l, emitted, cur,
+                active, budgets, rng, k_scale, v_scale)
     k_pool = k_pool.at[:, pid, :, off].set(val_k, mode="drop")
     v_pool = v_pool.at[:, pid, :, off].set(val_v, mode="drop")
     return (k_pool, v_pool, lengths_, out_t, out_l, emitted, cur, active,
@@ -458,53 +616,90 @@ def gather_blocks(
     k_pool: jax.Array,
     v_pool: jax.Array,
     src: jax.Array,  # [n] pool block ids to gather (pad with any valid id)
-) -> Tuple[jax.Array, jax.Array]:
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+):
     """Gather whole blocks out of the pool as ``[n, L, Hkv, BS, hd]``
     pairs — the device half of a host-tier SPILL (the engine
     ``device_get``s the result into host buffers, one batched fetch per
-    reclamation round).  NOT donated: the pool stays live."""
+    reclamation round).  Quantized pools also gather the blocks' scale
+    slices ``[n, L, Hkv, BS]`` (appended to the returned tuple), so a
+    spilled prefix costs its true int8+scale bytes in host RAM — half
+    or less of the model-dtype footprint.  NOT donated: the pool stays
+    live."""
     src = jnp.clip(src, 0, k_pool.shape[1] - 1)
-    return (
+    out = (
         jnp.take(k_pool, src, axis=1).swapaxes(0, 1),
         jnp.take(v_pool, src, axis=1).swapaxes(0, 1),
     )
+    if k_scale is None:
+        return out
+    return out + (
+        jnp.take(k_scale, src, axis=1).swapaxes(0, 1),
+        jnp.take(v_scale, src, axis=1).swapaxes(0, 1),
+    )
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@partial(
+    jax.jit, donate_argnums=(0, 1), donate_argnames=("k_scale", "v_scale")
+)
 def restore_blocks(
     k_pool: jax.Array,
     v_pool: jax.Array,
     k_host: jax.Array,  # [n, L, Hkv, BS, hd] spilled payloads (host-built)
     v_host: jax.Array,
     dst: jax.Array,  # [n] destination pool block ids (NB entries drop)
-) -> Tuple[jax.Array, jax.Array]:
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    k_scale_host: Optional[jax.Array] = None,  # [n, L, Hkv, BS]
+    v_scale_host: Optional[jax.Array] = None,
+):
     """Scatter host-spilled block KV back into freshly allocated pool
-    blocks — the device half of a host-tier swap-in.  Dispatched async
-    like every pool op: the host->device transfer and scatter ride
-    under the decode chunks queued behind it in the in-flight ring, and
-    any later op consuming the (donated) pool is sequenced after it by
-    data dependence."""
+    blocks — the device half of a host-tier swap-in.  Quantized pools
+    restore the spilled int8 bytes AND their scales bit-identically (no
+    requantization round trip).  Dispatched async like every pool op:
+    the host->device transfer and scatter ride under the decode chunks
+    queued behind it in the in-flight ring, and any later op consuming
+    the (donated) pool is sequenced after it by data dependence."""
     k_pool = k_pool.at[:, dst].set(
         k_host.swapaxes(0, 1).astype(k_pool.dtype), mode="drop"
     )
     v_pool = v_pool.at[:, dst].set(
         v_host.swapaxes(0, 1).astype(v_pool.dtype), mode="drop"
     )
-    return k_pool, v_pool
+    if k_scale is None:
+        return k_pool, v_pool
+    k_scale = k_scale.at[:, dst].set(
+        k_scale_host.swapaxes(0, 1).astype(k_scale.dtype), mode="drop"
+    )
+    v_scale = v_scale.at[:, dst].set(
+        v_scale_host.swapaxes(0, 1).astype(v_scale.dtype), mode="drop"
+    )
+    return k_pool, v_pool, k_scale, v_scale
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
+@partial(
+    jax.jit, donate_argnums=(0, 1), donate_argnames=("k_scale", "v_scale")
+)
 def copy_blocks(
     k_pool: jax.Array,
     v_pool: jax.Array,
     src: jax.Array,  # [n] pool block ids to copy from
     dst: jax.Array,  # [n] pool block ids to copy into (NB entries drop)
-) -> Tuple[jax.Array, jax.Array]:
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+):
     """Copy whole blocks inside the pool (group-prompt tail blocks: the
     full blocks of a shared prompt are REFERENCED by every group member,
     but the partially-filled last block must be copied per member since
-    their generated tokens diverge inside it)."""
+    their generated tokens diverge inside it).  Quantized pools copy the
+    scale slices with the int8 bytes — a COW tail carries its donor's
+    exact quantization."""
     src = jnp.clip(src, 0, k_pool.shape[1] - 1)  # pad entries gather blk 0
     k_pool = k_pool.at[:, dst].set(k_pool[:, src], mode="drop")
     v_pool = v_pool.at[:, dst].set(v_pool[:, src], mode="drop")
-    return k_pool, v_pool
+    if k_scale is None:
+        return k_pool, v_pool
+    k_scale = k_scale.at[:, dst].set(k_scale[:, src], mode="drop")
+    v_scale = v_scale.at[:, dst].set(v_scale[:, src], mode="drop")
+    return k_pool, v_pool, k_scale, v_scale

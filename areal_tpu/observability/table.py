@@ -179,6 +179,34 @@ METRIC_TABLE = [
         "workload",
     ),
     MetricSpec(
+        "areal_inference_kv_quant_storage_bits",
+        "gauge",
+        "Bits per stored KV element in the serving cache (8 = int8 "
+        "quantized pools with per-(block, head, slot) scales; 16/32 = "
+        "model-dtype storage, kv_cache_dtype='auto')",
+    ),
+    MetricSpec(
+        "areal_inference_kv_quant_blocks",
+        "gauge",
+        "Pool blocks currently held in quantized (int8) storage — live "
+        "rows, prefix-cache references, and in-flight fills together; 0 "
+        "on an unquantized engine",
+    ),
+    MetricSpec(
+        "areal_inference_kv_quant_divergence_checks_total",
+        "counter",
+        "Greedy-divergence checks folded into the engine by quality "
+        "harnesses (bench kv_quant_ab / parity tests comparing the int8 "
+        "arm against an fp arm token by token)",
+    ),
+    MetricSpec(
+        "areal_inference_kv_quant_divergence_diverged_total",
+        "counter",
+        "Checked requests whose int8-arm greedy stream diverged from "
+        "the fp arm's (the measured token-quality delta the quantized "
+        "serving rollout is gated on)",
+    ),
+    MetricSpec(
         "areal_inference_inflight_rows",
         "gauge",
         "Rows currently decoding or chunk-filling",

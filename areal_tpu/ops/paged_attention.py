@@ -76,17 +76,24 @@ def _kernel(
     tables_ref,  # scalar prefetch [B, MB]
     layer_ref,  # scalar prefetch [1] (0 when the pool is per-layer)
     q_ref,  # (1, 1, Hkv, QR, hd)
-    *refs,  # G k-page refs, G v-page refs, 3 outs, 3 scratch
+    *refs,  # G k-page refs, G v-page refs, [2G scale refs], 3 outs, 3 scratch
     block_size: int,
     scale: float,
     n_kv_heads: int,
     page_group: int,
+    quantized: bool = False,
 ):
     G = page_group
     k_refs = refs[:G]
     v_refs = refs[G : 2 * G]
-    acc_ref, m_ref, l_ref = refs[2 * G : 2 * G + 3]
-    s_acc, s_m, s_l = refs[2 * G + 3 :]
+    base_idx = 2 * G
+    ks_refs = vs_refs = ()
+    if quantized:
+        ks_refs = refs[2 * G : 3 * G]
+        vs_refs = refs[3 * G : 4 * G]
+        base_idx = 4 * G
+    acc_ref, m_ref, l_ref = refs[base_idx : base_idx + 3]
+    s_acc, s_m, s_l = refs[base_idx + 3 :]
     b = pl.program_id(0)
     j = pl.program_id(2)
     nb = pl.num_programs(2)
@@ -106,6 +113,14 @@ def _kernel(
             # KV heads ride it together
             k_all = k_refs[g][...].reshape(n_kv_heads, block_size, hd)
             v_all = v_refs[g][...].reshape(n_kv_heads, block_size, hd)
+            if quantized:
+                # in-kernel dequant: multiply the int8 page by its
+                # per-(head, slot) scales right after the gather, so the
+                # attention dots below run in f32 like the fp path
+                ks = ks_refs[g][...].reshape(n_kv_heads, block_size)
+                vs = vs_refs[g][...].reshape(n_kv_heads, block_size)
+                k_all = k_all.astype(jnp.float32) * ks[:, :, None]
+                v_all = v_all.astype(jnp.float32) * vs[:, :, None]
             for h in range(n_kv_heads):
                 softmax_block_update(
                     q_ref[0, 0, h], k_all[h], v_all[h],
@@ -191,6 +206,8 @@ def paged_flash_attention(
     lengths: jax.Array,  # [B] int32 — valid cache prefix per row
     layer: jax.Array | None = None,  # [] or [1] int32, for stacked pools
     interpret: bool = False,
+    k_scale: jax.Array | None = None,  # [(L,) NB, Hkv, BS] int8-pool scales
+    v_scale: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Un-normalized online-softmax attention partials over paged KV.
 
@@ -209,6 +226,11 @@ def paged_flash_attention(
     layer scan never materializes a per-layer pool slice (that slice is
     pool_bytes/L of pure copy traffic per layer — the whole pool per
     forward).
+
+    ``k_scale``/``v_scale`` mark an int8-quantized pool: each page's
+    scale tile streams beside its KV tile through the same index map and
+    the kernel dequantizes in VMEM right after the gather (the
+    storage-only quantization contract).
     """
     B, Q, Hq, hd = q.shape
     layered = k_pool.ndim == 5
@@ -223,6 +245,7 @@ def paged_flash_attention(
     layer_arr = _layer_scalar(layer)
 
     G = min(PAGE_GROUP, MB)
+    quantized = k_scale is not None
     grid = (B, QB, -(-MB // G))
     kv_block = (1, 1, Hkv, BS, hd) if layered else (1, Hkv, BS, hd)
     kv_specs = [
@@ -238,6 +261,22 @@ def paged_flash_attention(
         )
         for g in range(G)
     ]
+    # int8 pools: each page's scale tile (one f32 per head x slot) rides
+    # the same clamped index map as its KV tile
+    scale_block = (1, 1, Hkv, BS) if layered else (1, Hkv, BS)
+    scale_specs = [
+        pl.BlockSpec(
+            scale_block,
+            functools.partial(
+                _paged_scale_map,
+                block_size=BS,
+                layered=layered,
+                group=G,
+                offset=g,
+            ),
+        )
+        for g in range(G)
+    ]
     acc, m, l = pl.pallas_call(
         functools.partial(
             _kernel,
@@ -245,6 +284,7 @@ def paged_flash_attention(
             scale=1.0 / np.sqrt(hd),
             n_kv_heads=Hkv,
             page_group=G,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
@@ -258,6 +298,7 @@ def paged_flash_attention(
                 ]
                 + kv_specs  # G k-page streams
                 + kv_specs  # G v-page streams (same maps, v operands)
+                + (scale_specs + scale_specs if quantized else [])
             ),
             out_specs=[
                 pl.BlockSpec(
@@ -295,9 +336,22 @@ def paged_flash_attention(
         qg,
         *([k_pool] * G),
         *([v_pool] * G),
+        *(([k_scale] * G + [v_scale] * G) if quantized else []),
     )
 
     return _ungroup_outputs(acc, m, l, B, QB, QT, Hkv, r, Q, Hq, hd)
+
+
+def _paged_scale_map(b, qb, j, lengths_ref, tables_ref, layer_ref, *,
+                     block_size, layered, group, offset):
+    """Scale-pool twin of :func:`_paged_kv_map` (one fewer trailing dim)."""
+    last = jnp.maximum(
+        (lengths_ref[b] + block_size - 1) // block_size - 1, 0
+    )
+    pid = tables_ref[b, jnp.minimum(j * group + offset, last)]
+    if layered:
+        return (layer_ref[0], pid, 0, 0)
+    return (pid, 0, 0)
 
 
 #: in-flight page DMAs of the deep-pipelined kernel (see
@@ -311,26 +365,22 @@ def _deep_kernel(
     tables_ref,  # scalar prefetch [B, MB]
     layer_ref,  # scalar prefetch [1]
     q_ref,  # (1, 1, Hkv, QR, hd) VMEM
-    k_hbm,  # full pool, stays in HBM
-    v_hbm,
-    acc_ref,  # out (1, 1, Hkv, QR, hd) f32
-    m_ref,  # out (1, 1, Hkv, QR, 128) f32
-    l_ref,  # out (1, 1, Hkv, QR, 128) f32
-    kbuf,  # scratch (NBUF, Hkv, BS, hd)
-    vbuf,  # scratch (NBUF, Hkv, BS, hd)
-    s_acc,  # scratch (Hkv, QR, hd) f32
-    s_m,  # scratch (Hkv, QR, 128) f32
-    s_l,  # scratch (Hkv, QR, 128) f32
-    k_sems,  # DMA sems (NBUF,)
-    v_sems,  # DMA sems (NBUF,)
-    *,
+    *refs,  # k_hbm, v_hbm, [ks_hbm, vs_hbm], 3 outs, bufs, scratch, sems
     block_size: int,
     scale: float,
     n_kv_heads: int,
     layered: bool,
     max_blocks: int,
     n_buffers: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        (k_hbm, v_hbm, ks_hbm, vs_hbm, acc_ref, m_ref, l_ref,
+         kbuf, vbuf, ksbuf, vsbuf, s_acc, s_m, s_l,
+         k_sems, v_sems, ks_sems, vs_sems) = refs
+    else:
+        (k_hbm, v_hbm, acc_ref, m_ref, l_ref, kbuf, vbuf,
+         s_acc, s_m, s_l, k_sems, v_sems) = refs
     NBUF = n_buffers
     b = pl.program_id(0)
     length = lengths_ref[b]
@@ -347,31 +397,46 @@ def _deep_kernel(
             return lambda r: r.at[lay, pid]
         return lambda r: r.at[pid]
 
-    def dma_pair(j, slot):
+    def dma_group(j, slot):
         sel = src(j)
-        return (
+        copies = [
             pltpu.make_async_copy(sel(k_hbm), kbuf.at[slot], k_sems.at[slot]),
             pltpu.make_async_copy(sel(v_hbm), vbuf.at[slot], v_sems.at[slot]),
-        )
+        ]
+        if quantized:
+            # the page's scale tiles ride the same DMA ring slot — the
+            # in-kernel-dequant half of the int8 storage format
+            copies.append(
+                pltpu.make_async_copy(
+                    sel(ks_hbm), ksbuf.at[slot], ks_sems.at[slot]
+                )
+            )
+            copies.append(
+                pltpu.make_async_copy(
+                    sel(vs_hbm), vsbuf.at[slot], vs_sems.at[slot]
+                )
+            )
+        return copies
 
     # warm-up: fill the buffer ring
     def warm(j, _):
         @pl.when(j < n_blocks)
         def _():
-            kd, vd = dma_pair(j, j % NBUF)
-            kd.start()
-            vd.start()
+            for c in dma_group(j, j % NBUF):
+                c.start()
         return 0
 
     jax.lax.fori_loop(0, NBUF, warm, 0)
 
     def body(j, _):
         slot = j % NBUF
-        kd, vd = dma_pair(j, slot)
-        kd.wait()
-        vd.wait()
+        for c in dma_group(j, slot):
+            c.wait()
         k_all = kbuf[slot]
         v_all = vbuf[slot]
+        if quantized:
+            k_all = k_all.astype(jnp.float32) * ksbuf[slot][:, :, None]
+            v_all = v_all.astype(jnp.float32) * vsbuf[slot][:, :, None]
         for h in range(n_kv_heads):
             softmax_block_update(
                 q_ref[0, 0, h], k_all[h], v_all[h],
@@ -383,9 +448,8 @@ def _deep_kernel(
 
         @pl.when(nxt < n_blocks)
         def _():
-            kd2, vd2 = dma_pair(nxt, slot)
-            kd2.start()
-            vd2.start()
+            for c in dma_group(nxt, slot):
+                c.start()
         return 0
 
     jax.lax.fori_loop(0, n_blocks, body, 0)
@@ -404,6 +468,8 @@ def paged_flash_attention_deep(
     lengths: jax.Array,  # [B]
     layer: jax.Array | None = None,
     interpret: bool = False,
+    k_scale: jax.Array | None = None,  # [(L,) NB, Hkv, BS] int8-pool scales
+    v_scale: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Deep-pipelined variant of :func:`paged_flash_attention`: the pool
     stays in HBM and the kernel issues its own page DMAs with a
@@ -424,12 +490,31 @@ def paged_flash_attention_deep(
     if layered:
         assert layer is not None
     r = Hq // Hkv
+    quantized = k_scale is not None
     qg, QT, QB, Qp = _group_queries(q, Hkv, r)
     layer_arr = _layer_scalar(layer)
-    # ring depth bounded by a ~12 MB VMEM budget for the two page rings
+    # ring depth bounded by a ~12 MB VMEM budget for the page rings
+    # (int8 pools add a small f32 scale tile per page)
     tile_bytes = Hkv * BS * hd * jnp.dtype(k_pool.dtype).itemsize
+    if quantized:
+        tile_bytes += Hkv * BS * 4
     nbuf = int(max(2, min(DEEP_BUFFERS, (6 << 20) // max(tile_bytes, 1))))
     grid = (B, QB)
+    scratch = [
+        pltpu.VMEM((nbuf, Hkv, BS, hd), k_pool.dtype),
+        pltpu.VMEM((nbuf, Hkv, BS, hd), v_pool.dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((nbuf, Hkv, BS), jnp.float32),
+            pltpu.VMEM((nbuf, Hkv, BS), jnp.float32),
+        ]
+    scratch += [
+        pltpu.VMEM((Hkv, QT * r, hd), jnp.float32),
+        pltpu.VMEM((Hkv, QT * r, 128), jnp.float32),
+        pltpu.VMEM((Hkv, QT * r, 128), jnp.float32),
+    ]
+    scratch += [pltpu.SemaphoreType.DMA((nbuf,))] * (4 if quantized else 2)
     acc, m, l = pl.pallas_call(
         functools.partial(
             _deep_kernel,
@@ -439,6 +524,7 @@ def paged_flash_attention_deep(
             layered=layered,
             max_blocks=MB,
             n_buffers=nbuf,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
@@ -448,9 +534,9 @@ def paged_flash_attention_deep(
                     (1, 1, Hkv, QT * r, hd),
                     lambda b, qb, L, T, Y: (b, qb, 0, 0, 0),
                 ),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            ]
+            + [pl.BlockSpec(memory_space=pl.ANY)]
+            * (4 if quantized else 2),
             out_specs=[
                 pl.BlockSpec(
                     (1, 1, Hkv, QT * r, hd),
@@ -465,15 +551,7 @@ def paged_flash_attention_deep(
                     lambda b, qb, L, T, Y: (b, qb, 0, 0, 0),
                 ),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((nbuf, Hkv, BS, hd), k_pool.dtype),
-                pltpu.VMEM((nbuf, Hkv, BS, hd), v_pool.dtype),
-                pltpu.VMEM((Hkv, QT * r, hd), jnp.float32),
-                pltpu.VMEM((Hkv, QT * r, 128), jnp.float32),
-                pltpu.VMEM((Hkv, QT * r, 128), jnp.float32),
-                pltpu.SemaphoreType.DMA((nbuf,)),
-                pltpu.SemaphoreType.DMA((nbuf,)),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((B, QB, Hkv, QT * r, hd), jnp.float32),
@@ -491,6 +569,7 @@ def paged_flash_attention_deep(
         qg,
         k_pool,
         v_pool,
+        *((k_scale, v_scale) if quantized else ()),
     )
 
     return _ungroup_outputs(acc, m, l, B, QB, QT, Hkv, r, Q, Hq, hd)
@@ -513,12 +592,24 @@ def gather_paged_kv(
     return g(k_pool), g(v_pool)
 
 
-def reference_paged_partials(q, k_pool, v_pool, tables, lengths):
-    """jnp reference for :func:`paged_flash_attention` (same contract)."""
+def reference_paged_partials(
+    q, k_pool, v_pool, tables, lengths, k_scale=None, v_scale=None
+):
+    """jnp reference for :func:`paged_flash_attention` (same contract).
+
+    ``k_scale``/``v_scale`` ([NB, Hkv, BS]) mark an int8 pool: the
+    gathered pages are multiplied by their per-(head, slot) scales right
+    after the block gather — dequant-on-read, storage-only error."""
     B, Q, Hq, hd = q.shape
     NB, Hkv, BS, _ = k_pool.shape
     r = Hq // Hkv
     k, v = gather_paged_kv(k_pool, v_pool, tables)  # [B,Hkv,S,hd]
+    if k_scale is not None:
+        ks, vs = gather_paged_kv(
+            k_scale[..., None], v_scale[..., None], tables
+        )  # [B,Hkv,S,1]
+        k = k.astype(jnp.float32) * ks
+        v = v.astype(jnp.float32) * vs
     S = k.shape[2]
     qg = q.reshape(B, Q, Hkv, r, hd).astype(jnp.float32)
     s = jnp.einsum(

@@ -126,6 +126,7 @@ class GenerationServerWorker(worker_base.Worker):
             cache_mode=config.cache_mode,
             page_size=config.page_size,
             kv_pool_tokens=config.kv_pool_tokens,
+            kv_cache_dtype=getattr(config, "kv_cache_dtype", "auto"),
             prefill_chunk_tokens=config.prefill_chunk_tokens,
             pipeline_depth=config.pipeline_depth,
             dispatch_table=resolve_dispatch_table(
@@ -273,6 +274,12 @@ class GenerationServerWorker(worker_base.Worker):
             "spec_fallback_rows": reg.counter(
                 "areal_inference_spec_fallback_rows_total"
             ),
+            "kv_quant_checks": reg.counter(
+                "areal_inference_kv_quant_divergence_checks_total"
+            ),
+            "kv_quant_diverged": reg.counter(
+                "areal_inference_kv_quant_divergence_diverged_total"
+            ),
             "swap_stage": reg.counter(
                 "areal_inference_swap_stage_seconds_total"
             ),
@@ -295,6 +302,10 @@ class GenerationServerWorker(worker_base.Worker):
             "prefix_host_blocks": reg.gauge(
                 "areal_inference_prefix_host_blocks"
             ),
+            "kv_quant_bits": reg.gauge(
+                "areal_inference_kv_quant_storage_bits"
+            ),
+            "kv_quant_blocks": reg.gauge("areal_inference_kv_quant_blocks"),
             "mesh_devices": reg.gauge("areal_inference_mesh_devices"),
         }
         self._obs_accept_hist = reg.histogram(
@@ -327,6 +338,7 @@ class GenerationServerWorker(worker_base.Worker):
         eng = self.engine
         pstats = eng.prefix_cache_stats()
         sstats = eng.spec_stats()
+        qstats = eng.kv_quant_stats()
         totals = {
             "chunks": float(eng.chunks_total),
             "host": eng.time_host_s,
@@ -350,6 +362,10 @@ class GenerationServerWorker(worker_base.Worker):
             "spec_rejected": float(sstats["rejected_total"]),
             "spec_verify_chunks": float(sstats["verify_chunks_total"]),
             "spec_fallback_rows": float(sstats["fallback_rows_total"]),
+            "kv_quant_checks": float(qstats["divergence_checks_total"]),
+            "kv_quant_diverged": float(
+                qstats["divergence_diverged_total"]
+            ),
             "swap_stage": eng.swap_stage_s,
             "swap_pause": eng.swap_pause_s,
             "swaps": float(eng.swaps_total),
@@ -379,6 +395,8 @@ class GenerationServerWorker(worker_base.Worker):
         self._obs["prefix_blocks"].set(pstats["blocks_held"])
         self._obs["prefix_host_bytes"].set(pstats["host_bytes_held"])
         self._obs["prefix_host_blocks"].set(pstats["host_blocks_held"])
+        self._obs["kv_quant_bits"].set(qstats["storage_bits"])
+        self._obs["kv_quant_blocks"].set(qstats["quantized_blocks_held"])
         self._obs["mesh_devices"].set(eng.mesh_devices)
 
     # -- API ---------------------------------------------------------------
@@ -682,6 +700,12 @@ class GenerationServerWorker(worker_base.Worker):
             **{
                 f"spec_{k}": v
                 for k, v in self.engine.spec_stats().items()
+            },
+            # quantized KV storage: dtype bits, quantized block
+            # residency, measured divergence-check counters
+            **{
+                f"kv_quant_{k}": v
+                for k, v in self.engine.kv_quant_stats().items()
             },
             # decode-loop host/device/fetch attribution (cumulative s)
             **{

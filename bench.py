@@ -142,7 +142,9 @@ def make_engine(cfg, params, n_reqs, prompt_len, max_new, chunk=128, **kw):
     )
 
 
-def submit_wave(eng, cfg, n_reqs, prompt_len, max_new, tag, lens=None):
+def submit_wave(
+    eng, cfg, n_reqs, prompt_len, max_new, tag, lens=None, greedy=False
+):
     from areal_tpu.api.model_api import (
         APIGenerateInput,
         GenerationHyperparameters,
@@ -153,19 +155,47 @@ def submit_wave(eng, cfg, n_reqs, prompt_len, max_new, tag, lens=None):
     # crc32, not hash(): str hashes are salted per interpreter launch and
     # would make the prompt stream differ across bench runs
     rng = np.random.default_rng(zlib.crc32(tag.encode()))
+    qids = []
     for i in range(n_reqs):
         ids = rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
         mn = int(lens[i]) if lens is not None else max_new
+        qid = f"{tag}{i}"
+        qids.append(qid)
         eng.submit(
             APIGenerateInput(
-                qid=f"{tag}{i}",
+                qid=qid,
                 prompt_ids=ids,
                 input_ids=ids,
                 gconfig=GenerationHyperparameters(
-                    max_new_tokens=mn, temperature=1.0
+                    max_new_tokens=mn,
+                    **({"greedy": True} if greedy
+                       else {"temperature": 1.0}),
                 ),
             )
         )
+    return qids
+
+
+def lcp_divergence(ref_streams, got_streams):
+    """Greedy divergence between two {qid: tokens} stream maps:
+    ``1 - (longest-common-prefix tokens / reference tokens)`` — one
+    early flip charges the whole tail (the conservative definition).
+    Returns ``(rate, diverged_request_count)``.  THE quality-gate
+    statistic of ``bench_kv_quant_ab``; the tier-1 divergence pin
+    (tests/engine/test_kv_quant.py) imports this same function so the
+    asserted bar can never drift from what the bench reports."""
+    total = matched = diverged = 0
+    for qid, ref in ref_streams.items():
+        got = got_streams[qid]
+        lcp = 0
+        for a, b in zip(ref, got):
+            if a != b:
+                break
+            lcp += 1
+        total += len(ref)
+        matched += lcp
+        diverged += int(lcp < max(len(ref), len(got)))
+    return round(1.0 - matched / max(total, 1), 4), diverged
 
 
 def drain(eng):
@@ -745,6 +775,257 @@ def bench_prefix_cache_hier(
         else:
             cell["token_parity"] = None  # unverified, not assumed
         out["sweep"][f"c{n_conv}"] = cell
+    return out
+
+
+def bench_kv_quant_ab(
+    cfg,
+    params,
+    n_reqs=8,
+    prompt_len=256,
+    max_new=64,
+    page=256,
+    chunk=32,
+    turns=3,
+    sessions=4,
+    user_len=24,
+    capacity_frac=0.5,
+    divergence_bar=0.35,
+):
+    """Quantized KV cache A/B (``GenServerConfig.kv_cache_dtype``):
+    fp ("auto") vs int8 per-block-quantized pools on the paged serving
+    path, at EQUAL pool budgets.
+
+    Reported, all MEASURED on the arms actually run:
+
+    * ``blocks_per_hbm_byte_gain`` — bytes per pool block from the
+      allocated arrays' true itemsize (int8 data + f32 scales vs model
+      dtype), i.e. how many more paged blocks one HBM byte buys;
+    * ``max_concurrent_rows`` — full-context rows a FIXED byte budget
+      (the fp arm's pool) holds per arm;
+    * ``decode`` — greedy decode tok/s per arm on an identical wave,
+      plus the int8 arm's greedy divergence rate vs the fp arm
+      (per-request longest-common-prefix, so one early flip counts the
+      whole tail — the conservative definition);
+    * ``prefix_equal_hbm`` — the multi-turn replay with the radix cache
+      capped at the SAME HBM bytes per arm: the int8 arm's pool holds
+      ~2x the blocks, so ``cached_token_frac`` rises at equal memory;
+    * ``auto_token_parity`` — the "auto" arm against a DENSE engine on
+      the same wave: the quantization plumbing must leave the
+      unquantized path token-identical (pinned in tier-1).
+
+    The ``quality_ok`` gate asserts the decode-wave divergence rate
+    under ``divergence_bar``; the int8 engine (the arm under test)
+    folds the check into its ``areal_inference_kv_quant_*`` divergence
+    counters.
+    Sub-arms never silently cap: a cell that raises is recorded as
+    ``{"error": ...}`` and named in ``dropped``."""
+    import zlib
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine.sampling import SamplingParams
+
+    out = {
+        "batch": n_reqs,
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+        "page_size": page,
+        "divergence_bar": divergence_bar,
+        "dropped": [],
+    }
+
+    def decode_arm(kv_dtype):
+        eng = make_engine(
+            cfg, params, n_reqs, prompt_len, max_new, chunk=chunk,
+            cache_mode="paged", page_size=page,
+            kv_cache_dtype=kv_dtype,
+            sampling=SamplingParams(greedy=True),
+        )
+        # IDENTICAL tags (= identical prompt streams and qids) across
+        # arms: the divergence comparison is token-by-token per qid
+        submit_wave(
+            eng, cfg, n_reqs, prompt_len, max_new, "kvwarm", greedy=True
+        )
+        drain(eng)  # warmup: compile this arm's buckets
+        qids = submit_wave(
+            eng, cfg, n_reqs, prompt_len, max_new, "kvwave", greedy=True
+        )
+        t0 = time.perf_counter()
+        while eng.has_work:
+            eng.step()
+        dt = time.perf_counter() - t0
+        outs = eng.drain_results()
+        streams = {q: list(outs[q].output_ids) for q in qids}
+        n_tok = sum(len(s) for s in streams.values())
+        row = {
+            "decode_toks_per_sec": round(n_tok / max(dt, 1e-9), 1),
+            "generated_tokens": int(n_tok),
+            "bytes_per_block": int(eng._pool_block_bytes()),
+            "pool_blocks": int(eng.n_blocks),
+            "storage_bits": eng.kv_quant_stats()["storage_bits"],
+        }
+        return eng, streams, row
+
+    # -- decode wave + storage-density numbers (equal pool budget) ---------
+    try:
+        eng_fp, fp_streams, fp_row = decode_arm("auto")
+        eng_q, q_streams, q_row = decode_arm("int8")
+        div_rate, n_div = lcp_divergence(fp_streams, q_streams)
+        # the measured check lands on the INT8 arm's quality counters
+        # (the areal_inference_kv_quant_divergence_* series) — it is
+        # the arm whose storage is under test; the fp arm is the
+        # reference and its counters stay zero
+        eng_q.note_kv_divergence_check(len(fp_streams), n_div)
+        gain = fp_row["bytes_per_block"] / max(q_row["bytes_per_block"], 1)
+        budget = fp_row["bytes_per_block"] * fp_row["pool_blocks"]
+        bpr = eng_fp.blocks_per_row
+        out["bytes_per_block"] = {
+            "auto": fp_row["bytes_per_block"],
+            "int8": q_row["bytes_per_block"],
+        }
+        out["blocks_per_hbm_byte_gain"] = round(gain, 3)
+        out["max_concurrent_rows"] = {
+            "budget_bytes": int(budget),
+            "auto": int(fp_row["pool_blocks"] // bpr),
+            "int8": int(
+                (budget // q_row["bytes_per_block"]) // bpr
+            ),
+        }
+        out["decode"] = {
+            "auto": fp_row,
+            "int8": q_row,
+            "divergence_rate": div_rate,
+            "diverged_requests": int(n_div),
+            "quality_ok": bool(div_rate <= divergence_bar),
+        }
+        del eng_q
+    except Exception as e:  # noqa: BLE001 - a cell is data
+        out["decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        out["dropped"].append("decode")
+        eng_fp = None
+        fp_streams = {}
+
+    # -- "auto" arm parity pin: the unquantized path must be untouched -----
+    try:
+        if eng_fp is None:
+            raise RuntimeError("decode arm dropped")
+        dense = make_engine(
+            cfg, params, n_reqs, prompt_len, max_new, chunk=chunk,
+            cache_mode="dense",
+            sampling=SamplingParams(greedy=True),
+        )
+        qids = submit_wave(
+            dense, cfg, n_reqs, prompt_len, max_new, "kvwave", greedy=True
+        )
+        drain_outs = {}
+        while dense.has_work:
+            dense.step()
+        for q, o in dense.drain_results().items():
+            drain_outs[q] = list(o.output_ids)
+        out["auto_token_parity"] = bool(
+            all(drain_outs[q] == fp_streams[q] for q in qids)
+        )
+        del dense
+    except Exception as e:  # noqa: BLE001
+        out["auto_token_parity"] = None
+        out["dropped"].append(f"auto_parity: {type(e).__name__}: {e}"[:120])
+    finally:
+        del eng_fp
+
+    # -- prefix cache at equal HBM: int8 pools hold ~2x the blocks ---------
+    final_prompt = prompt_len + (turns - 1) * (max_new + user_len)
+    fp_pool_tokens = sessions * bench_gen_cache_len(final_prompt, max_new)
+
+    def replay_arm(kv_dtype, pool_tokens, tag):
+        eng = make_engine(
+            cfg, params, 2, final_prompt, max_new, chunk=chunk,
+            cache_mode="paged", page_size=page,
+            kv_pool_tokens=pool_tokens,
+            kv_cache_dtype=kv_dtype,
+            prefix_cache_capacity_frac=capacity_frac,
+            sampling=SamplingParams(greedy=True),
+        )
+        eng.park_ttl_steps = 0  # fresh-qid turns never resume parks
+        rngs = [
+            np.random.default_rng(zlib.crc32(f"{tag}s{s}".encode()))
+            for s in range(sessions)
+        ]
+        convs = [
+            rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+            for rng in rngs
+        ]
+        streams = {}
+        prompt_toks = 0
+        for j in range(turns):
+            for s in range(sessions):
+                qid = f"{tag}s{s}t{j}"
+                prompt_toks += len(convs[s])
+                eng.submit(
+                    APIGenerateInput(
+                        qid=qid,
+                        prompt_ids=convs[s],
+                        input_ids=convs[s],
+                        gconfig=GenerationHyperparameters(
+                            max_new_tokens=max_new, greedy=True
+                        ),
+                    )
+                )
+                while eng.has_work:
+                    eng.step()
+                o = eng.drain_results()[qid]
+                streams[qid] = list(o.output_ids)
+                convs[s] = (
+                    convs[s]
+                    + list(o.output_ids)
+                    + rngs[s].integers(
+                        0, cfg.vocab_size, (user_len,)
+                    ).tolist()
+                )
+        st = eng.prefix_cache_stats()
+        row = {
+            "pool_tokens": int(pool_tokens),
+            "pool_blocks": int(eng.n_blocks),
+            "pool_bytes": int(
+                eng._pool_block_bytes() * eng.n_blocks
+            ),
+            "capacity_blocks": int(st["capacity_blocks"]),
+            "cached_token_frac": round(
+                st["cached_tokens_total"] / max(prompt_toks, 1), 3
+            ),
+            "prefill_tokens": int(eng.prefill_tokens_total),
+        }
+        del eng
+        return streams, row
+
+    try:
+        fp_rep_streams, fp_rep = replay_arm("auto", fp_pool_tokens, "r")
+        # equal HBM: scale the int8 arm's pool tokens by the measured
+        # per-block byte ratio so both arms' pools cost the same bytes
+        bb = out.get("bytes_per_block")
+        ratio = (
+            bb["auto"] / bb["int8"]
+            if isinstance(bb, dict)
+            else 2.0
+        )
+        q_pool_tokens = int(fp_pool_tokens * ratio)
+        q_rep_streams, q_rep = replay_arm("int8", q_pool_tokens, "r")
+        rep_div, rep_n_div = lcp_divergence(fp_rep_streams, q_rep_streams)
+        out["prefix_equal_hbm"] = {
+            "auto": fp_rep,
+            "int8": q_rep,
+            "divergence_rate": rep_div,
+            "diverged_requests": int(rep_n_div),
+            "cached_token_frac_gain": round(
+                q_rep["cached_token_frac"] - fp_rep["cached_token_frac"],
+                3,
+            ),
+        }
+    except Exception as e:  # noqa: BLE001
+        out["prefix_equal_hbm"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        out["dropped"].append("prefix_equal_hbm")
     return out
 
 
@@ -1897,6 +2178,7 @@ SUMMARY_REQUIRED_KEYS = (
     "prefill_ab",
     "prefix_cache_ab",
     "prefix_cache_hier",
+    "kv_quant_ab",
     "trace_overhead_ab",
     "spec_decode_ab",
     "slo_report",
@@ -1914,6 +2196,7 @@ def build_summary(
     prefill_ab=None,
     prefix_cache_ab=None,
     prefix_cache_hier=None,
+    kv_quant_ab=None,
     trace_overhead_ab=None,
     spec_decode_ab=None,
     slo_report=None,
@@ -1951,6 +2234,7 @@ def build_summary(
         "prefill_ab": prefill_ab,
         "prefix_cache_ab": prefix_cache_ab,
         "prefix_cache_hier": prefix_cache_hier,
+        "kv_quant_ab": kv_quant_ab,
         "trace_overhead_ab": trace_overhead_ab,
         "spec_decode_ab": spec_decode_ab,
         "slo_report": slo_report,
@@ -2732,6 +3016,28 @@ def main():
         ),
     )
 
+    # quantized KV cache A/B: fp vs int8 paged pools at equal budgets —
+    # blocks-per-HBM-byte gain, decode tok/s, max rows at a fixed byte
+    # budget, prefix-cache cached_token_frac at equal HBM, and the
+    # MEASURED greedy divergence rate per workload (the quality gate).
+    # Runs off-TPU too — tiny shapes — so the summary always carries the
+    # >=1.8x density + quality-bar acceptance numbers.
+    mark("kv quant A/B")
+    kv_quant_ab = _section(
+        bench_kv_quant_ab,
+        cfg,
+        gen_params,
+        name="kv_quant_ab",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                n_reqs=2, prompt_len=48, max_new=12, page=16, chunk=8,
+                turns=2, sessions=3, user_len=8,
+            )
+        ),
+    )
+
     # request-level SLO report: fleet-merged TTFT/TPOT percentiles under
     # the multi-turn replay + spec-decode workloads, digest-merge
     # cross-check, and the SLO-tracking on/off overhead A/B (<2% bar).
@@ -2995,6 +3301,7 @@ def main():
         prefill_ab=prefill_ab,
         prefix_cache_ab=prefix_cache_ab,
         prefix_cache_hier=prefix_cache_hier,
+        kv_quant_ab=kv_quant_ab,
         trace_overhead_ab=trace_overhead_ab,
         spec_decode_ab=spec_decode_ab,
         slo_report=slo_report,
@@ -3056,6 +3363,7 @@ def main():
                     "prefix_reuse": prefix_reuse,
                     "prefix_cache_ab": prefix_cache_ab,
                     "prefix_cache_hier": prefix_cache_hier,
+                    "kv_quant_ab": kv_quant_ab,
                     "trace_overhead_ab": trace_overhead_ab,
                     "spec_decode_ab": spec_decode_ab,
                     "slo_report": slo_report,

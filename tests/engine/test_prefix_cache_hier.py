@@ -244,6 +244,52 @@ def test_spill_restore_replay_parity_and_no_leak():
     assert st["host_bytes_held"] == 0 and st["host_blocks_held"] == 0
 
 
+@pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+def test_host_bytes_accounting_exact(kv_dtype):
+    """``host_bytes_held`` must be EXACT for both storage formats: the
+    budget unit (``block_bytes``) derives from the pool arrays' actual
+    itemsize — int8 data + f32 scales for quantized pools, model dtype
+    otherwise — and equals the true nbytes of every spilled payload.
+    An int8 pool's spilled block costs well under half the fp one."""
+    eng, *_ = _pressure_engine(kv_cache_dtype=kv_dtype)
+    _replay(eng, n_sessions=3, turns=1)
+    cache = eng._prefix_cache
+    # block_bytes comes from the allocated arrays, not assumed dtype
+    assert cache.block_bytes == eng._pool_block_bytes()
+    expected = sum(int(a.nbytes) for a in eng._pool_arrays()) // eng.n_blocks
+    assert cache.block_bytes == expected
+    # force everything cached out to the host tier
+    cache.evict(eng.prefix_cache_stats()["blocks_held"])
+    st = eng.prefix_cache_stats()
+    assert st["host_blocks_held"] > 0
+    assert (
+        st["host_bytes_held"]
+        == st["host_blocks_held"] * cache.block_bytes
+    )
+    # every spilled payload's true host nbytes == the accounted unit
+    # (scales included on the int8 arm: 4 components, not 2)
+    stack = list(cache._root.children.values())
+    n_checked = 0
+    while stack:
+        node = stack.pop()
+        stack.extend(node.children.values())
+        if node.spilled and node.host_kv is not None:
+            assert (
+                sum(int(a.nbytes) for a in node.host_kv)
+                == cache.block_bytes
+            )
+            assert len(node.host_kv) == (4 if kv_dtype == "int8" else 2)
+            n_checked += 1
+    assert n_checked > 0
+    if kv_dtype == "int8":
+        fp_eng, *_ = _pressure_engine()
+        assert cache.block_bytes < fp_eng._pool_block_bytes() / 1.8
+    # flush drains the byte account to exactly zero
+    cache.flush()
+    st = eng.prefix_cache_stats()
+    assert st["host_bytes_held"] == 0 and st["host_blocks_held"] == 0
+
+
 def test_weight_swap_flushes_host_tier():
     """No token may ever come from pre-swap KV — including KV parked in
     HOST memory: after update_weights both tiers are empty and the next

@@ -45,6 +45,15 @@ def three_live_workers():
     gen.counter("areal_inference_prefix_host_spilled_blocks_total").inc(6)
     gen.counter("areal_inference_prefix_host_restored_blocks_total").inc(2)
     gen.gauge("areal_inference_prefix_host_bytes").set(4096.0)
+    # quantized KV storage: dtype gauge + residency + divergence checks
+    gen.gauge("areal_inference_kv_quant_storage_bits").set(8.0)
+    gen.gauge("areal_inference_kv_quant_blocks").set(24.0)
+    gen.counter(
+        "areal_inference_kv_quant_divergence_checks_total"
+    ).inc(10)
+    gen.counter(
+        "areal_inference_kv_quant_divergence_diverged_total"
+    ).inc(1)
 
     servers = []
     for wname, reg in (
@@ -113,6 +122,29 @@ def test_discovers_and_scrapes_three_live_workers(
     assert (
         flat["cluster/gen_server_0/areal_inference_prefix_host_bytes"]
         == 4096.0
+    )
+    # the quantized-KV family survives the scrape cycle too
+    assert (
+        flat["cluster/gen_server_0/areal_inference_kv_quant_storage_bits"]
+        == 8.0
+    )
+    assert (
+        flat["cluster/gen_server_0/areal_inference_kv_quant_blocks"]
+        == 24.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_kv_quant_divergence_checks_total"
+        ]
+        == 10.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_kv_quant_divergence_diverged_total"
+        ]
+        == 1.0
     )
     # histogram buckets are dropped from the flat view (sum/count kept)
     assert not any("_bucket" in k for k in flat)
