@@ -215,6 +215,22 @@ class GenServerConfig:
     # weight swaps always flush both tiers.  Single-process engines
     # only (multi-host SPMD serving auto-disables with a warning).
     prefix_cache_host_bytes: int = 0
+    # P/D disaggregation: the serving role this server registers under
+    # (the SGLang/vLLM prefill/decode-disaggregation deployment knob).
+    # "unified" (default) serves both stages exactly as before.  With
+    # both "prefill" and "decode" servers registered, the gserver
+    # manager routes every NEW request to a prefill server, which runs
+    # chunked prefill + first token, exports the row's paged KV blocks
+    # as a handoff unit, and pushes them to the decode server that owns
+    # the request; continuations sticky-route to the decode server and
+    # resume with zero prefill.  Version skew across a weight swap
+    # fails the handoff closed (the decode server re-prefills — stale
+    # KV is never decoded).  Single-process servers only.
+    role: str = "unified"
+    # per-handoff timeout for the import_handoff RPC to the decode peer
+    # (a dead peer must not wedge the prefill server's poll loop; on
+    # timeout the continuation re-prefills on the decode server)
+    handoff_request_timeout: float = 60.0
     # self-speculative n-gram decoding on the paged path (default off);
     # maps SGLang's ngram speculative mode / vLLM's ngram
     # speculative_config — see SpecDecodeConfig + docs

@@ -691,6 +691,14 @@ class ContinuousBatchingEngine:
         # and batched swap-in dispatches (one async scatter each)
         self.host_spill_rounds_total = 0
         self.host_restore_rounds_total = 0
+        # prefill/decode disaggregation: paged-block KV handoff counters
+        # (exports on the prefill role, imports/rejects on the decode
+        # role; bytes/seconds cover the host round trip on both sides)
+        self.handoff_exports_total = 0
+        self.handoff_imports_total = 0
+        self.handoff_bytes_total = 0
+        self.handoff_seconds_total = 0.0
+        self.handoff_import_rejects: Dict[str, int] = {}
         # decode-loop time attribution (cumulative seconds): host = admit/
         # bookkeeping/dispatch-enqueue, device = blocked waiting for chunk
         # compute, fetch = device->host transfer after completion.  The
@@ -1008,23 +1016,30 @@ class ContinuousBatchingEngine:
 
     def _spill_gather(self, blocks: List[int]):
         """Batched device->host gather of whole pool blocks (the cache's
-        ``spill_fetch``): one jitted gather + one blocking ``device_get``
-        per reclamation round, power-of-two padded so repeated rounds
-        reuse a handful of compiled shapes.  Returns host (k, v[, ks,
-        vs]) arrays indexed ``[i] -> blocks[i]`` — int8 pools spill the
-        quantized bytes plus their scale slices, half or less the host
-        RAM of a model-dtype spill."""
-        n = len(blocks)
-        n_pad = 1 << (n - 1).bit_length()
-        idx = np.zeros((n_pad,), np.int32)
-        idx[:n] = blocks
-        out = paged.gather_blocks(
-            self.k_pool, self.v_pool, jnp.asarray(idx),
+        ``spill_fetch``), via the shared :func:`paged.gather_blocks_host`
+        helper — int8 pools spill the quantized bytes plus their scale
+        slices, half or less the host RAM of a model-dtype spill."""
+        out = paged.gather_blocks_host(
+            self.k_pool, self.v_pool, blocks,
             k_scale=self.k_scale, v_scale=self.v_scale,
         )
-        out = jax.device_get(out)
         self.host_spill_rounds_total += 1
-        return tuple(np.asarray(a)[:n] for a in out)
+        return out
+
+    def _scatter_host_payloads(self, payloads, blocks: List[int]):
+        """Dispatch ONE batched async scatter of host block payloads
+        (per-block component tuples, as produced by the shared gather
+        helper) into ``blocks`` — the device half of a host-tier swap-in
+        AND of a handoff import.  The transfer rides under whatever
+        decode chunks are queued behind it in the in-flight ring."""
+        out = paged.restore_blocks_from_host(
+            self.k_pool, self.v_pool, payloads, blocks,
+            k_scale=self.k_scale, v_scale=self.v_scale,
+        )
+        if self._kv_quant:
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale) = out
+        else:
+            self.k_pool, self.v_pool = out
 
     def _restore_spilled(self, nodes, keep_qids=()) -> bool:
         """Swap spilled prefix blocks back into the pool: allocate fresh
@@ -1043,32 +1058,7 @@ class ContinuousBatchingEngine:
         if blocks is None:
             return False
         payloads = self._prefix_cache.begin_restore(nodes)
-        n_pad = 1 << (n - 1).bit_length()
-        # stack each payload component (k, v[, k_scale, v_scale]) into
-        # one batched host buffer; component shapes/dtypes come from the
-        # payloads themselves so int8+scale spills restore bit-identically
-        stacked = []
-        for c, proto in enumerate(payloads[0]):
-            buf = np.zeros((n_pad,) + proto.shape, proto.dtype)
-            for i, payload in enumerate(payloads):
-                buf[i] = payload[c]
-            stacked.append(jnp.asarray(buf))
-        dst = np.full((n_pad,), self.n_blocks, np.int32)  # pad -> dropped
-        dst[:n] = blocks
-        if self._kv_quant:
-            kh, vh, ksh, vsh = stacked
-            (self.k_pool, self.v_pool, self.k_scale, self.v_scale) = (
-                paged.restore_blocks(
-                    self.k_pool, self.v_pool, kh, vh, jnp.asarray(dst),
-                    k_scale=self.k_scale, v_scale=self.v_scale,
-                    k_scale_host=ksh, v_scale_host=vsh,
-                )
-            )
-        else:
-            kh, vh = stacked
-            self.k_pool, self.v_pool = paged.restore_blocks(
-                self.k_pool, self.v_pool, kh, vh, jnp.asarray(dst)
-            )
+        self._scatter_host_payloads(payloads, blocks)
         self._prefix_cache.complete_restore(
             nodes, blocks, ready_step=self._step_seq + 1
         )
@@ -1158,6 +1148,183 @@ class ContinuousBatchingEngine:
         if self._prefix_cache is None:
             return RadixPrefixCache.zero_stats()
         return self._prefix_cache.stats()
+
+    # -- prefill/decode disaggregation: paged-block KV handoff ---------------
+
+    def export_handoff(self, qid: str) -> Optional[Dict[str, Any]]:
+        """Export a PARKED row's cache state as a handoff unit: the host
+        request state plus every pool block's KV gathered to host numpy
+        (the shared :func:`paged.gather_blocks_host` — int8 pools export
+        quantized bytes + scales, bit-identical on restore).  The row is
+        released; its blocks stay resident only through the radix
+        cache's own references (the park already inserted them), so a
+        sibling landing here later still reuses the prefix.
+
+        Returns None when no parked row carries ``qid`` (already evicted
+        by a weight swap or TTL — the decode side re-prefills) or on a
+        dense engine.  This is the prefill role's half of the
+        P/D-disaggregated serving path."""
+        if not self.paged:
+            return None
+        for row_id, row in enumerate(self.rows):
+            if row is None or not row.parked or row.req.qid != qid:
+                continue
+            blocks = list(self._row_blocks[row_id])
+            if not blocks:
+                return None
+            tik = time.perf_counter()
+            payload = paged.gather_blocks_host(
+                self.k_pool, self.v_pool, blocks,
+                k_scale=self.k_scale, v_scale=self.v_scale,
+            )
+            unit = {
+                "qid": qid,
+                "req": row.req,
+                "prompt": list(row.prompt),
+                "generated": list(row.generated),
+                "logprobs": list(row.logprobs),
+                # the weight version this KV was computed under: the
+                # importer must match it exactly or fail closed
+                "version": self.version,
+                "page_size": self.page_size,
+                "kv_cache_dtype": self.kv_cache_dtype,
+                "payload": payload,
+            }
+            self._release_row(row_id)
+            n_bytes = int(sum(a.nbytes for a in payload))
+            self.handoff_exports_total += 1
+            self.handoff_bytes_total += n_bytes
+            self.handoff_seconds_total += time.perf_counter() - tik
+            self.tracer.event(
+                qid, "engine.handoff_export",
+                row=row_id, blocks=len(blocks), bytes=n_bytes,
+                version=self.version,
+            )
+            return unit
+        return None
+
+    def _reject_handoff(self, qid: str, reason: str) -> Tuple[bool, str]:
+        self.handoff_import_rejects[reason] = (
+            self.handoff_import_rejects.get(reason, 0) + 1
+        )
+        self.tracer.event(
+            qid, "engine.handoff_import", ok=False, reason=reason
+        )
+        logger.info("handoff import of %s rejected: %s", qid, reason)
+        return False, reason
+
+    def import_handoff(self, unit: Dict[str, Any]) -> Tuple[bool, str]:
+        """Import a handoff unit exported by a prefill-role peer: scatter
+        the host KV payload into freshly allocated pool blocks (one
+        batched async dispatch riding under the decode ring) and park
+        the row, so the continuation request — sticky-routed here by the
+        manager — resumes through the ordinary ``_try_resume`` path with
+        ZERO prefill.  The handed-off prefix also enters this engine's
+        radix cache.
+
+        Fails CLOSED on any skew: a unit whose weight ``version``
+        differs from this engine's (a swap raced the handoff) is
+        REJECTED — stale KV is never decoded; the continuation simply
+        re-prefills under the current weights.  Layout mismatches
+        (page size, kv dtype, context length) and pool/row exhaustion
+        reject the same way.  Returns ``(ok, reason)``."""
+        t0 = time.perf_counter()
+        qid = unit.get("qid", "?")
+        if not self.paged:
+            return self._reject_handoff(qid, "dense")
+        if (
+            unit.get("page_size") != self.page_size
+            or unit.get("kv_cache_dtype") != self.kv_cache_dtype
+        ):
+            return self._reject_handoff(qid, "layout")
+        if unit.get("version") != self.version:
+            return self._reject_handoff(qid, "version")
+        prompt = list(unit["prompt"])
+        generated = list(unit["generated"])
+        if not generated:
+            return self._reject_handoff(qid, "empty")
+        payload = unit["payload"]
+        n = len(payload[0])
+        # per-block payload geometry must match THIS pool exactly —
+        # [L, Hkv, BS, hd] (scales [L, Hkv, BS]) — or the scatter would
+        # raise mid-dispatch; a peer built from a different model config
+        # rejects here instead
+        pool_block_shape = self.k_pool.shape[:1] + self.k_pool.shape[2:]
+        if (
+            n > self.blocks_per_row
+            or len(prompt) + len(generated) + 1 >= self.kv_cache_len
+            or tuple(payload[0].shape[1:]) != pool_block_shape
+            or len(payload) != len(self._pool_arrays())
+        ):
+            return self._reject_handoff(qid, "layout")
+        rid = next(
+            (i for i, r in enumerate(self.rows) if r is None), None
+        )
+        # never evict live work for an import (the fallback is a plain
+        # re-prefill, not a correctness problem), and — like every other
+        # eviction site — spare parked rows whose own continuation is
+        # already queued: trading their zero-prefill resume for this
+        # import's would just move the re-prefill cost around
+        with self._lock:
+            queued = {r.qid for r in self._pending}
+        if rid is None:
+            rid = self._evict_parked(keep_qids=queued)
+        if rid is None:
+            rid = self._evict_parked()  # unprotected last resort
+        if rid is None:
+            return self._reject_handoff(qid, "capacity")
+        blocks = self._alloc_blocks_reclaiming(n, keep_qids=queued)
+        if blocks is None:
+            return self._reject_handoff(qid, "pool")
+        payloads = [tuple(a[i] for a in payload) for i in range(n)]
+        try:
+            self._scatter_host_payloads(payloads, blocks)
+        except Exception:  # noqa: BLE001 - free the blocks, fail closed
+            self._free_block_list(blocks)
+            logger.exception("handoff import scatter failed for %s", qid)
+            return self._reject_handoff(qid, "scatter")
+        row = _Row(
+            req=unit["req"],
+            prompt=prompt,
+            generated=generated,
+            logprobs=list(unit["logprobs"]),
+            version_start=self.version,
+            no_eos=True,
+            cur_token=int(generated[-1]),
+            parked=True,
+            park_step=self._step_seq,
+        )
+        self._epoch_counter += 1
+        row.epoch = self._epoch_counter
+        self.rows[rid] = row
+        self._set_row_blocks(rid, blocks)
+        # cached KV covers everything but the pending cur token
+        n_kv = len(prompt) + len(generated) - 1
+        self.kv_lengths = self.kv_lengths.at[
+            np.array([rid], np.int32)
+        ].set(n_kv)
+        self._cache_insert((prompt + generated)[:-1], blocks)
+        n_bytes = int(sum(a.nbytes for a in payload))
+        self.handoff_imports_total += 1
+        self.handoff_bytes_total += n_bytes
+        self.handoff_seconds_total += time.perf_counter() - t0
+        self.tracer.event(
+            qid, "engine.handoff_import",
+            ok=True, row=rid, blocks=n, bytes=n_bytes,
+            version=self.version,
+        )
+        return True, ""
+
+    def handoff_stats(self) -> Dict[str, Any]:
+        """Cumulative KV-handoff counters (worker scrape + metrics RPC +
+        bench)."""
+        return {
+            "exports_total": self.handoff_exports_total,
+            "imports_total": self.handoff_imports_total,
+            "bytes_total": self.handoff_bytes_total,
+            "seconds_total": self.handoff_seconds_total,
+            "import_rejects": dict(self.handoff_import_rejects),
+        }
 
     # -- client API (any thread) -------------------------------------------
 
@@ -1933,6 +2100,19 @@ class ContinuousBatchingEngine:
                     continue
                 row.cur_token = int(tok_i)
                 row.budget_left = tgt.max_new - 1
+                if (row.req.metadata or {}).get("handoff_to"):
+                    # prefill-role handoff: park RIGHT AFTER the fill +
+                    # first token instead of decoding — the worker
+                    # exports the parked row's blocks to the decode
+                    # server and the continuation resumes THERE.  The
+                    # device-side row length must be stamped here (a
+                    # normal park inherits it from its decode chunks).
+                    row.no_eos = True
+                    self.kv_lengths = self.kv_lengths.at[
+                        np.array([tgt.row_id], np.int32)
+                    ].set(plen)
+                    self._finish(tgt.row_id, row, park=True)
+                    continue
                 self._epoch_counter += 1
                 row.epoch = self._epoch_counter
                 activation.append(

@@ -46,7 +46,7 @@ behavior) and returns them updated whenever it returns the pools.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -676,6 +676,78 @@ def restore_blocks(
         v_scale_host.swapaxes(0, 1).astype(v_scale.dtype), mode="drop"
     )
     return k_pool, v_pool, k_scale, v_scale
+
+
+def gather_blocks_host(
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    blocks: Sequence[int],
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> Tuple[np.ndarray, ...]:
+    """Batched device->host copy of whole pool blocks: one jitted
+    :func:`gather_blocks` + one blocking ``device_get``, power-of-two
+    padded so repeated calls reuse a handful of compiled shapes.
+    Returns host numpy components indexed ``[i] -> blocks[i]`` —
+    ``(k, v)`` for model-dtype pools, ``(k, v, k_scale, v_scale)`` for
+    int8 pools (the quantized bytes and their scales travel together,
+    so a round trip through :func:`restore_blocks_from_host` is
+    bit-identical, no requantization).
+
+    The ONE host-copy implementation for every whole-block exporter:
+    the prefix cache's host spill tier and the P/D-disaggregation
+    handoff unit both ride it."""
+    n = len(blocks)
+    n_pad = 1 << (n - 1).bit_length()
+    idx = np.zeros((n_pad,), np.int32)
+    idx[:n] = blocks
+    out = gather_blocks(
+        k_pool, v_pool, jnp.asarray(idx), k_scale=k_scale, v_scale=v_scale
+    )
+    out = jax.device_get(out)
+    return tuple(np.asarray(a)[:n] for a in out)
+
+
+def restore_blocks_from_host(
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    payloads: Sequence[Tuple[np.ndarray, ...]],
+    dst: Sequence[int],
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+):
+    """Batched host->device scatter of per-block payload tuples (each as
+    produced by :func:`gather_blocks_host`, one tuple per destination
+    block): stacks the components into one padded transfer buffer and
+    dispatches ONE async :func:`restore_blocks` — the copy rides under
+    whatever decode chunks are queued behind it, and any later op
+    consuming the (donated) pools is sequenced after it by data
+    dependence.  Returns the updated pools: ``(k_pool, v_pool)`` or
+    ``(k_pool, v_pool, k_scale, v_scale)`` matching the pool format.
+
+    Component shapes/dtypes come from the payloads themselves, so int8
+    + scale spills restore bit-identically on quantized pools."""
+    n = len(payloads)
+    assert n == len(dst) and n > 0
+    n_pad = 1 << (n - 1).bit_length()
+    stacked = []
+    for c, proto in enumerate(payloads[0]):
+        buf = np.zeros((n_pad,) + proto.shape, proto.dtype)
+        for i, payload in enumerate(payloads):
+            buf[i] = payload[c]
+        stacked.append(jnp.asarray(buf))
+    # pad destinations point one past the pool: mode="drop" discards them
+    dst_arr = np.full((n_pad,), k_pool.shape[1], np.int32)
+    dst_arr[:n] = dst
+    if k_scale is not None:
+        kh, vh, ksh, vsh = stacked
+        return restore_blocks(
+            k_pool, v_pool, kh, vh, jnp.asarray(dst_arr),
+            k_scale=k_scale, v_scale=v_scale,
+            k_scale_host=ksh, v_scale_host=vsh,
+        )
+    kh, vh = stacked
+    return restore_blocks(k_pool, v_pool, kh, vh, jnp.asarray(dst_arr))
 
 
 @partial(

@@ -207,6 +207,39 @@ METRIC_TABLE = [
         "serving rollout is gated on)",
     ),
     MetricSpec(
+        "areal_inference_handoff_exports_total",
+        "counter",
+        "Paged-block KV handoff units exported by a prefill-role server "
+        "(one per request handed to a decode peer)",
+    ),
+    MetricSpec(
+        "areal_inference_handoff_imports_total",
+        "counter",
+        "Handoff units imported and parked by a decode-role server "
+        "(the continuation resumes over them with zero prefill)",
+    ),
+    MetricSpec(
+        "areal_inference_handoff_import_rejects_total",
+        "counter",
+        "Handoff imports rejected fail-closed, by reason (version = "
+        "weight-swap skew; layout | dense | capacity | pool | empty | "
+        "scatter); the continuation re-prefills on the decode server",
+        ("reason",),
+    ),
+    MetricSpec(
+        "areal_inference_handoff_bytes_total",
+        "counter",
+        "Host bytes moved by KV handoffs (export gathers + import "
+        "scatters; int8 pools move quantized bytes + scales)",
+    ),
+    MetricSpec(
+        "areal_inference_handoff_seconds_total",
+        "counter",
+        "Time spent in KV-handoff device<->host block copies (export "
+        "gather on the prefill side + import scatter dispatch on the "
+        "decode side)",
+    ),
+    MetricSpec(
         "areal_inference_inflight_rows",
         "gauge",
         "Rows currently decoding or chunk-filling",
@@ -356,6 +389,20 @@ METRIC_TABLE = [
         "counter",
         "Sessions re-routed away from their prefix-hot server because "
         "the load-imbalance escape hatch fired",
+    ),
+    MetricSpec(
+        "areal_gserver_pd_role_servers",
+        "gauge",
+        "Registered generation servers per serving role (prefill | "
+        "decode | unified); two-stage P/D routing is active iff both "
+        "prefill and decode are nonzero",
+        ("role",),
+    ),
+    MetricSpec(
+        "areal_gserver_pd_handoff_routes_total",
+        "counter",
+        "New requests routed through the two-stage prefill->handoff->"
+        "decode path (continuations sticky-route and are not counted)",
     ),
     MetricSpec(
         "areal_gserver_weight_update_pause_seconds",
@@ -571,6 +618,13 @@ TRACE_TABLE = [
         "prompt_len, version)",
     ),
     TraceSpec(
+        "gserver.handoff_route",
+        "event",
+        "New request routed through the two-stage P/D path (attrs: "
+        "prefill = the server filling the blocks, decode = the server "
+        "owning the request after the handoff)",
+    ),
+    TraceSpec(
         "gserver.finish",
         "event",
         "Rollout slot released at the manager (attrs: accepted)",
@@ -627,6 +681,19 @@ TRACE_TABLE = [
         "ring drain -> pointer flip (or legacy full reload) -> prefix "
         "flush -> in-flight recompute (attrs: version, pre_sharded, "
         "interrupted)",
+    ),
+    TraceSpec(
+        "engine.handoff_export",
+        "event",
+        "Parked prefill row's KV blocks gathered to host and exported "
+        "as a handoff unit (attrs: row, blocks, bytes, version)",
+    ),
+    TraceSpec(
+        "engine.handoff_import",
+        "event",
+        "Handoff unit imported (scattered into fresh pool blocks and "
+        "parked for resume) or rejected fail-closed (attrs: ok, reason "
+        "on reject, row, blocks, bytes, version)",
     ),
     TraceSpec(
         "engine.finish",

@@ -26,23 +26,44 @@ from areal_tpu.system import worker_base
 logger = logging_.getLogger("generation_server")
 
 
-def format_server_registration(addr: str, mesh_spec) -> str:
+#: serving roles a generation server may register under.  ``prefill``
+#: servers run chunked prefill and hand finished rows' KV blocks to a
+#: ``decode`` peer (P/D disaggregation); ``unified`` (the default, and
+#: what every legacy registration parses as) does both.
+SERVER_ROLES = ("prefill", "decode", "unified")
+
+
+def format_server_registration(
+    addr: str, mesh_spec, role: str = "unified"
+) -> str:
     """Registration value for the gen_servers name-resolve subtree:
-    ``addr|mesh_devices|mesh_spec``.  One "server" = one mesh: the
+    ``addr|mesh_devices|mesh_spec|role``.  One "server" = one mesh: the
     gserver manager scales capacity accounting and routing weights by
     the chip count, so a 4-chip TP/EP server absorbs 4x the load of a
-    single-chip one instead of being treated as an equal peer."""
-    return f"{addr}|{mesh_spec.world_size}|{mesh_spec}"
+    single-chip one instead of being treated as an equal peer.  ``role``
+    opts the server into the manager's two-stage prefill/decode routing
+    (omitted for ``unified``, so unified registrations are byte-stable
+    across versions)."""
+    base = f"{addr}|{mesh_spec.world_size}|{mesh_spec}"
+    if role and role != "unified":
+        if role not in SERVER_ROLES:
+            raise ValueError(f"unknown server role {role!r}")
+        base += f"|{role}"
+    return base
 
 
-def parse_server_registration(value: str) -> Tuple[str, int, str]:
-    """``(addr, mesh_devices, mesh_spec_str)`` from a registration value;
-    bare-address values (older registrations) parse as one device."""
+def parse_server_registration(value: str) -> Tuple[str, int, str, str]:
+    """``(addr, mesh_devices, mesh_spec_str, role)`` from a registration
+    value; bare-address values (older registrations) parse as one device,
+    and registrations without a role field parse as ``unified``."""
     parts = value.split("|")
     addr = parts[0]
     devices = int(parts[1]) if len(parts) > 1 and parts[1] else 1
     spec = parts[2] if len(parts) > 2 else ""
-    return addr, max(1, devices), spec
+    role = parts[3] if len(parts) > 3 and parts[3] else "unified"
+    if role not in SERVER_ROLES:
+        role = "unified"
+    return addr, max(1, devices), spec, role
 
 # ctrl-stream high-water mark (messages, each ~100s of bytes): bounds the
 # leader's buffer at ~10s of MB if a follower wedges, yet is ~100x deeper
@@ -79,6 +100,27 @@ class GenerationServerWorker(worker_base.Worker):
         # span hosts (the reference's multi-node SGLang server role)
         self._n_procs = max(1, config.num_processes)
         self._is_leader = config.process_id == 0
+        # P/D disaggregation: the serving role this server registers
+        # under (routing hint for the manager; the handoff mechanics are
+        # driven per-request by the ``handoff_to`` metadata the client
+        # copies from its schedule response, so a unified fleet never
+        # pays anything for the feature existing)
+        self._role = getattr(config, "role", "unified") or "unified"
+        if self._role not in SERVER_ROLES:
+            raise ValueError(
+                f"unknown server role {self._role!r}; expected "
+                "prefill | decode | unified"
+            )
+        if self._role != "unified" and self._n_procs > 1:
+            # the handoff unit is a full (unsharded) host copy of the
+            # row's blocks; a multi-controller SPMD server only
+            # addresses its local kv-head shard, so P/D roles are
+            # single-process servers for now (cross-host MESHES decode
+            # fine as unified)
+            raise ValueError(
+                "prefill/decode roles need a single-process server; "
+                "multi-host SPMD servers must register as unified"
+            )
         if self._n_procs > 1:
             from areal_tpu.parallel import distributed as dist
 
@@ -162,11 +204,14 @@ class GenerationServerWorker(worker_base.Worker):
             self._sock = self._ctx.socket(zmq.ROUTER)
             port = self._sock.bind_to_random_port("tcp://*")
             self.addr = f"{network.gethostip()}:{port}"
-            # registration carries the mesh shape: the manager weights
-            # this server's capacity/routing by its chip count
+            # registration carries the mesh shape + serving role: the
+            # manager weights this server's capacity/routing by its chip
+            # count and slots it into the prefill/decode pools
             name_resolve.add(
                 base_key,
-                format_server_registration(self.addr, config.mesh_spec),
+                format_server_registration(
+                    self.addr, config.mesh_spec, role=self._role
+                ),
                 replace=True,
             )
             if self._n_procs > 1:
@@ -214,6 +259,18 @@ class GenerationServerWorker(worker_base.Worker):
         # qid -> ROUTER identity awaiting the result (leader only)
         self._waiting: Dict[str, bytes] = {}
         self._update_reply_idents = []  # clients awaiting update_weights
+        self._import_reply_idents = []  # clients awaiting import_handoff
+        # P/D handoff plumbing: destination decode server per in-flight
+        # handoff-flagged request, lazily created peer clients, and the
+        # in-flight pushes — the peer RPC runs on a small thread pool so
+        # a slow or dead decode peer can never stall this server's poll
+        # loop (the client reply is deferred until the push settles; the
+        # RPC's own timeout bounds the deferral)
+        self._handoff_dest: Dict[str, str] = {}
+        self._peer_clients: Dict[str, "GenServerClient"] = {}
+        self._handoff_pool = None
+        self._handoff_futs: Dict[str, object] = {}
+        self._handoff_out: Dict[str, object] = {}
         # in-flight staged weight restore (update_weights mode="stage"):
         # a background thread restores the snapshot into a device-resident
         # staging tree while decode continues; the RPC reply is deferred
@@ -280,6 +337,18 @@ class GenerationServerWorker(worker_base.Worker):
             "kv_quant_diverged": reg.counter(
                 "areal_inference_kv_quant_divergence_diverged_total"
             ),
+            "handoff_exports": reg.counter(
+                "areal_inference_handoff_exports_total"
+            ),
+            "handoff_imports": reg.counter(
+                "areal_inference_handoff_imports_total"
+            ),
+            "handoff_bytes": reg.counter(
+                "areal_inference_handoff_bytes_total"
+            ),
+            "handoff_seconds": reg.counter(
+                "areal_inference_handoff_seconds_total"
+            ),
             "swap_stage": reg.counter(
                 "areal_inference_swap_stage_seconds_total"
             ),
@@ -308,6 +377,12 @@ class GenerationServerWorker(worker_base.Worker):
             "kv_quant_blocks": reg.gauge("areal_inference_kv_quant_blocks"),
             "mesh_devices": reg.gauge("areal_inference_mesh_devices"),
         }
+        # handoff import rejects carry a reason label (version skew vs
+        # layout vs capacity); mirrored as per-reason counter deltas
+        self._obs_handoff_rejects = reg.counter(
+            "areal_inference_handoff_import_rejects_total"
+        )
+        self._obs_handoff_rejects_last: Dict[str, int] = {}
         self._obs_accept_hist = reg.histogram(
             "areal_inference_spec_accept_rate",
             buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
@@ -339,6 +414,7 @@ class GenerationServerWorker(worker_base.Worker):
         pstats = eng.prefix_cache_stats()
         sstats = eng.spec_stats()
         qstats = eng.kv_quant_stats()
+        hstats = eng.handoff_stats()
         totals = {
             "chunks": float(eng.chunks_total),
             "host": eng.time_host_s,
@@ -366,6 +442,10 @@ class GenerationServerWorker(worker_base.Worker):
             "kv_quant_diverged": float(
                 qstats["divergence_diverged_total"]
             ),
+            "handoff_exports": float(hstats["exports_total"]),
+            "handoff_imports": float(hstats["imports_total"]),
+            "handoff_bytes": float(hstats["bytes_total"]),
+            "handoff_seconds": float(hstats["seconds_total"]),
             "swap_stage": eng.swap_stage_s,
             "swap_pause": eng.swap_pause_s,
             "swaps": float(eng.swaps_total),
@@ -376,6 +456,11 @@ class GenerationServerWorker(worker_base.Worker):
             if delta > 0:
                 self._obs[key].inc(delta)
                 self._obs_last[key] = total
+        for reason, total in hstats["import_rejects"].items():
+            delta = total - self._obs_handoff_rejects_last.get(reason, 0)
+            if delta > 0:
+                self._obs_handoff_rejects.inc(delta, reason=reason)
+                self._obs_handoff_rejects_last[reason] = total
         for frac in eng.drain_spec_accept_samples():
             self._obs_accept_hist.observe(frac)
         for rec in eng.drain_slo_records():
@@ -416,8 +501,20 @@ class GenerationServerWorker(worker_base.Worker):
                 cmd, payload = pickle.loads(msg)
                 if cmd == "generate":
                     self._waiting[payload.qid] = ident
+                    dest = (payload.metadata or {}).get("handoff_to")
+                    if dest:
+                        # prefill-stage request: after the fill parks the
+                        # row, export its KV to this decode peer BEFORE
+                        # the client reply goes out (_reply_finished)
+                        self._handoff_dest[payload.qid] = dest
                     batch.append((cmd, payload))
                     continue  # reply when the result is ready
+                elif cmd == "import_handoff":
+                    # state-mutating (a pool scatter): rides the lockstep
+                    # batch like generate/update; reply after the apply
+                    self._import_reply_idents.append(ident)
+                    batch.append((cmd, payload))
+                    continue
                 elif cmd == "update_weights":
                     self._update_reply_idents.append(ident)
                     batch.append((cmd, payload))
@@ -484,19 +581,106 @@ class GenerationServerWorker(worker_base.Worker):
                         "controller — versions would diverge across "
                         "the lockstep mesh"
                     ) from commit_failed
+            elif cmd == "import_handoff":
+                try:
+                    ok, reason = self.engine.import_handoff(payload["unit"])
+                    resp = {"imported": ok, "reason": reason}
+                except Exception as e:  # noqa: BLE001 - peer re-prefills
+                    self.logger.exception("handoff import failed")
+                    resp = {"error": repr(e)}
+                if self._is_leader and self._import_reply_idents:
+                    ident = self._import_reply_idents.pop(0)
+                    self._sock.send_multipart(
+                        [ident, b"", pickle.dumps(resp)]
+                    )
             elif cmd == "pause":
                 self.engine.pause()
             elif cmd == "resume":
                 self.engine.resume()
 
     def _reply_finished(self):
+        # settle in-flight handoff pushes first: a finished push frees
+        # its request's deferred client reply
+        for qid in list(self._handoff_futs):
+            fut = self._handoff_futs[qid]
+            if not fut.done():
+                continue
+            del self._handoff_futs[qid]
+            out = self._handoff_out.pop(qid)
+            ident = self._waiting.pop(qid)
+            self._sock.send_multipart([ident, b"", pickle.dumps(out)])
         if not self._waiting:
             return
         for qid in list(self._waiting):
+            if qid in self._handoff_futs:
+                continue  # reply deferred until the push settles
             out = self.engine.try_get_result(qid)
             if out is not None:
+                dest = self._handoff_dest.pop(qid, None)
+                if dest is not None and out.no_eos and out.output_ids:
+                    # the handoff COMPLETES before the client reply: the
+                    # continuation the client schedules next must find
+                    # the imported row already parked on the decode
+                    # server (an EOS'd or empty result has nothing to
+                    # continue, so nothing moves).  The export (a local
+                    # device gather) runs here on the engine's thread;
+                    # the peer RPC runs pooled so the poll loop never
+                    # blocks on a slow or dead peer.
+                    if self._begin_handoff(qid, dest, out):
+                        continue
                 ident = self._waiting.pop(qid)
                 self._sock.send_multipart([ident, b"", pickle.dumps(out)])
+
+    def _begin_handoff(self, qid: str, dest: str, out) -> bool:
+        """Export the parked prefill row's KV blocks (on this thread —
+        the engine is single-threaded) and start the ``import_handoff``
+        push to the decode peer on the handoff thread pool.  Returns
+        True iff a push is in flight (the caller defers the client
+        reply until it settles).  Every failure is non-fatal and
+        FAIL-CLOSED: the peer rejects skewed or unplaceable units, a
+        dead peer times out at ``handoff_request_timeout``, and in all
+        cases the continuation simply re-prefills on the decode server
+        under its own weights — stale KV is never decoded."""
+        unit = self.engine.export_handoff(qid)
+        if unit is None:
+            return False  # row already evicted (swap/TTL): re-prefill
+        if dest not in self._peer_clients:
+            self._peer_clients[dest] = GenServerClient(
+                dest, timeout=self.config.handoff_request_timeout
+            )
+        client = self._peer_clients[dest]
+
+        def push():
+            try:
+                resp = client.call(
+                    "import_handoff",
+                    {"unit": unit},
+                    timeout=self.config.handoff_request_timeout,
+                )
+                if not (isinstance(resp, dict) and resp.get("imported")):
+                    self.logger.warning(
+                        "handoff of %s rejected by %s (%s); the decode "
+                        "server re-prefills",
+                        qid, dest,
+                        (resp or {}).get("reason")
+                        if isinstance(resp, dict)
+                        else resp,
+                    )
+            except Exception as e:  # noqa: BLE001 - fail closed
+                self.logger.warning(
+                    "handoff of %s to %s failed (%r); the decode server "
+                    "re-prefills", qid, dest, e,
+                )
+
+        if self._handoff_pool is None:
+            import concurrent.futures as cf
+
+            self._handoff_pool = cf.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="kv-handoff"
+            )
+        self._handoff_out[qid] = out
+        self._handoff_futs[qid] = self._handoff_pool.submit(push)
+        return True
 
     def _update_weights(self, payload: Dict) -> int:
         """Load new weights (from the trainer's realloc dir) and hot-swap.
@@ -707,6 +891,12 @@ class GenerationServerWorker(worker_base.Worker):
                 f"kv_quant_{k}": v
                 for k, v in self.engine.kv_quant_stats().items()
             },
+            # P/D disaggregation: this server's role + KV-handoff volume
+            "role": self._role,
+            **{
+                f"handoff_{k}": v
+                for k, v in self.engine.handoff_stats().items()
+            },
             # decode-loop host/device/fetch attribution (cumulative s)
             **{
                 f"time_{k}": v
@@ -762,6 +952,11 @@ class GenerationServerWorker(worker_base.Worker):
         return worker_base.PollResult(sample_count=n)
 
     def _exit_hook(self):
+        for client in getattr(self, "_peer_clients", {}).values():
+            client.close()  # aborts any in-flight pooled push promptly
+        pool = getattr(self, "_handoff_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
         for name in ("_sock", "_ctrl_pub", "_ctrl_sub"):
             sock = getattr(self, name, None)
             if sock is not None:

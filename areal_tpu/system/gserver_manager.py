@@ -75,11 +75,60 @@ class GserverManager(worker_base.Worker):
                 )
             time.sleep(0.1)
         parsed = [parse_server_registration(v) for v in values]
-        self.server_addrs = [a for a, _, _ in parsed]
+        self.server_addrs = [a for a, _, _, _ in parsed]
         self._server_devices: Dict[str, int] = {
-            a: d for a, d, _ in parsed
+            a: d for a, d, _, _ in parsed
         }
-        self._server_mesh: Dict[str, str] = {a: s for a, _, s in parsed}
+        self._server_mesh: Dict[str, str] = {a: s for a, _, s, _ in parsed}
+        # P/D disaggregation: servers register a serving role (prefill |
+        # decode | unified; legacy registrations parse as unified).  Two-
+        # stage routing activates iff the fleet holds BOTH a prefill and
+        # a decode server; prefill servers never OWN a request's resident
+        # state (their rows exist only between fill and handoff), so
+        # sticky routing, token accounting, and cache affinity all live
+        # on the decode pool.  The decode pool is DECODE-ROLE servers
+        # only: a decode registration is guaranteed single-process
+        # (generation_server validates at configure), while a unified
+        # registration carries no such guarantee — a multi-controller
+        # SPMD unified server cannot import a handoff unit (it only
+        # addresses its local kv-head shard), and routing owners there
+        # would make every request pay export + RPC + reject + full
+        # re-prefill.  Unified servers in a P/D fleet keep serving
+        # whatever reaches them directly, but receive no two-stage
+        # traffic.
+        self._server_role: Dict[str, str] = {a: r for a, _, _, r in parsed}
+        self._prefill_addrs = [
+            a for a in self.server_addrs
+            if self._server_role[a] == "prefill"
+        ]
+        decode_only = [
+            a for a in self.server_addrs
+            if self._server_role[a] == "decode"
+        ]
+        self._pd_enabled = bool(self._prefill_addrs) and bool(decode_only)
+        self._decode_addrs = (
+            decode_only if self._pd_enabled else list(self.server_addrs)
+        )
+        if self._prefill_addrs and not self._pd_enabled:
+            logger.warning(
+                "prefill-role servers registered without any decode-role "
+                "peer; two-stage P/D routing stays OFF (the fleet serves "
+                "unified)"
+            )
+        if self._pd_enabled and any(
+            self._server_role[a] == "unified" for a in self.server_addrs
+        ):
+            logger.warning(
+                "unified-role servers in a P/D fleet receive no "
+                "two-stage traffic (handoff owners must be decode-role "
+                "servers, whose single-process import capability is "
+                "validated at registration)"
+            )
+        #: rollout group -> its prefill-stage server (group members share
+        #: one prompt; colocating their fills lets the engine's block-
+        #: reference prompt dedup fire once per group)
+        self._group_prefill: Dict[str, str] = {}
+        self._pd_rr = 0
         self._clients = {a: GenServerClient(a) for a in self.server_addrs}
 
         # rollout accounting (reference: monitor.RolloutStat threading
@@ -151,6 +200,12 @@ class GserverManager(worker_base.Worker):
         self._m_affinity_escapes = reg.counter(
             "areal_gserver_affinity_escapes_total"
         )
+        # P/D disaggregation: registered servers per role + requests
+        # routed through the two-stage prefill->handoff->decode path
+        self._m_pd_roles = reg.gauge("areal_gserver_pd_role_servers")
+        self._m_pd_routes = reg.counter(
+            "areal_gserver_pd_handoff_routes_total"
+        )
         self._m_update_pause = reg.gauge(
             "areal_gserver_weight_update_pause_seconds"
         )
@@ -183,6 +238,11 @@ class GserverManager(worker_base.Worker):
             self._m_srv_reqs.set(self._server_load[addr], server=addr)
             self._m_srv_toks.set(self._server_tokens[addr], server=addr)
             self._m_srv_devices.set(self._devices(addr), server=addr)
+        roles = getattr(self, "_server_role", {})
+        for role in ("prefill", "decode", "unified"):
+            self._m_pd_roles.set(
+                sum(1 for r in roles.values() if r == role), role=role
+            )
 
     # -- scheduling / staleness --------------------------------------------
 
@@ -199,6 +259,60 @@ class GserverManager(worker_base.Worker):
         from areal_tpu.observability.tracing import member_root
 
         return member_root(qid)
+
+    def _route_pool(self) -> List[str]:
+        """Servers eligible to OWN a request's resident state: everybody
+        in a unified fleet; DECODE-ROLE servers only under two-stage P/D
+        routing (a prefill server's rows exist only between fill and
+        handoff, and a unified registration carries no single-process
+        import guarantee — see the _configure comment)."""
+        if getattr(self, "_pd_enabled", False):
+            return self._decode_addrs
+        return self.server_addrs
+
+    def _pick_prefill(self, group: str) -> str:
+        """Prefill-stage pick: group-affine (every member of a rollout
+        shares one prompt, and colocating their fills fires the engine's
+        block-reference prompt dedup once per group), else a chip-
+        weighted rotation — prefill residency is transient (fill ->
+        handoff -> gone), so there is no resident-token signal to
+        balance on and the rotation keeps every prefill mesh fed."""
+        cand = self._group_prefill.get(group)
+        if cand is not None:
+            return cand
+        wpool = [
+            a for a in self._prefill_addrs
+            for _ in range(self._devices(a))
+        ]
+        addr = wpool[self._pd_rr % len(wpool)]
+        self._pd_rr += 1
+        self._group_prefill[group] = addr
+        return addr
+
+    def _schedule_request(
+        self, qid: str, prompt_len: int = 0, new_token_budget: int = 0
+    ) -> Dict:
+        """The schedule RPC's full response.  Unified fleets: the owning
+        server's url, as ever.  Two-stage P/D fleets: a NEW request is
+        routed to a prefill server with ``handoff_to`` naming the decode
+        server that owns it — the prefill server fills the row's blocks,
+        hands the KV off, and every later continuation sticky-routes
+        straight to the decode server."""
+        sticky = qid in self._qid_server  # before _schedule registers it
+        addr = self._schedule(qid, prompt_len, new_token_budget)
+        resp = {"url": addr, "version": self._model_version}
+        if getattr(self, "_pd_enabled", False) and not sticky:
+            prefill = self._pick_prefill(self._group_key(qid))
+            if prefill != addr:
+                resp["url"] = prefill
+                resp["handoff_to"] = addr
+                self._m_pd_routes.inc()
+                self._tracer.event(
+                    qid, "gserver.handoff_route",
+                    root=self._group_key(qid),
+                    prefill=prefill, decode=addr,
+                )
+        return resp
 
     def _schedule(
         self, qid: str, prompt_len: int = 0, new_token_budget: int = 0
@@ -243,9 +357,8 @@ class GserverManager(worker_base.Worker):
         # whose signal differs from the imbalance signal (least_requests
         # on a few-huge-conversations server) re-picks the very server
         # the escape meant to leave
-        pool = [a for a in self.server_addrs if a != avoid] or list(
-            self.server_addrs
-        )
+        route_pool = self._route_pool()
+        pool = [a for a in route_pool if a != avoid] or list(route_pool)
         if sibling is not None:
             addr = sibling
         elif self.config.schedule_policy == "least_requests":
@@ -302,22 +415,25 @@ class GserverManager(worker_base.Worker):
             cand = max(sorted(prefixes), key=lambda a: prefixes[a])
         else:
             cand = self._group_server.get(group)
+        pool = self._route_pool()
         if (
             cand is None
             or not self.config.cache_aware_routing
-            or len(self.server_addrs) <= 1  # nowhere to escape to
+            or len(pool) <= 1  # nowhere to escape to
         ):
             return cand, None
         # imbalance = FOREIGN load on the hot server: the session's own
         # resident-token estimates are discounted, else a long
         # conversation would eventually evict itself from its hot cache
         # just by growing.  All sides are PER-CHIP: a 4-chip mesh is not
-        # "overloaded" for holding 4x a single chip's tokens.
+        # "overloaded" for holding 4x a single chip's tokens — and the
+        # comparison runs over the ROUTE pool only (a P/D fleet's
+        # prefill servers hold ~zero resident tokens by construction
+        # and would otherwise trip the escape on every long session).
         own = self._group_tokens.get(group, {}).get(cand, 0.0)
         foreign = (self._server_tokens[cand] - own) / self._devices(cand)
         least = min(
-            self._server_tokens[a] / self._devices(a)
-            for a in self.server_addrs
+            self._server_tokens[a] / self._devices(a) for a in pool
         )
         if foreign > (
             self.config.affinity_imbalance_factor * least
@@ -418,6 +534,7 @@ class GserverManager(worker_base.Worker):
         self._group_server.pop(qid, None)
         self._group_prefix.pop(qid, None)
         self._group_tokens.pop(qid, None)
+        getattr(self, "_group_prefill", {}).pop(qid, None)
         # a rollout abandoned between reject and ok must not leak its
         # gate stamp (and must not pollute a later same-qid rollout)
         self._gate_first_reject.pop(qid, None)
@@ -665,12 +782,11 @@ class GserverManager(worker_base.Worker):
             try:
                 cmd, payload = pickle.loads(msg)
                 if cmd == "schedule_request":
-                    addr = self._schedule(
+                    resp = self._schedule_request(
                         payload["qid"],
                         payload.get("prompt_len", 0),
                         payload.get("new_token_budget", 0),
                     )
-                    resp = {"url": addr, "version": self._model_version}
                 elif cmd == "allocate_rollout":
                     resp = self._allocate_rollout(payload["qid"])
                 elif cmd == "finish_rollout":
@@ -692,6 +808,10 @@ class GserverManager(worker_base.Worker):
                         "server_mesh_devices": {
                             a: self._devices(a) for a in self.server_addrs
                         },
+                        "server_roles": dict(
+                            getattr(self, "_server_role", {})
+                        ),
+                        "pd_enabled": getattr(self, "_pd_enabled", False),
                     }
                 else:
                     resp = {"error": f"unknown command {cmd}"}

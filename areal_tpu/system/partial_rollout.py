@@ -118,17 +118,24 @@ class PartialRolloutManager:
                 continue
             try:
                 client = self._client(sched["url"])
+                metadata = {
+                    # SLO plane: client-observed routing latency, stamped
+                    # on THIS clock (no cross-host skew) — the engine
+                    # folds it into the request's LatencyRecord
+                    "slo_schedule_wait_s": time.monotonic() - t_sched
+                }
+                if sched.get("handoff_to"):
+                    # two-stage P/D routing: this chunk runs on a
+                    # prefill server which hands the KV to the named
+                    # decode server; the next chunk's schedule sticky-
+                    # routes there and resumes prefill-free
+                    metadata["handoff_to"] = sched["handoff_to"]
                 inp = model_api.APIGenerateInput(
                     qid=gen_qid,
                     prompt_ids=prompt_ids,
                     input_ids=cur,
                     gconfig=self.gconfig.new(max_new_tokens=chunk, n=1),
-                    # SLO plane: client-observed routing latency, stamped
-                    # on THIS clock (no cross-host skew) — the engine
-                    # folds it into the request's LatencyRecord
-                    metadata={
-                        "slo_schedule_wait_s": time.monotonic() - t_sched
-                    },
+                    metadata=metadata,
                 )
                 out = await asyncio.to_thread(client.generate, inp)
                 self._tracer.span_end(

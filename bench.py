@@ -1244,6 +1244,272 @@ def bench_slo_report(
     }
 
 
+def bench_pd_disagg_ab(
+    cfg,
+    params,
+    n_interactive=8,
+    interactive_prompt=48,
+    interactive_new=12,
+    turns=2,
+    n_wave=5,
+    wave_prompt=640,
+    wave_new=4,
+    page=64,
+    chunk=8,
+    prefill_chunk=128,
+):
+    """Disaggregated prefill/decode A/B under MIXED load (ROADMAP item 2).
+
+    Workload: ``n_interactive`` chat sessions decoding short turns (the
+    latency-sensitive stream) while a concurrent wave of ``n_wave``
+    long-prompt requests prefills (the throughput batch that, on a
+    unified fleet, steals a fill chunk out of every decode step).  Both
+    arms get the SAME two engines' worth of hardware:
+
+    * **unified** — two unified engines, sessions and wave spread across
+      both; every engine interleaves wave fill chunks with interactive
+      decode, so interactive TTFT absorbs the wave.
+    * **disagg** — one prefill engine + one decode engine: new requests
+      prefill on P (first token sampled there), the row's paged KV
+      blocks ride a handoff unit into D (export_handoff ->
+      import_handoff, the worker-RPC path's engine halves), and every
+      continuation decodes on D — which never runs a single wave fill.
+
+    Reported per (arm, workload): fleet-merged TTFT/TPOT p50/p99 from
+    per-request LatencyRecords folded into the SLO plane's
+    ``LatencyDigest`` (the same fixed-bucket digests the master merges),
+    plus handoff count/bytes/latency and greedy stream parity
+    unified-vs-disagg as DATA.  The acceptance bar — interactive p99
+    TTFT strictly better disaggregated — is asserted as a CPU smoke in
+    tests/system/test_pd_disagg.py and recorded here for the TPU run.
+    Setup turns (session establishment before the wave) are drained
+    from the digests so the numbers cover only the contended window.
+    """
+    import zlib
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine.sampling import SamplingParams
+    from areal_tpu.observability.latency import LatencyDigest
+
+    total_interactive = interactive_new * (1 + turns)
+
+    def mk(name):
+        eng = make_engine(
+            cfg, params, n_interactive + n_wave, wave_prompt,
+            total_interactive, chunk=chunk, cache_mode="paged",
+            page_size=page, prefill_chunk_tokens=prefill_chunk,
+            sampling=SamplingParams(greedy=True), server_name=name,
+        )
+        # sessions park through the whole wave phase; the default TTL
+        # (512 steps) could evict a quiet session mid-measurement
+        eng.park_ttl_steps = 1 << 20
+        return eng
+
+    def req(qid, ids, mn, workload, handoff=False):
+        md = {"workload": workload, "slo_schedule_wait_s": 0.0}
+        if handoff:
+            # the manager's two-stage routing sets this in production;
+            # the bench drives the engine halves directly
+            md["handoff_to"] = "peer"
+        return APIGenerateInput(
+            qid=qid, prompt_ids=ids, input_ids=ids,
+            gconfig=GenerationHyperparameters(
+                max_new_tokens=mn, greedy=True
+            ),
+            metadata=md,
+        )
+
+    iconvs = [
+        np.random.default_rng(zlib.crc32(f"pdi{s}".encode()))
+        .integers(0, cfg.vocab_size, (interactive_prompt,)).tolist()
+        for s in range(n_interactive)
+    ]
+    wconvs = [
+        np.random.default_rng(zlib.crc32(f"pdw{i}".encode()))
+        .integers(0, cfg.vocab_size, (wave_prompt,)).tolist()
+        for i in range(n_wave)
+    ]
+
+    def run_arm(disagg):
+        """Chunked-generation driver over two interleaved engines —
+        each request behaves like a partial_rollout client: submit a
+        chunk, collect, submit the continuation (disagg: first chunk on
+        P with the handoff flag, the driver moving the unit P->D when
+        the prefill result lands, exactly what the generation-server
+        worker does before its client reply)."""
+        if disagg:
+            P, D = mk("pd-P"), mk("pd-D")
+            engines = [P, D]
+        else:
+            engines = [mk("uni-0"), mk("uni-1")]
+        handoff_ms = []
+        handoff_fail = [0]
+
+        recs = {}
+
+        def start(qid, ids, total, per, workload, uni_idx):
+            recs[qid] = dict(
+                ids=list(ids), left=total, per=per, workload=workload,
+                uni=engines[uni_idx % len(engines)], first=True,
+                stream=[], waiting=False, cur=None, done=False,
+            )
+
+        def submit_next(r, qid):
+            mn = min(r["per"], r["left"])
+            if disagg:
+                eng = P if r["first"] else D
+                eng.submit(
+                    req(qid, r["ids"], mn, r["workload"],
+                        handoff=r["first"])
+                )
+            else:
+                eng = r["uni"]
+                eng.submit(req(qid, r["ids"], mn, r["workload"]))
+            r["cur"], r["waiting"] = eng, True
+
+        def pump(max_steps=200_000):
+            for _ in range(max_steps):
+                live = False
+                for eng in engines:
+                    if eng.has_work:
+                        eng.step()
+                        live = True
+                for qid, r in recs.items():
+                    if not r["waiting"]:
+                        continue
+                    out = r["cur"].try_get_result(qid)
+                    if out is None:
+                        continue
+                    r["waiting"] = False
+                    if disagg and r["first"] and out.output_ids:
+                        t0 = time.perf_counter()
+                        unit = P.export_handoff(qid)
+                        ok = False
+                        if unit is not None:
+                            ok, _ = D.import_handoff(unit)
+                        handoff_ms.append(
+                            (time.perf_counter() - t0) * 1e3
+                        )
+                        if not ok:
+                            handoff_fail[0] += 1
+                    r["first"] = False
+                    r["stream"].extend(out.output_ids)
+                    r["ids"].extend(out.output_ids)
+                    r["left"] -= len(out.output_ids)
+                    if (
+                        r["left"] <= 0
+                        or not out.output_ids
+                        or not out.no_eos
+                    ):
+                        r["done"] = True
+                    else:
+                        submit_next(r, qid)
+                        live = True
+                if not live and all(
+                    r["done"] or not r["waiting"] for r in recs.values()
+                ):
+                    if all(r["done"] for r in recs.values()):
+                        return
+                    # nothing in flight but requests remain: submit them
+                    for qid, r in recs.items():
+                        if not r["done"] and not r["waiting"]:
+                            submit_next(r, qid)
+            raise RuntimeError("pd_disagg driver did not converge")
+
+        # -- setup: establish every session's first turn, pre-wave
+        for s, conv in enumerate(iconvs):
+            start(f"pds{s}", conv, total_interactive, interactive_new,
+                  "interactive", s)
+        # sessions stop after turn 0 (budget throttled by `left` vs the
+        # measured turns below): cap left to one turn for the setup pump
+        for r in recs.values():
+            r["_left_total"] = r["left"]
+            r["left"] = interactive_new
+        for qid, r in recs.items():
+            submit_next(r, qid)
+        pump()
+        for eng in engines:
+            eng.drain_slo_records()  # setup latencies: not measured
+        # -- measured window: the wave prefills while sessions keep
+        # decoding turns
+        for r in recs.values():
+            r["left"] = r["_left_total"] - (
+                len(r["stream"])
+            )
+            r["done"] = r["left"] <= 0
+        for i, conv in enumerate(wconvs):
+            start(f"pdw{i}", conv, wave_new, wave_new, "wave",
+                  i)
+        for qid, r in recs.items():
+            if not r["done"] and not r["waiting"]:
+                submit_next(r, qid)
+        pump()
+        records = []
+        for eng in engines:
+            records.extend(eng.drain_slo_records())
+        digs: Dict[str, Dict[str, LatencyDigest]] = {}
+        for rec in records:
+            d = digs.setdefault(
+                rec.workload,
+                {"ttft_s": LatencyDigest(), "tpot_s": LatencyDigest()},
+            )
+            d["ttft_s"].observe(rec.ttft_s)
+            if rec.tpot_s is not None:
+                d["tpot_s"].observe(rec.tpot_s)
+        out = {}
+        for wl, d in sorted(digs.items()):
+            out[wl] = {
+                "records": d["ttft_s"].count,
+                "ttft_p50_ms": _q_ms(d["ttft_s"], 0.50),
+                "ttft_p99_ms": _q_ms(d["ttft_s"], 0.99),
+                "tpot_p50_ms": _q_ms(d["tpot_s"], 0.50),
+                "tpot_p99_ms": _q_ms(d["tpot_s"], 0.99),
+            }
+        if disagg:
+            hs = [P.handoff_stats(), D.handoff_stats()]
+            out["handoff"] = {
+                "count": hs[1]["imports_total"],
+                "exports": hs[0]["exports_total"],
+                "failed": handoff_fail[0],
+                "bytes_total": hs[0]["bytes_total"],
+                "mean_ms": round(float(np.mean(handoff_ms)), 2)
+                if handoff_ms else None,
+                "max_ms": round(float(np.max(handoff_ms)), 2)
+                if handoff_ms else None,
+                "import_rejects": hs[1]["import_rejects"],
+            }
+        streams = {qid: list(r["stream"]) for qid, r in recs.items()}
+        engines.clear()
+        return out, streams
+
+    def _q_ms(dig, q):
+        v = dig.quantile(q)
+        return round(v * 1e3, 3) if v is not None else None
+
+    out: Dict[str, object] = {}
+    streams = {}
+    for arm, disagg in (("unified", False), ("disagg", True)):
+        try:
+            out[arm], streams[arm] = run_arm(disagg)
+        except Exception as e:  # noqa: BLE001 - dropped sub-arm is data
+            import traceback
+
+            traceback.print_exc()
+            out[arm] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if all(isinstance(out.get(a), dict) and "error" not in out[a]
+           for a in ("unified", "disagg")):
+        out["parity_ok"] = streams["unified"] == streams["disagg"]
+        u = out["unified"].get("interactive", {}).get("ttft_p99_ms")
+        d = out["disagg"].get("interactive", {}).get("ttft_p99_ms")
+        out["interactive_ttft_p99_improved"] = (
+            u is not None and d is not None and d < u
+        )
+    return out
+
+
 def bench_spec_decode_ab(
     cfg,
     params,
@@ -2182,6 +2448,7 @@ SUMMARY_REQUIRED_KEYS = (
     "trace_overhead_ab",
     "spec_decode_ab",
     "slo_report",
+    "pd_disagg_ab",
     "sharded_serving",
     "weight_swap_ab",
     "train_packing_ab",
@@ -2200,6 +2467,7 @@ def build_summary(
     trace_overhead_ab=None,
     spec_decode_ab=None,
     slo_report=None,
+    pd_disagg_ab=None,
     sharded_serving=None,
     weight_swap_ab=None,
     train_packing_ab=None,
@@ -2238,6 +2506,7 @@ def build_summary(
         "trace_overhead_ab": trace_overhead_ab,
         "spec_decode_ab": spec_decode_ab,
         "slo_report": slo_report,
+        "pd_disagg_ab": pd_disagg_ab,
         "sharded_serving": sharded_serving,
         "weight_swap_ab": weight_swap_ab,
         "train_packing_ab": train_packing_ab,
@@ -3059,6 +3328,28 @@ def main():
         ),
     )
 
+    # disaggregated prefill/decode A/B: interactive decode stream + long-
+    # prompt prefill wave on unified vs 1P+1D split fleets (same hardware
+    # both arms) — fleet-merged p99 TTFT/TPOT per workload, handoff
+    # count/bytes/latency, greedy parity as data.  Runs off-TPU too —
+    # tiny shapes — so the summary always carries the p99-TTFT verdict.
+    mark("pd disagg A/B")
+    pd_disagg_ab = _section(
+        bench_pd_disagg_ab,
+        cfg,
+        gen_params,
+        name="pd_disagg_ab",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                n_interactive=3, interactive_prompt=32, interactive_new=8,
+                turns=2, n_wave=2, wave_prompt=192, wave_new=4,
+                page=32, chunk=4, prefill_chunk=64,
+            )
+        ),
+    )
+
     # self-speculative decoding A/B: n-gram draft + batched paged verify
     # on vs off, on a repetitive-trace workload (decode tok/s + accepted
     # tokens per verify step).  Runs off-TPU too — tiny shapes — so the
@@ -3305,6 +3596,7 @@ def main():
         trace_overhead_ab=trace_overhead_ab,
         spec_decode_ab=spec_decode_ab,
         slo_report=slo_report,
+        pd_disagg_ab=pd_disagg_ab,
         sharded_serving=sharded_serving,
         weight_swap_ab=weight_swap_ab,
         train_packing_ab=train_packing_ab,
@@ -3367,6 +3659,7 @@ def main():
                     "trace_overhead_ab": trace_overhead_ab,
                     "spec_decode_ab": spec_decode_ab,
                     "slo_report": slo_report,
+                    "pd_disagg_ab": pd_disagg_ab,
                     "sharded_serving": sharded_serving,
                 },
             }

@@ -159,6 +159,8 @@ def test_factored_matches_adam_shape_semantics():
     ],
     ids=["bf16_moments", "factored"],
 )
+@pytest.mark.slow  # ~15s/arm; dtype-parity smokes above + the checkpoint
+# round-trip smoke in test_checkpoint.py keep both subsystems covered
 def test_checkpoint_round_trip_preserves_moment_dtypes(tmp_path, opt_kw):
     """Sharded save/restore must reproduce the low-precision state exactly:
     same dtypes, same continued trajectory (ISSUE 1 acceptance)."""

@@ -228,3 +228,69 @@ def test_auto_mode_picks_paged_at_long_cache():
         cfg_sw, params_sw, max_batch=2, kv_cache_len=4096, cache_mode="auto"
     )
     assert not eng3.paged
+
+
+# -- shared host gather/restore helpers (hier-cache spill + P/D handoff) ------
+
+
+def _round_trip_pools(kv_cache_dtype):
+    """gather_blocks_host -> restore_blocks_from_host round trip must be
+    BIT-identical — the one property both consumers (prefix-cache host
+    spill tier and the disaggregation handoff unit) stand on.  int8
+    pools must carry their scale slices unrequantized."""
+    from areal_tpu.models import paged
+
+    cfg = tiny_config(vocab_size=64, max_position_embeddings=512)
+    rng = np.random.default_rng(7)
+    pools = paged.alloc_kv_pool(cfg, 8, 4, kv_cache_dtype=kv_cache_dtype)
+    k_pool, v_pool, k_scale, v_scale = pools
+    # fill with non-trivial content (int8: random bytes + random scales)
+    if kv_cache_dtype == "int8":
+        k_pool = jax.numpy.asarray(
+            rng.integers(-127, 128, k_pool.shape).astype(np.int8)
+        )
+        v_pool = jax.numpy.asarray(
+            rng.integers(-127, 128, v_pool.shape).astype(np.int8)
+        )
+        k_scale = jax.numpy.asarray(
+            rng.random(k_scale.shape).astype(np.float32)
+        )
+        v_scale = jax.numpy.asarray(
+            rng.random(v_scale.shape).astype(np.float32)
+        )
+    else:
+        k_pool = jax.numpy.asarray(
+            rng.standard_normal(k_pool.shape).astype(np.float32)
+        ).astype(k_pool.dtype)
+        v_pool = jax.numpy.asarray(
+            rng.standard_normal(v_pool.shape).astype(np.float32)
+        ).astype(v_pool.dtype)
+    src = [5, 1, 3]  # deliberately non-contiguous, non-pow2 count
+    payload = paged.gather_blocks_host(
+        k_pool, v_pool, src, k_scale=k_scale, v_scale=v_scale
+    )
+    want_components = 4 if kv_cache_dtype == "int8" else 2
+    assert len(payload) == want_components
+    # scatter into DIFFERENT destination blocks of a fresh pool
+    dst = [0, 6, 2]
+    fresh = paged.alloc_kv_pool(cfg, 8, 4, kv_cache_dtype=kv_cache_dtype)
+    payloads = [tuple(a[i] for a in payload) for i in range(len(src))]
+    out = paged.restore_blocks_from_host(
+        fresh[0], fresh[1], payloads, dst,
+        k_scale=fresh[2], v_scale=fresh[3],
+    )
+    back = paged.gather_blocks_host(
+        out[0], out[1], dst,
+        k_scale=out[2] if len(out) > 2 else None,
+        v_scale=out[3] if len(out) > 2 else None,
+    )
+    for a, b in zip(payload, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_host_block_round_trip_bit_identical_fp():
+    _round_trip_pools("auto")
+
+
+def test_host_block_round_trip_bit_identical_int8_with_scales():
+    _round_trip_pools("int8")

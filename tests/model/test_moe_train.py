@@ -63,6 +63,8 @@ def test_moe_aux_loss_in_objective():
     assert aux_per_tok >= cfg.moe_aux_loss_coef * 0.99
 
 
+@pytest.mark.slow  # ~25s; moe-train smoke stays via test_moe_aux_loss_in_
+# objective and the EP serving parity smoke in tests/engine/test_ep_serving
 def test_moe_expert_parallel_train_matches_replicated():
     """EP over the expert mesh axis computes the same losses as a
     non-expert-sharded mesh (XLA inserts the dispatch collectives).

@@ -31,6 +31,10 @@ def three_live_workers():
     gsm.counter("areal_gserver_alloc_rejections_total").inc(4, reason="staled")
     gsm.gauge("areal_gserver_running_rollouts").set(12)
     gsm.gauge("areal_gserver_version_lag").set(2)
+    # P/D disaggregation: per-role server gauges + two-stage route count
+    gsm.gauge("areal_gserver_pd_role_servers").set(1, role="prefill")
+    gsm.gauge("areal_gserver_pd_role_servers").set(2, role="decode")
+    gsm.counter("areal_gserver_pd_handoff_routes_total").inc(9)
 
     trainer = MetricsRegistry()
     trainer.histogram("areal_train_step_seconds").observe(1.5, model="actor")
@@ -54,6 +58,14 @@ def three_live_workers():
     gen.counter(
         "areal_inference_kv_quant_divergence_diverged_total"
     ).inc(1)
+    # P/D handoff: export/import volume + a reasoned fail-closed reject
+    gen.counter("areal_inference_handoff_exports_total").inc(3)
+    gen.counter("areal_inference_handoff_imports_total").inc(2)
+    gen.counter("areal_inference_handoff_bytes_total").inc(8192)
+    gen.counter("areal_inference_handoff_seconds_total").inc(0.125)
+    gen.counter(
+        "areal_inference_handoff_import_rejects_total"
+    ).inc(1, reason="version")
 
     servers = []
     for wname, reg in (
@@ -143,6 +155,48 @@ def test_discovers_and_scrapes_three_live_workers(
         flat[
             "cluster/gen_server_0/"
             "areal_inference_kv_quant_divergence_diverged_total"
+        ]
+        == 1.0
+    )
+    # the P/D disaggregation families survive the scrape cycle: role
+    # gauges + route counter on the manager, handoff volume + reasoned
+    # rejects on the gen server
+    assert (
+        flat[
+            "cluster/gserver_manager/"
+            "areal_gserver_pd_role_servers{role=prefill}"
+        ]
+        == 1.0
+    )
+    assert (
+        flat[
+            "cluster/gserver_manager/"
+            "areal_gserver_pd_role_servers{role=decode}"
+        ]
+        == 2.0
+    )
+    assert (
+        flat[
+            "cluster/gserver_manager/areal_gserver_pd_handoff_routes_total"
+        ]
+        == 9.0
+    )
+    assert (
+        flat["cluster/gen_server_0/areal_inference_handoff_exports_total"]
+        == 3.0
+    )
+    assert (
+        flat["cluster/gen_server_0/areal_inference_handoff_imports_total"]
+        == 2.0
+    )
+    assert (
+        flat["cluster/gen_server_0/areal_inference_handoff_bytes_total"]
+        == 8192.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_handoff_import_rejects_total{reason=version}"
         ]
         == 1.0
     )

@@ -74,11 +74,21 @@ def test_registration_value_round_trip():
     )
 
     v = format_server_registration("10.0.0.1:5555", MeshSpec(model=2, expert=2))
-    addr, devices, spec = parse_server_registration(v)
+    addr, devices, spec, role = parse_server_registration(v)
     assert addr == "10.0.0.1:5555"
     assert devices == 4
     assert MeshSpec.from_str(spec) == MeshSpec(model=2, expert=2)
-    assert parse_server_registration("10.0.0.2:80") == ("10.0.0.2:80", 1, "")
+    assert role == "unified"  # role-less registrations parse unified
+    assert parse_server_registration("10.0.0.2:80") == (
+        "10.0.0.2:80", 1, "", "unified"
+    )
+    # role round trip (the P/D registration knob)
+    vp = format_server_registration(
+        "10.0.0.3:90", MeshSpec(model=2), role="prefill"
+    )
+    assert parse_server_registration(vp) == (
+        "10.0.0.3:90", 2, str(MeshSpec(model=2)), "prefill"
+    )
 
 
 def test_least_requests_weighs_mesh_devices():

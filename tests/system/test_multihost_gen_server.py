@@ -106,7 +106,7 @@ def test_multihost_tp_generation(cluster):
 
     from areal_tpu.system.generation_server import parse_server_registration
 
-    addr, _devices, _spec = parse_server_registration(reg)
+    addr, _devices, _spec, _role = parse_server_registration(reg)
     client = GenServerClient(addr, timeout=180.0)
     out = client.generate(
         APIGenerateInput(

@@ -1,0 +1,489 @@
+"""Disaggregated prefill/decode serving gates (ISSUE 13, ROADMAP item 2).
+
+What this file pins, on CPU:
+
+* **Routing**: role-aware two-stage scheduling at the gserver manager —
+  a new request in a P/D fleet routes to a prefill server with
+  ``handoff_to`` naming the decode owner; continuations sticky-route to
+  the decode server; sticky/token/affinity state never lands on a
+  prefill server; unified fleets are byte-for-byte unaffected.
+* **Handoff mechanics**: the engine's export/import halves are greedy
+  TOKEN-IDENTICAL to the unified engine on the same workload, the
+  decode side resumes with ZERO prefill, and the payload round-trips
+  bit-identically (int8 pools: quantized bytes + scales, no requant).
+* **Fail-closed**: a handoff racing a weight swap — the swap landing
+  either before the import (version-skew reject) or after it (parked-
+  row eviction) — NEVER decodes stale KV; the continuation re-prefills
+  and the stream stays correct.
+* **Worker RPC path**: a real 1P+1D fleet (GenerationServerWorker x2 +
+  GserverManager + PartialRolloutManager client) serves a chunked
+  generation end to end through schedule -> prefill -> import_handoff
+  RPC -> resume, token-identical to a direct unified engine.
+* **The acceptance bar, as a CPU smoke**: bench_pd_disagg_ab's mixed
+  load (interactive decode stream + long-prompt prefill wave) shows
+  interactive p99 TTFT strictly better disaggregated than unified at
+  equal hardware, with greedy parity across arms.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tests.engine.test_prefix_cache import (
+    _req,
+    make_engine,
+    run_until_done,
+)
+from tests.system.test_gserver_manager_unit import _manager
+
+PROMPT = list(np.arange(24) % 40 + 6)
+
+
+# -- two-stage routing at the manager -----------------------------------------
+
+
+def _pd_manager(**kw):
+    """Hand-built role-aware manager: s0 = prefill, s1/s2 = decode."""
+    m = _manager(**kw)
+    m._server_role = {"s0": "prefill", "s1": "decode", "s2": "decode"}
+    m._prefill_addrs = ["s0"]
+    m._decode_addrs = ["s1", "s2"]
+    m._pd_enabled = True
+    m._group_prefill = {}
+    m._pd_rr = 0
+    return m
+
+
+def test_two_stage_routing_new_request_and_sticky_continuation():
+    m = _pd_manager(policy="least_token_usage")
+    r = m._schedule_request("q1-0", prompt_len=100, new_token_budget=50)
+    assert r["url"] == "s0"  # new request: prefill stage first
+    owner = r["handoff_to"]
+    assert owner in ("s1", "s2")
+    # the decode server OWNS the request: sticky + token accounting
+    assert m._qid_server["q1-0"] == owner
+    assert m._server_tokens["s0"] == 0.0
+    assert m._server_tokens[owner] > 0.0
+    # continuation: straight to the decode owner, no second handoff
+    r2 = m._schedule_request("q1-0", prompt_len=120, new_token_budget=30)
+    assert r2["url"] == owner and "handoff_to" not in r2
+
+
+def test_group_members_share_prefill_server_and_decode_owner():
+    """One rollout's members colocate at BOTH stages: the prefill server
+    dedups the shared prompt fill, the decode owner shares the radix
+    prefix."""
+    m = _pd_manager(policy="round_robin")
+    resps = [
+        m._schedule_request(f"g1-{i}", prompt_len=64, new_token_budget=16)
+        for i in range(4)
+    ]
+    assert {r["url"] for r in resps} == {"s0"}
+    assert len({r["handoff_to"] for r in resps}) == 1
+    m._finish_rollout("g1", accepted=True)
+    assert "g1" not in m._group_prefill
+
+
+def test_decode_pool_excludes_prefill_servers():
+    """Sticky owners are always decode servers — across many rollouts,
+    no request's resident state ever lands on the prefill server."""
+    m = _pd_manager(policy="least_token_usage")
+    for i in range(12):
+        m._schedule_request(f"r{i}-0", prompt_len=32, new_token_budget=8)
+    assert set(m._qid_server.values()) <= {"s1", "s2"}
+    assert m._server_load["s0"] == 0
+
+
+def test_unified_servers_excluded_from_pd_decode_pool():
+    """A unified registration carries no single-process guarantee (it
+    could be a multi-controller SPMD server that cannot import a
+    handoff unit), so in a P/D fleet the decode-owner pool is decode-
+    role servers ONLY — a unified bystander never becomes a handoff
+    target."""
+    m = _pd_manager(policy="least_token_usage")
+    m._server_role["s2"] = "unified"
+    m._decode_addrs = ["s1"]  # what _configure derives for this fleet
+    for i in range(8):
+        r = m._schedule_request(f"x{i}-0", prompt_len=32, new_token_budget=8)
+        assert r["handoff_to"] == "s1", r
+    assert m._server_load["s2"] == 0
+
+
+def test_unified_fleet_unchanged_no_handoff_key():
+    m = _manager(policy="least_requests")  # no roles registered
+    r = m._schedule_request("u0-0", prompt_len=32, new_token_budget=8)
+    assert "handoff_to" not in r
+    assert r["url"] in m.server_addrs
+
+
+def test_pd_routes_counter_increments_once_per_new_request():
+    m = _pd_manager(policy="round_robin")
+    base = m._m_pd_routes.value()
+    m._schedule_request("c0-0", prompt_len=16, new_token_budget=4)
+    m._schedule_request("c0-0", prompt_len=20, new_token_budget=4)  # sticky
+    assert m._m_pd_routes.value() == base + 1
+
+
+# -- engine-level handoff: parity, zero-prefill resume, bit identity ----------
+
+
+def _drive_disagg(P, D, prompt, max_new, qid="pd0", swap_before_import=None,
+                  swap_after_import=None):
+    """Run prefill-with-handoff on P, move the unit to D (exactly what
+    the generation-server worker does before its client reply), then
+    decode the continuation on D.  Optional weight swaps are injected at
+    the named race points.  Returns (tokens, import_ok, reason)."""
+    P.submit(_req(qid, prompt, max_new))
+    # stamp the handoff flag the manager's schedule response carries
+    with P._lock:
+        P._pending[-1].metadata = {"handoff_to": "D"}
+    run_until_done(P)
+    first = P.wait_result(qid, timeout=10)
+    assert len(first.output_ids) == 1 and first.no_eos
+    unit = P.export_handoff(qid)
+    assert unit is not None
+    if swap_before_import is not None:
+        D.update_weights(*swap_before_import)
+        D.step()
+    ok, reason = D.import_handoff(unit)
+    if swap_after_import is not None:
+        D.update_weights(*swap_after_import)
+        D.step()
+    cont = list(prompt) + list(first.output_ids)
+    D.submit(_req(qid, cont, max_new - 1))
+    run_until_done(D)
+    rest = D.wait_result(qid, timeout=10)
+    return list(first.output_ids) + list(rest.output_ids), ok, reason
+
+
+def test_disagg_greedy_token_identical_to_unified():
+    uni, _, params = make_engine()
+    uni.submit(_req("pd0", PROMPT, 10))
+    run_until_done(uni)
+    ref = list(uni.wait_result("pd0", timeout=10).output_ids)
+
+    P, *_ = make_engine(params=params)
+    D, *_ = make_engine(params=params)
+    got, ok, _ = _drive_disagg(P, D, PROMPT, 10)
+    assert ok
+    assert got == ref
+    # the whole point: ZERO suffix prefill on the decode side
+    assert D.resumed_total == 1
+    assert D.prefill_tokens_total == 0
+    assert D.handoff_stats()["imports_total"] == 1
+    assert P.handoff_stats()["exports_total"] == 1
+
+
+def test_handoff_racing_weight_swap_fails_closed_before_import():
+    """Swap lands on D between export and import: the unit's version no
+    longer matches — the import is REJECTED (stale KV never decoded) and
+    the continuation re-prefills, still token-correct."""
+    uni, _, params = make_engine()
+    uni.submit(_req("pd1", PROMPT, 10))
+    run_until_done(uni)
+    ref = list(uni.wait_result("pd1", timeout=10).output_ids)
+
+    P, *_ = make_engine(params=params)
+    D, *_ = make_engine(params=params)
+    got, ok, reason = _drive_disagg(
+        P, D, PROMPT, 10, qid="pd1",
+        swap_before_import=(params, 1),  # same tree, bumped version
+    )
+    assert not ok and reason == "version"
+    assert D.handoff_stats()["import_rejects"] == {"version": 1}
+    assert D.resumed_total == 0  # re-prefilled, never resumed stale KV
+    assert D.prefill_tokens_total > 0
+    assert got == ref  # same weights -> same stream, via the safe path
+
+
+def test_handoff_racing_weight_swap_fails_closed_after_import():
+    """Swap lands on D after the import but before the resume: the
+    imported parked row is evicted with every other parked row — the
+    continuation re-prefills under the new weights."""
+    uni, _, params = make_engine()
+    uni.submit(_req("pd2", PROMPT, 10))
+    run_until_done(uni)
+    ref = list(uni.wait_result("pd2", timeout=10).output_ids)
+
+    P, *_ = make_engine(params=params)
+    D, *_ = make_engine(params=params)
+    got, ok, _ = _drive_disagg(
+        P, D, PROMPT, 10, qid="pd2",
+        swap_after_import=(params, 1),
+    )
+    assert ok  # the import itself succeeded...
+    assert D.resumed_total == 0  # ...but the swap evicted the parked row
+    assert D.prefill_tokens_total > 0
+    assert got == ref
+
+
+def test_import_rejects_dense_and_layout_mismatch():
+    _, _, params = make_engine()
+    P, *_ = make_engine(params=params)
+    got_unit = {}
+
+    P.submit(_req("pd3", PROMPT, 8))
+    with P._lock:
+        P._pending[-1].metadata = {"handoff_to": "D"}
+    run_until_done(P)
+    P.wait_result("pd3", timeout=10)
+    got_unit = P.export_handoff("pd3")
+    assert got_unit is not None
+
+    dense, *_ = make_engine(params=params, cache_mode="dense")
+    ok, reason = dense.import_handoff(dict(got_unit))
+    assert not ok and reason == "dense"
+
+    other_page, *_ = make_engine(params=params, page_size=16)
+    ok, reason = other_page.import_handoff(dict(got_unit))
+    assert not ok and reason == "layout"
+
+    # a geometry-skewed payload (wrong per-block shape — e.g. a peer
+    # built from a different model config) rejects BEFORE any blocks
+    # are allocated, so nothing can leak off the free list
+    bad = dict(got_unit)
+    bad["payload"] = tuple(a[:, :1] for a in got_unit["payload"])
+    victim, *_ = make_engine(params=params)
+    free0 = victim.free_pool_blocks
+    ok, reason = victim.import_handoff(bad)
+    assert not ok and reason == "layout"
+    assert victim.free_pool_blocks == free0  # no leak
+
+
+def test_handoff_payload_bit_identical_through_import():
+    """The imported blocks' device bytes equal the exported payload
+    exactly (the shared gather/restore helpers' bit-identity, asserted
+    through the engine path)."""
+    from areal_tpu.models import paged
+
+    _, _, params = make_engine()
+    P, *_ = make_engine(params=params)
+    D, *_ = make_engine(params=params)
+    P.submit(_req("pd4", PROMPT, 8))
+    with P._lock:
+        P._pending[-1].metadata = {"handoff_to": "D"}
+    run_until_done(P)
+    P.wait_result("pd4", timeout=10)
+    unit = P.export_handoff("pd4")
+    ok, _ = D.import_handoff(unit)
+    assert ok
+    rid = next(
+        i for i, r in enumerate(D.rows)
+        if r is not None and r.req.qid == "pd4"
+    )
+    back = paged.gather_blocks_host(
+        D.k_pool, D.v_pool, D._row_blocks[rid],
+        k_scale=D.k_scale, v_scale=D.v_scale,
+    )
+    for a, b in zip(unit["payload"], back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow  # int8 arm: quant parity arms are slow-marked by policy
+def test_disagg_parity_int8_kv_cache():
+    """Disaggregation composes with the quantized KV cache: int8+scale
+    payloads hand off bit-identically, and the disaggregated stream
+    matches the int8 unified engine's exactly."""
+    uni, _, params = make_engine(kv_cache_dtype="int8")
+    uni.submit(_req("pdq", PROMPT, 10))
+    run_until_done(uni)
+    ref = list(uni.wait_result("pdq", timeout=10).output_ids)
+
+    P, *_ = make_engine(params=params, kv_cache_dtype="int8")
+    D, *_ = make_engine(params=params, kv_cache_dtype="int8")
+    got, ok, _ = _drive_disagg(P, D, PROMPT, 10, qid="pdq")
+    assert ok and got == ref
+    assert D.resumed_total == 1 and D.prefill_tokens_total == 0
+
+
+# -- worker RPC path: a real 1P+1D fleet --------------------------------------
+
+
+def test_pd_fleet_e2e_over_worker_rpc(monkeypatch, tmp_path):
+    """Full-stack proof over the REAL wire: two GenerationServerWorkers
+    registered prefill/decode, the GserverManager's two-stage schedule
+    RPC, the partial-rollout client copying ``handoff_to`` into request
+    metadata, the prefill worker pushing the unit through the
+    ``import_handoff`` RPC before its client reply, and the continuation
+    resuming on the decode server — token-identical to a direct unified
+    engine with the same weights."""
+    import asyncio
+
+    from areal_tpu.api.config import ModelAbstraction
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.api.system_api import (
+        GenServerConfig,
+        GserverManagerConfig,
+    )
+    from areal_tpu.base import constants, name_resolve, names
+    from areal_tpu.engine.backend import make_model
+    from areal_tpu.engine.inference_server import ContinuousBatchingEngine
+    from areal_tpu.engine.sampling import SamplingParams
+    from areal_tpu.system.generation_server import (
+        GenerationServerWorker,
+        GenServerClient,
+    )
+    from areal_tpu.system.gserver_manager import (
+        GserverManager,
+        GserverManagerClient,
+    )
+    from areal_tpu.system.partial_rollout import PartialRolloutManager
+
+    monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
+    monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
+    name_resolve.reconfigure("memory")
+    constants.set_experiment_trial_names("pdtest", "t0")
+    expr, tr = "pdtest", "t0"
+
+    model_abs = ModelAbstraction(
+        "random", {"vocab_size": 64, "max_position_embeddings": 256}
+    )
+    common = dict(
+        model=model_abs,
+        max_concurrent_batch=2,
+        kv_cache_len=128,
+        chunk_size=4,
+        greedy=True,
+        cache_mode="paged",
+        page_size=16,
+        prefill_chunk_tokens=32,
+    )
+    workers = []
+    for name, role in (("gen_server_0", "prefill"), ("gen_server_1", "decode")):
+        w = GenerationServerWorker()
+        threading.Thread(
+            target=w.run,
+            args=(GenServerConfig(worker_name=name, role=role, **common),),
+            daemon=True,
+        ).start()
+        workers.append(w)
+        name_resolve.wait(names.gen_server(expr, tr, name), timeout=30)
+
+    manager = GserverManager()
+    threading.Thread(
+        target=manager.run,
+        args=(
+            GserverManagerConfig(worker_name="gserver_manager", n_servers=2),
+        ),
+        daemon=True,
+    ).start()
+    name_resolve.wait(names.gen_server_manager(expr, tr), timeout=30)
+
+    prompt = list(np.arange(40) % 60 + 2)
+    mgr_client = GserverManagerClient(expr, tr, timeout=30.0)
+    prm = PartialRolloutManager(
+        mgr_client,
+        GenerationHyperparameters(max_new_tokens=12, greedy=True),
+        new_tokens_per_chunk=6,
+        request_timeout=60.0,
+    )
+    try:
+        out = asyncio.run(prm._gen_one("pdr0-0", prompt))
+        assert len(out.output_ids) == 12, out.output_ids
+
+        # unified reference: a direct engine on the identical weights
+        probe = make_model(model_abs, None, None)
+        ref_eng = ContinuousBatchingEngine(
+            probe.model_cfg,
+            probe.init_params,
+            max_batch=2,
+            kv_cache_len=128,
+            chunk_size=4,
+            sampling=SamplingParams(greedy=True),
+            cache_mode="paged",
+            page_size=16,
+            prefill_chunk_tokens=32,
+        )
+        ref_eng.submit(_req("ref0", prompt, 12))
+        run_until_done(ref_eng)
+        ref = ref_eng.wait_result("ref0", timeout=10)
+        assert list(out.output_ids) == list(ref.output_ids)
+
+        # the handoff ACTUALLY happened (not a silent unified fallback):
+        # prefill server exported once, decode server imported once and
+        # served every continuation
+        reg = name_resolve.get(names.gen_server(expr, tr, "gen_server_0"))
+        from areal_tpu.system.generation_server import (
+            parse_server_registration,
+        )
+
+        p_addr, _, _, p_role = parse_server_registration(reg)
+        assert p_role == "prefill"
+        p_metrics = GenServerClient(p_addr, timeout=10.0).call(
+            "metrics", {}
+        )
+        assert p_metrics["role"] == "prefill"
+        assert p_metrics["handoff_exports_total"] == 1, p_metrics
+        reg_d = name_resolve.get(names.gen_server(expr, tr, "gen_server_1"))
+        d_addr = parse_server_registration(reg_d)[0]
+        d_metrics = GenServerClient(d_addr, timeout=10.0).call(
+            "metrics", {}
+        )
+        assert d_metrics["role"] == "decode"
+        assert d_metrics["handoff_imports_total"] == 1, d_metrics
+        assert d_metrics["handoff_import_rejects"] == {}
+        status = mgr_client.call("get_status", {})
+        assert status["pd_enabled"] is True
+        assert status["server_roles"][p_addr] == "prefill"
+    finally:
+        prm.close()
+        mgr_client.close()
+        manager.exit()
+        for w in workers:
+            w.exit()
+
+
+# -- the acceptance bar, as a CPU smoke ---------------------------------------
+
+
+def test_bench_pd_disagg_cpu_smoke():
+    """bench_pd_disagg_ab at smoke shapes: interactive p99 TTFT under
+    the mixed load must be STRICTLY better disaggregated than unified at
+    equal hardware, with greedy stream parity across arms and every
+    handoff landing (the PR's acceptance criterion; the TPU run records
+    the same section as data).
+
+    The p99 verdict is a wall-clock measurement over few records (p99
+    of ~6 samples is the max), so a scheduler stall on a loaded CI box
+    could flip it with no code defect; the measured gap is ~4x, and one
+    retry makes a spurious flip require two independent stalls.  The
+    CORRECTNESS claims (parity, handoff completeness) are asserted on
+    the first run, never retried."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+    )
+    import jax
+
+    import bench
+    from areal_tpu.models import transformer
+    from areal_tpu.models.config import tiny_config
+
+    cfg = tiny_config(vocab_size=64, max_position_embeddings=1024)
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+    def run():
+        return bench.bench_pd_disagg_ab(
+            cfg, params,
+            n_interactive=3, interactive_prompt=32, interactive_new=8,
+            turns=2, n_wave=2, wave_prompt=192, wave_new=4,
+            page=32, chunk=4, prefill_chunk=64,
+        )
+
+    out = run()
+    assert "error" not in out.get("unified", {}), out
+    assert "error" not in out.get("disagg", {}), out
+    assert out["parity_ok"] is True, out
+    h = out["disagg"]["handoff"]
+    assert h["count"] == h["exports"] and h["failed"] == 0, h
+    assert h["bytes_total"] > 0
+    if out["interactive_ttft_p99_improved"] is not True:
+        retry = run()
+        assert retry["parity_ok"] is True, retry
+        assert retry["interactive_ttft_p99_improved"] is True, (out, retry)

@@ -2,6 +2,7 @@
 (mirrors the reference's CPU e2e test tests/experiments/test_math_ppo.py)."""
 
 import numpy as np
+import pytest
 
 from tests.fixtures import (  # noqa: F401
     dataset,
@@ -59,6 +60,7 @@ def test_sync_ppo_grpo_style(dataset_path, tokenizer_path, tmp_path, monkeypatch
     assert np.isfinite(s["actor_train/loss"])
 
 
+@pytest.mark.slow  # ~17s; sync-ppo smoke stays via full_graph + grpo_style
 def test_sync_ppo_with_trained_reward_model(
     dataset_path, tokenizer_path, tmp_path, monkeypatch
 ):

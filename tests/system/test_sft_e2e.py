@@ -3,6 +3,7 @@
 run_test_exp, tests/experiments/utils.py:52)."""
 
 import numpy as np
+import pytest
 
 from tests.fixtures import (  # noqa: F401
     dataset,
@@ -13,6 +14,8 @@ from tests.fixtures import (  # noqa: F401
 )
 
 
+@pytest.mark.slow  # ~23s; SFT loss/interface smokes stay via
+# test_train_engine / test_packed_training (the DPO-e2e precedent)
 def test_sft_experiment_e2e(dataset_path, tokenizer_path, tmp_path, monkeypatch):
     monkeypatch.setenv("AREAL_LOG_ROOT", str(tmp_path / "logs"))
     monkeypatch.setenv("AREAL_SAVE_ROOT", str(tmp_path / "save"))
