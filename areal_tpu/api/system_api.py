@@ -74,6 +74,14 @@ class ModelWorkerConfig:
     dataset_shard: Tuple[int, int] = (0, 1)
     use_stream_dataset: bool = False  # async mode: data arrives by push
     stream_group_size: int = 1  # trajectories per prompt (epoch accounting)
+    # publish an int8 serving tree (matmul weights quantized to int8 +
+    # per-output-channel f32 scales, sibling v{N}-int8 snapshot dir)
+    # next to every full-precision weight publish and advertise it in
+    # the manifest.  Servers that set serving_weight_dtype="int8"
+    # negotiate onto it (half the staged-swap bytes, half the serving
+    # weight HBM); everyone else ignores it.  Costs ~50% extra publish
+    # IO — turn off for trainers whose fleet never serves quantized.
+    publish_quantized_int8: bool = True
     seed: int = 1
     # flight-recorder knobs (None = ambient process defaults)
     trace: Optional[TraceConfig] = None
@@ -189,6 +197,23 @@ class GenServerConfig:
     # kv_quant_ab section reports the greedy divergence rate per
     # workload and the fleet exports areal_inference_kv_quant_* series.
     kv_cache_dtype: str = "auto"
+    # serving WEIGHT storage dtype (the SGLang --quantization / vLLM
+    # quantized-weight-loading knob): "auto" serves the model-dtype
+    # param tree (bit-for-bit today's behavior — quantized snapshots a
+    # publisher advertises are simply ignored); "int8" holds matmul
+    # weights as int8 + per-output-channel f32 absmax scales
+    # (models/quantize.py) — ~half the weight HBM (freed for paged
+    # blocks / prefix cache) and ~half the bytes a staged weight swap
+    # restores.  The format is NEGOTIATED through the publish manifest:
+    # a publisher that wrote the v{N}-int8 sibling tree serves it to
+    # int8 servers; one that didn't triggers a logged fall-back to the
+    # full-precision tree (restored full, quantized on arrival), never
+    # a crash.  Dequantization happens at use inside each projection,
+    # so matmul math stays model dtype and the error is storage-only —
+    # measured, not assumed: bench.py weight_quant_ab reports the
+    # greedy divergence rate per workload and the fleet exports the
+    # areal_inference_weight_quant_* series.
+    serving_weight_dtype: str = "auto"
     prefill_chunk_tokens: int = 1024
     # cross-request radix prefix cache over the paged pool (default on
     # for paged mode; engine/prefix_cache.py): finished/parked sequences'

@@ -32,7 +32,28 @@ logger = logging_.getLogger("checkpoint")
 #: so the keep-last-2 GC removes it with the arrays
 MANIFEST_NAME = "areal_manifest.json"
 
+#: suffix of the SIBLING snapshot dir holding a version's quantized
+#: serving tree (``v7`` -> ``v7-int8``).  A sibling — not a subdir — so
+#: the base snapshot stays byte-identical for consumers that predate the
+#: quantized format; the manifest's ``serving_quant`` entry advertises
+#: it (negotiation), and the publisher's keep-last-2 GC reaps the pair
+#: together.
+QUANT_DIR_SUFFIX = "-int8"
+
+
+def quant_snapshot_path(path: str) -> str:
+    """The sibling dir a snapshot's int8 serving tree publishes to."""
+    return os.path.abspath(path) + QUANT_DIR_SUFFIX
+
 _checkpointer = None
+
+#: separate checkpointer for OPTIONAL quantized-serving-tree publishes:
+#: the shared checkpointer's wait_until_finished re-raises ANY pending
+#: save's failure, so an int8 sibling write sharing it could block the
+#: MANDATORY full-precision publish from being advertised (review
+#: finding) — the quant tree fails independently and publishers just
+#: drop the advertisement
+_quant_checkpointer = None
 
 
 def _get_checkpointer():
@@ -42,6 +63,15 @@ def _get_checkpointer():
 
         _checkpointer = ocp.StandardCheckpointer()
     return _checkpointer
+
+
+def _get_quant_checkpointer():
+    global _quant_checkpointer
+    if _quant_checkpointer is None:
+        import orbax.checkpoint as ocp
+
+        _quant_checkpointer = ocp.StandardCheckpointer()
+    return _quant_checkpointer
 
 
 def _state_tree(engine):
@@ -120,10 +150,63 @@ def save_params(params, path: str, cast_dtype=None, wait: bool = True):
         ck.wait_until_finished()
 
 
+def save_quantized_params(params, path: str, cast_dtype=None,
+                          wait: bool = True):
+    """Additionally publish a snapshot's INT8 SERVING TREE (matmul
+    weights as int8 + per-output-channel f32 absmax scales, everything
+    else at ``cast_dtype`` — models/quantize.py) as its own orbax
+    checkpoint at ``path`` (conventionally :func:`quant_snapshot_path`
+    of the full-precision snapshot).  Consumers that negotiated the
+    format via the manifest restore THIS tree instead of the
+    full-precision one: the staged restore reads ~half the bytes and the
+    serving engine holds ~half the weight HBM.
+
+    Quantization runs eagerly before returning, so the produced arrays
+    are independent of ``params`` (which the next train step may
+    donate); like :func:`save_params`, ``wait=False`` returns once the
+    buffers are snapshotted.  Returns the quantized tree's abstract
+    (ShapeDtypeStruct) form — the manifest's ``serving_quant`` leaves
+    metadata."""
+    from areal_tpu.models import quantize
+
+    path = os.path.abspath(path)
+    if cast_dtype is not None:
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(cast_dtype)
+        params = jax.tree.map(lambda x: x.astype(dt), params)
+    qtree = quantize.quantize_param_tree(params)
+    if not quantize.quantized_leaf_count(qtree):
+        # nothing quantizable (e.g. a bias-only test tree): publishing
+        # a byte-identical copy would advertise a format that saves
+        # nothing — callers skip the advertisement on None
+        return None
+    jax.block_until_ready(qtree)
+    # the DEDICATED quant checkpointer: this save is optional, and a
+    # background failure here must never poison wait_for_saves() for
+    # the mandatory full-precision snapshot sharing a checkpointer
+    ck = _get_quant_checkpointer()
+    ck.save(path, qtree, force=True)
+    if wait:
+        ck.wait_until_finished()
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), qtree
+    )
+
+
 def wait_for_saves():
     """Block until every pending async checkpoint save has committed."""
     if _checkpointer is not None:
         _checkpointer.wait_until_finished()
+
+
+def wait_for_quant_saves():
+    """Block until pending QUANTIZED-tree saves have committed, raising
+    their failure — kept separate from :func:`wait_for_saves` so the
+    optional int8 publish can fail without taking the mandatory
+    full-precision advertisement down with it."""
+    if _quant_checkpointer is not None:
+        _quant_checkpointer.wait_until_finished()
 
 
 def load_params_like(template, path: str):
@@ -132,15 +215,21 @@ def load_params_like(template, path: str):
     the consumer's mesh need not match the publisher's)."""
     path = os.path.abspath(path)
     ck = _get_checkpointer()
-
-    def _abstract(x):
-        if isinstance(x, jax.Array):
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-        x = np.asarray(x)
-        return jax.ShapeDtypeStruct(x.shape, x.dtype)
-
-    target = jax.tree.map(_abstract, template)
+    target = jax.tree.map(_abstract_leaf, template)
     return ck.restore(path, target)
+
+
+def _abstract_leaf(x):
+    """ShapeDtypeStruct for a restore-template leaf.  Templates may mix
+    live arrays (restore onto their shardings), ShapeDtypeStructs
+    (abstract templates — e.g. an engine's quantized-tree template when
+    the engine itself holds the other format), and plain scalars."""
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    x = np.asarray(x)
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
 
 # -- staged (chunked, sharding-direct) restore -------------------------------
@@ -217,18 +306,12 @@ def load_params_staged(
         chunks[-1].append((keypath, leaf))
         used += nbytes
 
-    def _abstract(x):
-        if isinstance(x, jax.Array):
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-        x = np.asarray(x)
-        return jax.ShapeDtypeStruct(x.shape, x.dtype)
-
     restorer = ocp.PyTreeCheckpointer()
     out: Dict = {}
     for chunk in chunks:
         item: Dict = {}
         for keypath, leaf in chunk:
-            _insert_path(item, keypath, _abstract(leaf))
+            _insert_path(item, keypath, _abstract_leaf(leaf))
         restored = restorer.restore(
             path,
             item=item,
@@ -246,21 +329,40 @@ def load_params_staged(
     return out
 
 
-def write_manifest(params, path: str, version: Optional[int] = None):
-    """Publish a layout/dtype manifest INSIDE a snapshot dir: per-leaf
-    key path, shape, and dtype (plus the version).  Consumers validate
-    their staging template against it BEFORE opening tensorstore arrays,
-    so a layout/arch mismatch fails as one readable error instead of an
-    orbax stack trace mid-restore — and readers can cheaply probe that a
-    snapshot survived keep-last-2 GC."""
-    leaves = {
+def _leaves_meta(params) -> Dict[str, Dict]:
+    """Per-leaf ``{"shape", "dtype"}`` metadata keyed by "/"-joined key
+    path — the manifest's layout vocabulary."""
+    return {
         "/".join(kp): {
             "shape": list(getattr(leaf, "shape", ())),
             "dtype": str(np.dtype(getattr(leaf, "dtype", np.float32))),
         }
         for kp, leaf in _flatten_dict(params)
     }
-    manifest = {"version": version, "leaves": leaves}
+
+
+def write_manifest(
+    params,
+    path: str,
+    version: Optional[int] = None,
+    serving_quant: Optional[Dict] = None,
+):
+    """Publish a layout/dtype manifest INSIDE a snapshot dir: per-leaf
+    key path, shape, and dtype (plus the version).  Consumers validate
+    their staging template against it BEFORE opening tensorstore arrays,
+    so a layout/arch mismatch fails as one readable error instead of an
+    orbax stack trace mid-restore — and readers can cheaply probe that a
+    snapshot survived keep-last-2 GC.
+
+    ``serving_quant`` advertises alternative quantized serving trees the
+    publisher ALSO wrote (the format negotiation): a dict like
+    ``{"int8": {"dir": "v7-int8", "leaves": {...}}}`` where ``dir`` is
+    the sibling snapshot dir name and ``leaves`` its layout (built with
+    :func:`quant_manifest_entry`).  Absent for publishers that didn't
+    write one — consumers fall back to the full-precision tree."""
+    manifest = {"version": version, "leaves": _leaves_meta(params)}
+    if serving_quant:
+        manifest["serving_quant"] = serving_quant
     # per-process tmp name: on multi-host publishes every host writes the
     # same snapshot dir, and a SHARED tmp path would let one writer
     # truncate another's in-progress file and os.replace torn bytes into
@@ -271,6 +373,18 @@ def write_manifest(params, path: str, version: Optional[int] = None):
         json.dump(manifest, f)
     os.replace(tmp, os.path.join(path, MANIFEST_NAME))
     return manifest
+
+
+def quant_manifest_entry(quant_avals, path: str) -> Dict:
+    """The manifest ``serving_quant`` advertisement for one quantized
+    tree: the sibling dir's NAME (resolved against the base snapshot's
+    parent at restore time — realloc dirs may be mounted at different
+    roots on consumers) plus its full leaf layout, so the consumer's
+    arch check runs BEFORE the pause window ever opens."""
+    return {
+        "dir": os.path.basename(os.path.abspath(path)),
+        "leaves": _leaves_meta(quant_avals),
+    }
 
 
 def read_manifest(path: str) -> Optional[Dict]:
@@ -285,24 +399,46 @@ def read_manifest(path: str) -> Optional[Dict]:
 
 def validate_manifest(template, manifest: Dict) -> List[str]:
     """Mismatches between ``template`` and a snapshot manifest, as
-    readable strings (empty = compatible).  Dtype differences are NOT
-    mismatches — orbax casts on restore (publishers write inference
-    dtype; consumers may hold fp32)."""
+    readable strings (empty = compatible).  Float-width dtype
+    differences are NOT mismatches — orbax casts on restore (publishers
+    write inference dtype; consumers may hold fp32).  A FLOAT/INTEGER
+    dtype-class mismatch IS one: casting a float snapshot into an int8
+    storage leaf (or vice versa) would silently produce garbage weights,
+    so a server that negotiated the quantized format onto a
+    full-precision tree — or the reverse — fails readably here, before
+    the pause window."""
     problems: List[str] = []
     mine = {
-        "/".join(kp): list(getattr(leaf, "shape", ()))
+        "/".join(kp): (
+            list(getattr(leaf, "shape", ())),
+            str(np.dtype(getattr(leaf, "dtype", np.float32))),
+        )
         for kp, leaf in _flatten_dict(template)
     }
-    theirs = {k: v["shape"] for k, v in manifest.get("leaves", {}).items()}
+    leaves = manifest.get("leaves", {})
+    theirs = {
+        k: (v["shape"], v.get("dtype", "float32"))
+        for k, v in leaves.items()
+    }
     for k in sorted(set(mine) - set(theirs)):
         problems.append(f"missing from snapshot: {k}")
     for k in sorted(set(theirs) - set(mine)):
         problems.append(f"unexpected in snapshot: {k}")
     for k in sorted(set(mine) & set(theirs)):
-        if mine[k] != theirs[k]:
+        if mine[k][0] != theirs[k][0]:
             problems.append(
-                f"shape mismatch at {k}: engine {mine[k]} vs "
-                f"snapshot {theirs[k]}"
+                f"shape mismatch at {k}: engine {mine[k][0]} vs "
+                f"snapshot {theirs[k][0]}"
+            )
+            continue
+        kind_mine = np.dtype(mine[k][1]).kind
+        kind_theirs = np.dtype(theirs[k][1]).kind
+        int_kinds = ("i", "u")
+        if (kind_mine in int_kinds) != (kind_theirs in int_kinds):
+            problems.append(
+                f"dtype-class mismatch at {k}: engine {mine[k][1]} vs "
+                f"snapshot {theirs[k][1]} (int storage never casts "
+                "to/from float weights)"
             )
     return problems
 

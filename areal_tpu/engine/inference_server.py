@@ -75,7 +75,7 @@ from areal_tpu.engine.dispatch import (
 )
 from areal_tpu.engine.prefix_cache import PrefixMatch, RadixPrefixCache
 from areal_tpu.engine.sampling import SamplingParams, sample_logits_keyed
-from areal_tpu.models import paged
+from areal_tpu.models import paged, quantize
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import KVCache, decode_step, prefill
 from areal_tpu.observability.latency import LatencyDigest, LatencyRecord
@@ -395,6 +395,7 @@ class ContinuousBatchingEngine:
         page_size: int = 1024,
         kv_pool_tokens: Optional[int] = None,
         kv_cache_dtype: str = "auto",
+        serving_weight_dtype: str = "auto",
         prefill_chunk_tokens: int = 1024,
         pipeline_depth: int = 2,
         dispatch_table: Optional[PagedDispatchTable] = None,
@@ -473,6 +474,22 @@ class ContinuousBatchingEngine:
         kv_quant_ab section measures the token-quality delta; dense
         mode ignores the knob with a warning.
 
+        ``serving_weight_dtype`` ("auto" | "int8"): "auto" serves the
+        param tree exactly as passed (bit-for-bit today's behavior);
+        "int8" quantizes every matmul weight to int8 + per-output-
+        channel f32 absmax scales at construction (models/quantize.py)
+        and dequantizes AT USE inside each projection — ~half the
+        weight HBM (freed for paged blocks / prefix cache) and ~half
+        the bytes a staged weight swap restores, at the cost of
+        storage-rounding error (matmul math stays at activation dtype;
+        the bench's weight_quant_ab section measures the token-quality
+        delta).  Works on every path — dense, paged, TP/EP meshes —
+        because the forward reads weights through one format-agnostic
+        accessor.  Incoming swap trees must arrive in the engine's
+        resident format; the generation server's manifest negotiation
+        guarantees that (quantizing on arrival when the publisher only
+        wrote full precision).
+
         ``prefix_cache_host_bytes`` > 0 adds the HOST SPILL TIER below
         the HBM cache (the SGLang hierarchical/HiCache direction):
         evicted full-block entries copy their KV to host buffers (one
@@ -513,6 +530,31 @@ class ContinuousBatchingEngine:
             kv_cache_dtype = "auto"
         self.kv_cache_dtype = kv_cache_dtype
         self._kv_quant = kv_cache_dtype == "int8"
+        assert serving_weight_dtype in ("auto", "int8"), serving_weight_dtype
+        self.serving_weight_dtype = serving_weight_dtype
+        self._weight_quant = serving_weight_dtype == "int8"
+        # quantized-serving-weight quality counters (the
+        # areal_inference_weight_quant_* divergence series): external
+        # parity harnesses (bench weight_quant_ab, tests) fold their
+        # measured greedy-divergence checks in here
+        self.weight_quant_divergence_checks_total = 0
+        self.weight_quant_divergence_diverged_total = 0
+        # abstract full-precision tree template (int8 engines only):
+        # the restore target when a publisher did NOT write the
+        # quantized format and the negotiation falls back to the
+        # full-precision snapshot (the server quantizes on arrival, so
+        # the engine's resident format never changes)
+        self._full_weight_template = None
+        if self._weight_quant:
+            self._full_weight_template = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    jnp.shape(x), jnp.result_type(x)
+                ),
+                params,
+            )
+            # the engine holds int8 + scales from step 0: ~half the
+            # weight HBM, and every staged swap restores ~half the bytes
+            params = quantize.quantize_param_tree(params)
         # scale pools exist only for int8 paged storage; None everywhere
         # else so every pool call site can pass them unconditionally
         self.k_scale: Optional[jax.Array] = None
@@ -569,6 +611,22 @@ class ContinuousBatchingEngine:
                 lambda ps: NamedSharding(mesh, ps), pspecs
             )
             params = jax.device_put(params, self._param_shardings)
+            if self._full_weight_template is not None:
+                # the fallback restore target places full-precision
+                # leaves at the SAME mesh's full-tree shardings (then
+                # quantizes on arrival) — never a one-chip transient
+                fspecs = (
+                    serving_param_pspecs(cfg, self._full_weight_template)
+                    if (cfg.is_moe and ep > 1)
+                    else param_pspecs(cfg, self._full_weight_template)
+                )
+                self._full_weight_template = jax.tree.map(
+                    lambda t, ps: jax.ShapeDtypeStruct(
+                        t.shape, t.dtype, sharding=NamedSharding(mesh, ps)
+                    ),
+                    self._full_weight_template,
+                    fspecs,
+                )
             tp = mesh.shape.get("model", 1)
             kv_axis = "model" if cfg.n_kv_heads % max(tp, 1) == 0 else None
             self._kv_axis = kv_axis
@@ -950,6 +1008,70 @@ class ContinuousBatchingEngine:
                 self.kv_quant_divergence_diverged_total
             ),
         }
+
+    def note_weight_divergence_check(self, checked: int, diverged: int):
+        """Fold a measured greedy-divergence check (bench weight_quant_ab
+        / parity tests compare an int8-weight arm against a
+        full-precision arm token by token) into the engine's cumulative
+        quality counters — the ``areal_inference_weight_quant_*``
+        divergence series."""
+        self.weight_quant_divergence_checks_total += int(checked)
+        self.weight_quant_divergence_diverged_total += int(diverged)
+
+    def weight_quant_stats(self) -> Dict[str, int]:
+        """Quantized-serving-weight counters (worker scrape + metrics
+        RPC + bench): resident format, storage bits, quantized-leaf
+        count, the param tree's HBM byte footprint, and the measured
+        divergence-check counters."""
+        quantized = quantize.is_quantized_tree(self.params)
+        if quantized:
+            bits = quantize.STORAGE_BITS
+        else:
+            probe = self.params["layers"]["attn"]["q"]
+            w = probe["w"] if isinstance(probe, dict) else probe
+            bits = int(jnp.dtype(w.dtype).itemsize) * 8
+        return {
+            "quantized": int(quantized),
+            "storage_bits": bits,
+            "quantized_leaves": quantize.quantized_leaf_count(self.params),
+            "param_bytes": quantize.tree_bytes(self.params),
+            "divergence_checks_total": (
+                self.weight_quant_divergence_checks_total
+            ),
+            "divergence_diverged_total": (
+                self.weight_quant_divergence_diverged_total
+            ),
+        }
+
+    def weight_restore_template(self, fmt: str):
+        """The restore/placement template for an incoming published
+        tree in ``fmt`` ("full" | "int8").  The engine's resident params
+        ARE the template when the formats agree (live arrays carry the
+        serving shardings); an int8 engine negotiating a FULL-precision
+        snapshot (publisher wrote no quantized tree) gets the abstract
+        full template captured at construction — the server restores
+        onto it, then quantizes on arrival so the engine's resident
+        format never changes."""
+        resident = (
+            "int8" if quantize.is_quantized_tree(self.params) else "full"
+        )
+        if fmt == resident:
+            return self.params
+        if fmt == "full" and self._full_weight_template is not None:
+            return self._full_weight_template
+        if fmt == "int8":
+            # an auto engine never negotiates int8; cover it anyway so a
+            # direct caller gets a usable (unsharded) template
+            return quantize.quant_tree_struct(self.params)
+        raise ValueError(f"unknown weight format {fmt!r}")
+
+    def prepare_weights(self, params):
+        """Convert an incoming tree to the engine's RESIDENT format
+        (quantize on arrival for an int8 engine handed a full-precision
+        tree — the negotiation fallback; pass-through otherwise)."""
+        if self._weight_quant and not quantize.is_quantized_tree(params):
+            return quantize.quantize_param_tree(params)
+        return params
 
     def _alloc_blocks(self, n: int) -> Optional[List[int]]:
         if len(self._free_blocks) < n:

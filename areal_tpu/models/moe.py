@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from areal_tpu.base import jax_compat
+from areal_tpu.models import quantize
 from areal_tpu.models.config import TransformerConfig
 
 
@@ -183,9 +184,16 @@ def moe_mlp(
         jax.nn.logsumexp(router_logits, axis=-1) ** 2 * vmask
     ) / n_valid
 
-    gate_w = p["experts"]["gate"].astype(h.dtype)
-    up_w = p["experts"]["up"].astype(h.dtype)
-    down_w = p["experts"]["down"].astype(h.dtype)
+    # leaf_weight serves both formats: plain arrays and the int8 serving
+    # format's {"qw", "scale"} leaves.  Dequant happens at use, OUTSIDE
+    # the EP shard_map: the qw/scale leaves are sharded over the same
+    # ``expert`` axis (transformer.serving_param_pspecs), so the
+    # partitioner dequantizes each shard's resident [E/ep, ...] slice
+    # locally and the shard_map's in_specs see the layout they expect —
+    # no gather, and per-chip residency stays E/ep at int8 bytes.
+    gate_w = quantize.leaf_weight(p["experts"]["gate"], h.dtype)
+    up_w = quantize.leaf_weight(p["experts"]["up"], h.dtype)
+    down_w = quantize.leaf_weight(p["experts"]["down"], h.dtype)
 
     xd = x.astype(h.dtype)
     if ep_axis_size(mesh) > 1:

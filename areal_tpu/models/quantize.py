@@ -1,0 +1,212 @@
+"""int8 serving-weight quantization (per-output-channel absmax).
+
+The serving fleet's second-largest HBM consumer after the paged KV pool
+is the full-precision param tree.  This module defines the storage
+format that halves it (and halves the bytes a staged weight swap must
+restore): every MATMUL weight — attention q/k/v/o, dense MLP
+gate/up/down, MoE expert stacks, the untied lm_head — is stored as an
+``int8`` tensor plus one float32 symmetric absmax scale per OUTPUT
+channel (the weight's last axis; for stacked/expert weights the scale
+keeps every leading axis, so a ``[L, D, F]`` weight carries a ``[L, F]``
+scale).  Norm scales/biases, embeddings, the router, and the critic
+value head stay at model dtype — they are tiny, and their error
+sensitivity is disproportionate.
+
+In the param tree a quantized leaf replaces its weight array with a
+``{"qw": int8, "scale": f32}`` dict (biases ride alongside unchanged),
+so one tree walks through ``lax.scan`` layer stacking, orbax
+checkpointing, and the staged-restore chunker exactly like the
+full-precision tree.  Consumers dequantize AT USE — ``w = qw * scale``
+fused in front of each projection (transformer._proj, moe.moe_mlp) — so
+the matmul math runs at the activation dtype like the full-precision
+path and the only error is storage rounding.  This is the role SGLang's
+``--quantization`` / vLLM's int8 weight loading play for AReaL's
+serving side.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: storage bits per quantized weight element (the metrics gauge)
+STORAGE_BITS = 8
+
+#: projection names whose "w" (or expert stack) leaves quantize
+_PROJ_NAMES = ("q", "k", "v", "o", "gate", "up", "down")
+
+
+def quantizable(keys: Tuple[str, ...]) -> bool:
+    """True iff the leaf at key path ``keys`` is a matmul weight the int8
+    serving format quantizes.  Everything else (norms, biases,
+    embeddings, the MoE router, the critic value head) stays model
+    dtype."""
+    if (
+        len(keys) >= 3
+        and keys[-1] == "w"
+        and keys[-2] in _PROJ_NAMES
+        and ("attn" in keys or "mlp" in keys)
+    ):
+        return True
+    if keys == ("lm_head", "w"):
+        return True
+    # MoE expert stacks are bare [L, E, D, F] leaves named gate/up/down
+    if len(keys) >= 2 and keys[-2] == "experts" and keys[-1] in _PROJ_NAMES:
+        return True
+    return False
+
+
+def quantize_weight(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """``(qw int8, scale f32)`` with one symmetric absmax scale per
+    output channel: scale shape is ``w.shape`` minus the input axis
+    (``-2``).  All-zero channels get a tiny scale so the divide is
+    finite and dequantizes back to exact zeros."""
+    w32 = jnp.asarray(w).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-2)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(
+        jnp.round(w32 / scale[..., None, :]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequant_weight(qw: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """``qw * scale`` at use.  The multiply runs in f32 (the scale's
+    dtype) and casts to the activation dtype AFTER, so the storage
+    rounding is the only error a reduced-precision activation path adds
+    on top of its own."""
+    return (
+        qw.astype(jnp.float32) * scale[..., None, :].astype(jnp.float32)
+    ).astype(dtype)
+
+
+def is_quant_leaf(p) -> bool:
+    """True for a ``{"qw", "scale"}`` quantized-projection dict."""
+    return isinstance(p, dict) and "qw" in p
+
+
+def leaf_weight(p, dtype) -> jax.Array:
+    """The compute-dtype weight of a projection leaf that is either a
+    plain array, a ``{"w": ...}`` dict, or a quantized ``{"qw",
+    "scale"}`` dict — ONE accessor so every forward path serves both
+    formats."""
+    if isinstance(p, dict):
+        if "qw" in p:
+            return dequant_weight(p["qw"], p["scale"], dtype)
+        p = p["w"]
+    return p.astype(dtype)
+
+
+def _transform(tree, quant_fn, other_fn):
+    """Structure-preserving walk that rewrites quantizable weights: a
+    ``{"w": ...}`` projection's weight entry is REPLACED in its parent
+    dict by whatever ``quant_fn`` returns (so ``qw``/``scale`` sit next
+    to an existing bias), while bare expert-stack leaves are replaced in
+    place (``{"gate": arr}`` -> ``{"gate": {"qw", "scale"}}``)."""
+
+    def walk(d, prefix):
+        out = {}
+        for k, v in d.items():
+            kp = prefix + (str(k),)
+            if isinstance(v, dict):
+                out[k] = walk(v, kp)
+            elif quantizable(kp):
+                rep = quant_fn(kp, v)
+                if k == "w":
+                    out.update(rep)
+                else:
+                    out[k] = rep
+            else:
+                out[k] = other_fn(kp, v)
+        return out
+
+    return walk(tree, ())
+
+
+def quantize_param_tree(params: Dict[str, Any]) -> Dict[str, Any]:
+    """The int8 serving tree of a full-precision param tree: quantizable
+    weights become ``{"qw", "scale"}`` pairs (``{"w"}`` projections lose
+    the ``w`` entry, biases/norms ride along unchanged), everything else
+    is the original leaf (same object — no copy).  Idempotent on an
+    already-quantized tree (its ``qw``/``scale`` leaves are not
+    quantizable paths)."""
+
+    def quant(keys, leaf):
+        qw, scale = quantize_weight(leaf)
+        return {"qw": qw, "scale": scale}
+
+    return _transform(params, quant, lambda keys, leaf: leaf)
+
+
+def quant_tree_struct(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Abstract (ShapeDtypeStruct) int8-serving-tree template derived
+    from a params tree of arrays OR structs (full-precision or already
+    quantized) — no compute, no transfer.  The staged-restore path uses
+    this as its placement template when the engine negotiated the
+    quantized snapshot format."""
+
+    def quant(keys, leaf):
+        shape = tuple(leaf.shape)
+        return {
+            "qw": jax.ShapeDtypeStruct(shape, jnp.int8),
+            "scale": jax.ShapeDtypeStruct(
+                shape[:-2] + shape[-1:], jnp.float32
+            ),
+        }
+
+    def other(keys, leaf):
+        return jax.ShapeDtypeStruct(tuple(leaf.shape), jnp.dtype(leaf.dtype))
+
+    return _transform(params, quant, other)
+
+
+def is_quantized_tree(params) -> bool:
+    """True iff ``params`` holds at least one int8 ``{"qw", "scale"}``
+    leaf (i.e. it is a serving tree in the quantized format)."""
+    found = False
+
+    def walk(tree):
+        nonlocal found
+        if found or not isinstance(tree, dict):
+            return
+        if "qw" in tree:
+            found = True
+            return
+        for v in tree.values():
+            walk(v)
+
+    walk(params)
+    return found
+
+
+def quantized_leaf_count(params) -> int:
+    """Number of ``{"qw", "scale"}`` projection leaves in the tree (the
+    metrics gauge; 0 for a full-precision tree)."""
+    n = 0
+
+    def walk(tree):
+        nonlocal n
+        if not isinstance(tree, dict):
+            return
+        if "qw" in tree:
+            n += 1
+            return
+        for v in tree.values():
+            walk(v)
+
+    walk(params)
+    return n
+
+
+def tree_bytes(params) -> int:
+    """Total leaf bytes of a param tree (HBM footprint of the serving
+    weights; int8 trees come out at roughly half the model-dtype tree)."""
+    return sum(
+        int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        if hasattr(leaf, "shape")
+        else 0
+        for leaf in jax.tree_util.tree_leaves(params)
+    )

@@ -40,6 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 from areal_tpu.base import logging_
 from areal_tpu.engine.sampling import call_sample_fn
+from areal_tpu.models import quantize
 from areal_tpu.models.config import TransformerConfig
 
 logger = logging_.getLogger("transformer")
@@ -158,6 +159,10 @@ def param_pspecs(
         if keys[0] == "pos_embed":
             return P(None, "fsdp")
         if keys[0] == "lm_head":
+            # quantized serving tree: the [V] per-output-channel scale
+            # shards like the weight's output (vocab) axis
+            if keys[-1] == "scale":
+                return P("model")
             return P("fsdp", "model")
         if keys[0] == "value_head":
             return P("fsdp", None)
@@ -169,18 +174,33 @@ def param_pspecs(
                 return P(lp, None, None)
             # [L, E, D, F]: expert dim shards over the ``expert`` mesh axis
             # (expert parallelism; SURVEY §2.9 EP row — beyond the
-            # reference's local-only MoE), matmul dims over fsdp/model
-            if keys[-1] == "down":
+            # reference's local-only MoE), matmul dims over fsdp/model.
+            # Quantized trees nest {"qw", "scale"} one level deeper; the
+            # [L, E, out] scale keeps the expert shard plus the weight's
+            # output-axis shard.
+            name = keys[-1] if keys[-1] in ("gate", "up", "down") else keys[-2]
+            if keys[-1] == "scale":
+                return (
+                    P(lp, "expert", "fsdp")
+                    if name == "down"
+                    else P(lp, "expert", "model")
+                )
+            if name == "down":
                 return P(lp, "expert", "model", "fsdp")
             return P(lp, "expert", "fsdp", "model")
         if "attn" in keys or "mlp" in keys:
             name = keys[-2]  # q/k/v/o/gate/up/down/q_norm/...
-            leafname = keys[-1]  # w or b or scale
-            if leafname == "scale":  # q_norm/k_norm
+            leafname = keys[-1]  # w / qw / b / scale
+            if leafname == "scale" and name in ("q_norm", "k_norm"):
                 return P(lp, None)
             is_row = name in ("o", "down")
             if leafname == "b":
                 return P(lp, None) if is_row else P(lp, "model")
+            if leafname == "scale":
+                # int8 per-output-channel scale [L, out]: shard like the
+                # weight's output axis (fsdp for row-parallel o/down,
+                # model for column-parallel)
+                return P(lp, "fsdp") if is_row else P(lp, "model")
             return (
                 P(lp, "model", "fsdp") if is_row else P(lp, "fsdp", "model")
             )
@@ -208,6 +228,10 @@ def serving_param_pspecs(cfg: TransformerConfig, params: Params) -> Params:
     def fix(path, spec):
         keys = tuple(k.key if hasattr(k, "key") else str(k) for k in path)
         if "experts" in keys:
+            # quantized trees: the [L, E, out] scale is one rank shorter
+            # than its [L, E, in, out] weight but shards the same E axis
+            if keys[-1] == "scale":
+                return P(None, "expert", None)
             return P(None, "expert", None, None)
         return spec
 
@@ -491,7 +515,11 @@ jax.tree_util.register_dataclass(
 
 
 def _proj(p, y):
-    out = y @ p["w"].astype(y.dtype)
+    # leaf_weight serves both formats: plain {"w"} arrays and the int8
+    # serving format's {"qw", "scale"} leaves (dequantized at use, so
+    # the matmul below is identical math at the activation dtype and
+    # storage rounding is the only delta — models/quantize.py)
+    out = y @ quantize.leaf_weight(p, y.dtype)
     if "b" in p:
         out = out + p["b"].astype(y.dtype)
     return out
@@ -757,7 +785,7 @@ def _head(params, cfg: TransformerConfig, x):
     if cfg.tied_embedding:
         w = params["embed"]["weight"].astype(x.dtype).T
     else:
-        w = params["lm_head"]["w"].astype(x.dtype)
+        w = quantize.leaf_weight(params["lm_head"], x.dtype)
     return (x @ w).astype(jnp.dtype(cfg.logits_dtype))
 
 
@@ -1156,6 +1184,8 @@ def head_weight(params: Params, cfg: TransformerConfig) -> jax.Array:
     """[D, V] output head weight (tied or untied)."""
     if cfg.tied_embedding:
         return params["embed"]["weight"].T
+    if quantize.is_quant_leaf(params["lm_head"]):
+        return quantize.leaf_weight(params["lm_head"], jnp.float32)
     return params["lm_head"]["w"]
 
 
@@ -1181,7 +1211,7 @@ def logprobs_of_labels(
     if cfg.tied_embedding:
         w = params["embed"]["weight"].astype(x.dtype).T
     else:
-        w = params["lm_head"]["w"].astype(x.dtype)
+        w = quantize.leaf_weight(params["lm_head"], x.dtype)
 
     labels = tokens[:, 1:]
     hs = x[:, :-1]  # hidden predicting next token

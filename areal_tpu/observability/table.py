@@ -207,6 +207,34 @@ METRIC_TABLE = [
         "serving rollout is gated on)",
     ),
     MetricSpec(
+        "areal_inference_weight_quant_storage_bits",
+        "gauge",
+        "Bits per stored element of the serving param tree's matmul "
+        "weights (8 = int8 + per-output-channel scales, "
+        "serving_weight_dtype='int8'; 16/32 = model-dtype storage)",
+    ),
+    MetricSpec(
+        "areal_inference_weight_quant_leaves",
+        "gauge",
+        "Projection leaves of the RESIDENT serving tree held in "
+        "quantized {int8 weight, f32 scale} form — 0 on a "
+        "full-precision engine",
+    ),
+    MetricSpec(
+        "areal_inference_weight_quant_divergence_checks_total",
+        "counter",
+        "Greedy-divergence checks folded into the engine by quality "
+        "harnesses (bench weight_quant_ab / parity tests comparing the "
+        "int8-weight arm against a full-precision arm token by token)",
+    ),
+    MetricSpec(
+        "areal_inference_weight_quant_divergence_diverged_total",
+        "counter",
+        "Checked requests whose int8-weight greedy stream diverged "
+        "from the full-precision arm's (the measured token-quality "
+        "delta the quantized-weight serving rollout is gated on)",
+    ),
+    MetricSpec(
         "areal_inference_handoff_exports_total",
         "counter",
         "Paged-block KV handoff units exported by a prefill-role server "

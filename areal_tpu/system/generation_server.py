@@ -169,6 +169,9 @@ class GenerationServerWorker(worker_base.Worker):
             page_size=config.page_size,
             kv_pool_tokens=config.kv_pool_tokens,
             kv_cache_dtype=getattr(config, "kv_cache_dtype", "auto"),
+            serving_weight_dtype=getattr(
+                config, "serving_weight_dtype", "auto"
+            ),
             prefill_chunk_tokens=config.prefill_chunk_tokens,
             pipeline_depth=config.pipeline_depth,
             dispatch_table=resolve_dispatch_table(
@@ -337,6 +340,12 @@ class GenerationServerWorker(worker_base.Worker):
             "kv_quant_diverged": reg.counter(
                 "areal_inference_kv_quant_divergence_diverged_total"
             ),
+            "weight_quant_checks": reg.counter(
+                "areal_inference_weight_quant_divergence_checks_total"
+            ),
+            "weight_quant_diverged": reg.counter(
+                "areal_inference_weight_quant_divergence_diverged_total"
+            ),
             "handoff_exports": reg.counter(
                 "areal_inference_handoff_exports_total"
             ),
@@ -375,6 +384,12 @@ class GenerationServerWorker(worker_base.Worker):
                 "areal_inference_kv_quant_storage_bits"
             ),
             "kv_quant_blocks": reg.gauge("areal_inference_kv_quant_blocks"),
+            "weight_quant_bits": reg.gauge(
+                "areal_inference_weight_quant_storage_bits"
+            ),
+            "weight_quant_leaves": reg.gauge(
+                "areal_inference_weight_quant_leaves"
+            ),
             "mesh_devices": reg.gauge("areal_inference_mesh_devices"),
         }
         # handoff import rejects carry a reason label (version skew vs
@@ -414,6 +429,7 @@ class GenerationServerWorker(worker_base.Worker):
         pstats = eng.prefix_cache_stats()
         sstats = eng.spec_stats()
         qstats = eng.kv_quant_stats()
+        wstats = eng.weight_quant_stats()
         hstats = eng.handoff_stats()
         totals = {
             "chunks": float(eng.chunks_total),
@@ -441,6 +457,12 @@ class GenerationServerWorker(worker_base.Worker):
             "kv_quant_checks": float(qstats["divergence_checks_total"]),
             "kv_quant_diverged": float(
                 qstats["divergence_diverged_total"]
+            ),
+            "weight_quant_checks": float(
+                wstats["divergence_checks_total"]
+            ),
+            "weight_quant_diverged": float(
+                wstats["divergence_diverged_total"]
             ),
             "handoff_exports": float(hstats["exports_total"]),
             "handoff_imports": float(hstats["imports_total"]),
@@ -482,6 +504,8 @@ class GenerationServerWorker(worker_base.Worker):
         self._obs["prefix_host_blocks"].set(pstats["host_blocks_held"])
         self._obs["kv_quant_bits"].set(qstats["storage_bits"])
         self._obs["kv_quant_blocks"].set(qstats["quantized_blocks_held"])
+        self._obs["weight_quant_bits"].set(wstats["storage_bits"])
+        self._obs["weight_quant_leaves"].set(wstats["quantized_leaves"])
         self._obs["mesh_devices"].set(eng.mesh_devices)
 
     # -- API ---------------------------------------------------------------
@@ -701,40 +725,107 @@ class GenerationServerWorker(worker_base.Worker):
 
     # -- staged weight sync (stage -> commit) --------------------------------
 
+    def _negotiate_weight_format(
+        self, path: str, manifest: Optional[Dict]
+    ) -> Tuple[str, str, Optional[Dict]]:
+        """Pick the snapshot tree this server restores: ``(format,
+        restore_path, quant_leaves)`` with format "int8" | "full".
+
+        A server configured ``serving_weight_dtype="int8"`` prefers the
+        quantized sibling tree the publisher ADVERTISED in the manifest
+        (half the staged bytes); a publisher that wrote none — or an
+        old manifest-less snapshot — falls back to the full-precision
+        tree with one readable log line (the server quantizes on
+        arrival, so serving stays int8 either way).  An "auto" server
+        ignores quantized advertisements entirely: today's behavior,
+        bit for bit.  No publisher/server combination crashes on
+        format grounds."""
+        import os as _os
+
+        want = getattr(self.config, "serving_weight_dtype", "auto")
+        if want != "int8":
+            return "full", path, None
+        qinfo = ((manifest or {}).get("serving_quant") or {}).get("int8")
+        if not (isinstance(qinfo, dict) and qinfo.get("dir")):
+            self.logger.info(
+                "serving_weight_dtype='int8' but snapshot %s advertises "
+                "no quantized serving tree%s — restoring the "
+                "full-precision tree and quantizing on arrival",
+                path,
+                "" if manifest is not None else " (no manifest)",
+            )
+            return "full", path, None
+        qpath = _os.path.join(
+            _os.path.dirname(_os.path.abspath(path)), qinfo["dir"]
+        )
+        if not _os.path.isdir(qpath):
+            self.logger.info(
+                "advertised quantized serving tree %s is gone (GC "
+                "race?) — restoring the full-precision tree and "
+                "quantizing on arrival",
+                qpath,
+            )
+            return "full", path, None
+        return "int8", qpath, qinfo.get("leaves")
+
     def _load_update_params(self, payload: Dict, staged: bool):
         """Restore the snapshot named by an update payload.  The staged
         path restores layer-chunked straight onto the engine's serving
         shardings (each chip reads only its own shard ranges; transient
         restore buffers bounded by ``stage_chunk_bytes``) and pre-checks
         the publisher's layout manifest so an arch mismatch fails as one
-        readable error instead of an orbax stack trace."""
+        readable error instead of an orbax stack trace.
+
+        The tree FORMAT is negotiated through the manifest first
+        (:meth:`_negotiate_weight_format`): int8 servers restore the
+        publisher's quantized sibling tree when advertised — ~half the
+        bytes per stage — and fall back to full precision (quantized on
+        arrival) otherwise.  Either way the returned tree is in the
+        engine's resident format, so the pointer-flip commit and
+        version checks downstream are untouched."""
         path = payload.get("path")
         if payload.get("format") == "params":
             from areal_tpu.engine import checkpoint
 
+            manifest = checkpoint.read_manifest(path)
+            fmt, restore_path, quant_leaves = self._negotiate_weight_format(
+                path, manifest
+            )
+            template = self.engine.weight_restore_template(fmt)
             if staged:
-                manifest = checkpoint.read_manifest(path)
-                if manifest is not None:
+                # arch pre-check BEFORE any tensorstore open (and before
+                # the fleet's pause window): the negotiated tree's own
+                # leaves entry for int8, the manifest's for full
+                check_leaves = (
+                    quant_leaves
+                    if fmt == "int8"
+                    else (manifest or {}).get("leaves")
+                )
+                if check_leaves:
                     problems = checkpoint.validate_manifest(
-                        self.engine.params, manifest
+                        template, {"leaves": check_leaves}
                     )
                     if problems:
                         raise RuntimeError(
                             "published snapshot does not match this "
                             f"engine's layout: {problems[:3]}"
                         )
-                return checkpoint.load_params_staged(
-                    self.engine.params,
-                    path,
+                restored = checkpoint.load_params_staged(
+                    template,
+                    restore_path,
                     chunk_bytes=getattr(
                         self.config, "stage_chunk_bytes", None
                     ),
                 )
-            return checkpoint.load_params_like(self.engine.params, path)
+            else:
+                restored = checkpoint.load_params_like(
+                    template, restore_path
+                )
+            return self.engine.prepare_weights(restored)
         from areal_tpu.models.hf.registry import load_hf_model
 
         _, params = load_hf_model(path)
-        return params
+        return self.engine.prepare_weights(params)
 
     def _begin_stage(self, payload: Dict):
         """Start restoring ``payload``'s snapshot into a device-resident
@@ -890,6 +981,12 @@ class GenerationServerWorker(worker_base.Worker):
             **{
                 f"kv_quant_{k}": v
                 for k, v in self.engine.kv_quant_stats().items()
+            },
+            # quantized serving weights: resident format, storage bits,
+            # leaf count, param-tree HBM bytes, divergence counters
+            **{
+                f"weight_quant_{k}": v
+                for k, v in self.engine.weight_quant_stats().items()
             },
             # P/D disaggregation: this server's role + KV-handoff volume
             "role": self._role,

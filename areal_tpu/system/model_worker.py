@@ -409,6 +409,38 @@ class ModelWorker(worker_base.Worker):
             lambda x: jax.ShapeDtypeStruct(np.shape(x), _manifest_dtype),
             model.engine.params,
         )
+        # int8 serving tree: ALSO publish the quantized format to the
+        # sibling v{N}-int8 dir and advertise it in the manifest so
+        # servers that opted in (serving_weight_dtype="int8") stage half
+        # the bytes.  Quantization runs eagerly (the produced arrays are
+        # independent of the maybe-donated params); a failure here only
+        # withholds the advertisement — consumers fall back to the
+        # full-precision tree, never crash.
+        serving_quant = None
+        if getattr(
+            getattr(self, "config", None), "publish_quantized_int8", True
+        ):
+            qpath = checkpoint.quant_snapshot_path(path)
+            try:
+                qavals = checkpoint.save_quantized_params(
+                    model.engine.params,
+                    qpath,
+                    cast_dtype=model.model_cfg.dtype,
+                    wait=False,
+                )
+                if qavals is not None:
+                    serving_quant = {
+                        "int8": checkpoint.quant_manifest_entry(
+                            qavals, qpath
+                        )
+                    }
+            except Exception:  # noqa: BLE001 - full tree still publishes
+                self.logger.warning(
+                    "int8 serving-tree publish failed for %s; consumers "
+                    "fall back to the full-precision tree",
+                    qpath,
+                    exc_info=True,
+                )
 
         def _commit():
             # advertise the version only once the checkpoint is durable,
@@ -416,9 +448,28 @@ class ModelWorker(worker_base.Worker):
             # :287-305)
             try:
                 checkpoint.wait_for_saves()
+                # the OPTIONAL quant sibling settles on its own
+                # checkpointer: a failed int8 commit only drops the
+                # advertisement — the durable full-precision publish
+                # below proceeds regardless
+                quant_ok = serving_quant
+                if quant_ok is not None:
+                    try:
+                        checkpoint.wait_for_quant_saves()
+                    except Exception:  # noqa: BLE001 - degrade, don't die
+                        self.logger.warning(
+                            "int8 serving-tree commit failed for v%d; "
+                            "advertising the full-precision tree only",
+                            version,
+                            exc_info=True,
+                        )
+                        quant_ok = None
                 try:
                     checkpoint.write_manifest(
-                        manifest_params, path, version=version
+                        manifest_params,
+                        path,
+                        version=version,
+                        serving_quant=quant_ok,
                     )
                 except OSError:
                     # snapshot already GC'd by a newer publish: the
@@ -448,7 +499,13 @@ class ModelWorker(worker_base.Worker):
                         ),
                         key=lambda d: int(d[1:]),
                     )
-                    for d in snaps[:-2]:
+                    keep = set(snaps[-2:])
+                    # reap old versions AND their -int8 serving-tree
+                    # siblings together (a kept version keeps its pair)
+                    for d in os.listdir(base):
+                        m = _re.fullmatch(r"(v\d+)(-int8)?", d)
+                        if m is None or m.group(1) in keep:
+                            continue
                         shutil.rmtree(
                             os.path.join(base, d), ignore_errors=True
                         )

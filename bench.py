@@ -1029,6 +1029,348 @@ def bench_kv_quant_ab(
     return out
 
 
+def bench_weight_quant_ab(
+    cfg,
+    params,
+    n_reqs=8,
+    prompt_len=256,
+    max_new=64,
+    page=256,
+    chunk=32,
+    turns=3,
+    sessions=4,
+    user_len=24,
+    divergence_bar=0.35,
+    stage_bytes_bar=1.8,
+):
+    """Quantized serving weights A/B (``GenServerConfig.
+    serving_weight_dtype``): the model-dtype param tree ("auto") vs the
+    int8 + per-output-channel-scale serving format on the same engine
+    paths.
+
+    Reported, all MEASURED on the arms actually run:
+
+    * ``param_hbm`` — the resident serving tree's byte footprint per
+      arm (the HBM a quantized fleet frees for paged blocks / prefix
+      cache) and the reduction ratio;
+    * ``staged_swap`` — a staged weight swap per arm against a
+      published snapshot pair (full tree + the ``v*-int8`` sibling the
+      manifest advertises): bytes actually restored, stage seconds
+      (decode running), commit pause ms — the ``bytes_ratio`` >=
+      ``stage_bytes_bar`` gate is the "half-byte staged swaps" claim;
+    * ``decode`` — greedy decode tok/s per arm on an identical paged
+      wave, plus the int8 arm's divergence rate vs the full-precision
+      arm (per-request longest common prefix — one early flip charges
+      the whole tail);
+    * ``replay`` — the multi-turn replay (paged + radix prefix cache)
+      divergence rate: THE ``quality_ok`` gate's workload, folded into
+      the int8 engine's ``areal_inference_weight_quant_*`` counters;
+    * ``max_concurrent_rows`` — full-context rows a FIXED HBM budget
+      (full weights + the fp pool) holds when weight-int8 frees weight
+      bytes into pool blocks, with and without kv int8 COMPOSED (the
+      PR-12 format) — the capacity story the two quantizations buy
+      together;
+    * ``auto_token_parity`` — the "auto" arm against a dense engine on
+      the same wave: the weight-quant plumbing must leave the
+      unquantized path token-identical (pinned in tier-1).
+
+    Sub-arms never silently cap: a cell that raises is recorded as
+    ``{"error": ...}`` and named in ``dropped``."""
+    import shutil
+    import tempfile
+    import threading
+    import zlib
+
+    import jax
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine import checkpoint
+    from areal_tpu.engine.sampling import SamplingParams
+    from areal_tpu.models import quantize
+
+    out = {
+        "batch": n_reqs,
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+        "page_size": page,
+        "divergence_bar": divergence_bar,
+        "stage_bytes_bar": stage_bytes_bar,
+        "dropped": [],
+    }
+
+    def decode_arm(swd, kv_dtype="auto"):
+        eng = make_engine(
+            cfg, params, n_reqs, prompt_len, max_new, chunk=chunk,
+            cache_mode="paged", page_size=page,
+            serving_weight_dtype=swd, kv_cache_dtype=kv_dtype,
+            sampling=SamplingParams(greedy=True),
+        )
+        # IDENTICAL tags (= identical prompt streams and qids) across
+        # arms: the divergence comparison is token-by-token per qid
+        submit_wave(
+            eng, cfg, n_reqs, prompt_len, max_new, "wqwarm", greedy=True
+        )
+        drain(eng)  # warmup: compile this arm's buckets
+        qids = submit_wave(
+            eng, cfg, n_reqs, prompt_len, max_new, "wqwave", greedy=True
+        )
+        t0 = time.perf_counter()
+        while eng.has_work:
+            eng.step()
+        dt = time.perf_counter() - t0
+        outs = eng.drain_results()
+        streams = {q: list(outs[q].output_ids) for q in qids}
+        n_tok = sum(len(s) for s in streams.values())
+        st = eng.weight_quant_stats()
+        row = {
+            "decode_toks_per_sec": round(n_tok / max(dt, 1e-9), 1),
+            "generated_tokens": int(n_tok),
+            "param_bytes": int(st["param_bytes"]),
+            "storage_bits": int(st["storage_bits"]),
+            "quantized_leaves": int(st["quantized_leaves"]),
+            "pool_block_bytes": int(eng._pool_block_bytes()),
+        }
+        return eng, streams, row
+
+    # -- decode wave + param-HBM numbers -----------------------------------
+    try:
+        eng_fp, fp_streams, fp_row = decode_arm("auto")
+        eng_q, q_streams, q_row = decode_arm("int8")
+        div_rate, n_div = lcp_divergence(fp_streams, q_streams)
+        out["param_hbm"] = {
+            "auto_bytes": fp_row["param_bytes"],
+            "int8_bytes": q_row["param_bytes"],
+            "reduction": round(
+                fp_row["param_bytes"] / max(q_row["param_bytes"], 1), 3
+            ),
+        }
+        out["decode"] = {
+            "auto": fp_row,
+            "int8": q_row,
+            "divergence_rate": div_rate,
+            "diverged_requests": int(n_div),
+        }
+        # -- max concurrent rows at a FIXED HBM budget (weights + pool),
+        # composing kv int8 (PR 12): freed weight bytes buy pool blocks
+        budget = fp_row["param_bytes"] + (
+            fp_row["pool_block_bytes"] * eng_fp.n_blocks
+        )
+        bpr = eng_fp.blocks_per_row
+        cells = {}
+        kv_bb = {"auto": fp_row["pool_block_bytes"]}
+        try:
+            eng_kv, _, kv_row = decode_arm("auto", kv_dtype="int8")
+            kv_bb["int8"] = kv_row["pool_block_bytes"]
+            del eng_kv
+        except Exception as e:  # noqa: BLE001
+            out["dropped"].append(
+                f"kv_int8_block_bytes: {type(e).__name__}: {e}"[:120]
+            )
+        for warm, wbytes in (
+            ("auto", fp_row["param_bytes"]),
+            ("int8", q_row["param_bytes"]),
+        ):
+            for kvarm, bb in kv_bb.items():
+                cells[f"w_{warm}+kv_{kvarm}"] = int(
+                    max(budget - wbytes, 0) // bb // bpr
+                )
+        out["max_concurrent_rows"] = {
+            "budget_bytes": int(budget), **cells
+        }
+        del eng_q
+    except Exception as e:  # noqa: BLE001 - a cell is data
+        out["decode"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        out["dropped"].append("decode")
+        eng_fp = None
+        fp_streams = {}
+
+    # -- "auto" arm parity pin: the unquantized path must be untouched -----
+    try:
+        if eng_fp is None:
+            raise RuntimeError("decode arm dropped")
+        dense = make_engine(
+            cfg, params, n_reqs, prompt_len, max_new, chunk=chunk,
+            cache_mode="dense",
+            sampling=SamplingParams(greedy=True),
+        )
+        qids = submit_wave(
+            dense, cfg, n_reqs, prompt_len, max_new, "wqwave", greedy=True
+        )
+        while dense.has_work:
+            dense.step()
+        dense_streams = {
+            q: list(o.output_ids) for q, o in dense.drain_results().items()
+        }
+        out["auto_token_parity"] = bool(
+            all(dense_streams[q] == fp_streams[q] for q in qids)
+        )
+        del dense
+    except Exception as e:  # noqa: BLE001
+        out["auto_token_parity"] = None
+        out["dropped"].append(f"auto_parity: {type(e).__name__}: {e}"[:120])
+    finally:
+        del eng_fp
+
+    # -- staged swap A/B: bytes restored + stage/commit time per format ----
+    pub = tempfile.mkdtemp(prefix="areal-wquant-")
+    try:
+        snap = os.path.join(pub, "v1")
+        checkpoint.save_params(params, snap)
+        qpath = checkpoint.quant_snapshot_path(snap)
+        qavals = checkpoint.save_quantized_params(params, qpath)
+        checkpoint.write_manifest(
+            jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), params
+            ),
+            snap,
+            version=1,
+            serving_quant={
+                "int8": checkpoint.quant_manifest_entry(qavals, qpath)
+            },
+        )
+
+        def staged_arm(swd):
+            eng = make_engine(
+                cfg, params, n_reqs, prompt_len, max_new, chunk=chunk,
+                cache_mode="paged", page_size=page,
+                serving_weight_dtype=swd,
+                sampling=SamplingParams(greedy=True),
+            )
+            submit_wave(
+                eng, cfg, n_reqs, prompt_len, max_new, f"wqsw{swd}",
+                greedy=True,
+            )
+            tok = 0
+            while eng.has_work and tok < n_reqs * chunk:
+                tok += eng.step()
+            # the negotiation the generation server runs: int8 engines
+            # restore the advertised sibling tree, auto the full one
+            restore_path = qpath if swd == "int8" else snap
+            template = eng.weight_restore_template(
+                "int8" if swd == "int8" else "full"
+            )
+            box = {}
+
+            def _stage():
+                try:
+                    p = checkpoint.load_params_staged(
+                        template, restore_path, chunk_bytes=1 << 20
+                    )
+                    box["bytes"] = quantize.tree_bytes(p)
+                    eng.stage_weights(eng.prepare_weights(p), 1)
+                except Exception as e:  # noqa: BLE001 - reported
+                    box["error"] = repr(e)
+
+            th = threading.Thread(target=_stage, daemon=True)
+            t_st = time.perf_counter()
+            th.start()
+            while th.is_alive():
+                eng.step()  # decode CONTINUES during staging
+            th.join()
+            if "error" in box:
+                raise RuntimeError(box["error"])
+            stage_s = time.perf_counter() - t_st
+            t0 = time.perf_counter()
+            eng.pause()
+            eng.step()
+            eng.commit_staged(expected_version=1)
+            eng.resume()
+            while eng.version != 1:
+                eng.step()
+            pause_s = time.perf_counter() - t0
+            drain(eng)
+            del eng
+            return {
+                "staged_bytes": int(box["bytes"]),
+                "stage_ms": round(stage_s * 1e3, 1),
+                "commit_pause_ms": round(pause_s * 1e3, 1),
+            }
+
+        fp_sw = staged_arm("auto")
+        q_sw = staged_arm("int8")
+        ratio = fp_sw["staged_bytes"] / max(q_sw["staged_bytes"], 1)
+        out["staged_swap"] = {
+            "auto": fp_sw,
+            "int8": q_sw,
+            "bytes_ratio": round(ratio, 3),
+            "bytes_ok": bool(ratio >= stage_bytes_bar),
+        }
+    except Exception as e:  # noqa: BLE001
+        out["staged_swap"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        out["dropped"].append("staged_swap")
+    finally:
+        shutil.rmtree(pub, ignore_errors=True)
+
+    # -- multi-turn replay (paged + prefix cache): THE quality gate --------
+    def replay_arm(swd, tag):
+        eng = make_engine(
+            cfg, params, 2,
+            prompt_len + (turns - 1) * (max_new + user_len), max_new,
+            chunk=chunk, cache_mode="paged", page_size=page,
+            serving_weight_dtype=swd,
+            sampling=SamplingParams(greedy=True),
+        )
+        eng.park_ttl_steps = 0  # fresh-qid turns never resume parks
+        rngs = [
+            np.random.default_rng(zlib.crc32(f"{tag}s{s}".encode()))
+            for s in range(sessions)
+        ]
+        convs = [
+            rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+            for rng in rngs
+        ]
+        streams = {}
+        for j in range(turns):
+            for s in range(sessions):
+                qid = f"{tag}s{s}t{j}"
+                eng.submit(
+                    APIGenerateInput(
+                        qid=qid,
+                        prompt_ids=convs[s],
+                        input_ids=convs[s],
+                        gconfig=GenerationHyperparameters(
+                            max_new_tokens=max_new, greedy=True
+                        ),
+                    )
+                )
+                while eng.has_work:
+                    eng.step()
+                o = eng.drain_results()[qid]
+                streams[qid] = list(o.output_ids)
+                convs[s] = (
+                    convs[s]
+                    + list(o.output_ids)
+                    + rngs[s].integers(
+                        0, cfg.vocab_size, (user_len,)
+                    ).tolist()
+                )
+        return eng, streams
+
+    try:
+        eng_rf, fp_rep = replay_arm("auto", "wqr")
+        del eng_rf
+        eng_rq, q_rep = replay_arm("int8", "wqr")
+        rep_div, rep_n_div = lcp_divergence(fp_rep, q_rep)
+        # the measured check lands on the INT8 arm's quality counters
+        # (the areal_inference_weight_quant_divergence_* series) — it is
+        # the arm whose storage is under test
+        eng_rq.note_weight_divergence_check(len(fp_rep), rep_n_div)
+        out["replay"] = {
+            "requests": len(fp_rep),
+            "divergence_rate": rep_div,
+            "diverged_requests": int(rep_n_div),
+            "quality_ok": bool(rep_div <= divergence_bar),
+        }
+        del eng_rq
+    except Exception as e:  # noqa: BLE001
+        out["replay"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        out["dropped"].append("replay")
+    return out
+
+
 def bench_slo_report(
     cfg,
     params,
@@ -2445,6 +2787,7 @@ SUMMARY_REQUIRED_KEYS = (
     "prefix_cache_ab",
     "prefix_cache_hier",
     "kv_quant_ab",
+    "weight_quant_ab",
     "trace_overhead_ab",
     "spec_decode_ab",
     "slo_report",
@@ -2464,6 +2807,7 @@ def build_summary(
     prefix_cache_ab=None,
     prefix_cache_hier=None,
     kv_quant_ab=None,
+    weight_quant_ab=None,
     trace_overhead_ab=None,
     spec_decode_ab=None,
     slo_report=None,
@@ -2503,6 +2847,7 @@ def build_summary(
         "prefix_cache_ab": prefix_cache_ab,
         "prefix_cache_hier": prefix_cache_hier,
         "kv_quant_ab": kv_quant_ab,
+        "weight_quant_ab": weight_quant_ab,
         "trace_overhead_ab": trace_overhead_ab,
         "spec_decode_ab": spec_decode_ab,
         "slo_report": slo_report,
@@ -3307,6 +3652,28 @@ def main():
         ),
     )
 
+    # quantized serving weights A/B: model-dtype vs int8 + scales param
+    # trees — param-HBM reduction, staged-swap bytes/time per format,
+    # decode tok/s, fixed-budget capacity with kv-int8 composed, and
+    # the MEASURED greedy divergence rate per workload (quality gate).
+    # Runs off-TPU too — tiny shapes — so the summary always carries
+    # the >=1.8x staged-bytes + quality-bar acceptance numbers.
+    mark("weight quant A/B")
+    weight_quant_ab = _section(
+        bench_weight_quant_ab,
+        cfg,
+        gen_params,
+        name="weight_quant_ab",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                n_reqs=2, prompt_len=48, max_new=12, page=16, chunk=8,
+                turns=2, sessions=3, user_len=8,
+            )
+        ),
+    )
+
     # request-level SLO report: fleet-merged TTFT/TPOT percentiles under
     # the multi-turn replay + spec-decode workloads, digest-merge
     # cross-check, and the SLO-tracking on/off overhead A/B (<2% bar).
@@ -3593,6 +3960,7 @@ def main():
         prefix_cache_ab=prefix_cache_ab,
         prefix_cache_hier=prefix_cache_hier,
         kv_quant_ab=kv_quant_ab,
+        weight_quant_ab=weight_quant_ab,
         trace_overhead_ab=trace_overhead_ab,
         spec_decode_ab=spec_decode_ab,
         slo_report=slo_report,
@@ -3656,6 +4024,7 @@ def main():
                     "prefix_cache_ab": prefix_cache_ab,
                     "prefix_cache_hier": prefix_cache_hier,
                     "kv_quant_ab": kv_quant_ab,
+                    "weight_quant_ab": weight_quant_ab,
                     "trace_overhead_ab": trace_overhead_ab,
                     "spec_decode_ab": spec_decode_ab,
                     "slo_report": slo_report,

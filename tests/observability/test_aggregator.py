@@ -58,6 +58,15 @@ def three_live_workers():
     gen.counter(
         "areal_inference_kv_quant_divergence_diverged_total"
     ).inc(1)
+    # quantized serving weights: storage-bits + leaf gauges + checks
+    gen.gauge("areal_inference_weight_quant_storage_bits").set(8.0)
+    gen.gauge("areal_inference_weight_quant_leaves").set(8.0)
+    gen.counter(
+        "areal_inference_weight_quant_divergence_checks_total"
+    ).inc(6)
+    gen.counter(
+        "areal_inference_weight_quant_divergence_diverged_total"
+    ).inc(2)
     # P/D handoff: export/import volume + a reasoned fail-closed reject
     gen.counter("areal_inference_handoff_exports_total").inc(3)
     gen.counter("areal_inference_handoff_imports_total").inc(2)
@@ -157,6 +166,31 @@ def test_discovers_and_scrapes_three_live_workers(
             "areal_inference_kv_quant_divergence_diverged_total"
         ]
         == 1.0
+    )
+    # the quantized-serving-weight family survives the scrape cycle
+    assert (
+        flat[
+            "cluster/gen_server_0/areal_inference_weight_quant_storage_bits"
+        ]
+        == 8.0
+    )
+    assert (
+        flat["cluster/gen_server_0/areal_inference_weight_quant_leaves"]
+        == 8.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_weight_quant_divergence_checks_total"
+        ]
+        == 6.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_weight_quant_divergence_diverged_total"
+        ]
+        == 2.0
     )
     # the P/D disaggregation families survive the scrape cycle: role
     # gauges + route counter on the manager, handoff volume + reasoned

@@ -218,6 +218,45 @@ def test_handoff_racing_weight_swap_fails_closed_after_import():
     assert got == ref
 
 
+def test_handoff_racing_quantized_weight_swap_fails_closed():
+    """PR-13 x weight-quant interaction pin: when the swap that causes
+    the version skew is a QUANTIZED-tree swap (int8 serving weights on
+    both roles), the import still fails closed on version and the
+    continuation re-prefills — same stream via the safe path, and the
+    decode server's resident tree stays in the quantized format."""
+    from areal_tpu.models import quantize
+
+    uni, _, params = make_engine(serving_weight_dtype="int8")
+    uni.submit(_req("pdq", PROMPT, 10))
+    run_until_done(uni)
+    ref = list(uni.wait_result("pdq", timeout=10).output_ids)
+
+    P, *_ = make_engine(params=params, serving_weight_dtype="int8")
+    D, *_ = make_engine(params=params, serving_weight_dtype="int8")
+    got, ok, reason = _drive_disagg(
+        P, D, PROMPT, 10, qid="pdq",
+        # same weights, bumped version — arriving in the engine's
+        # resident (quantized) format, as the server negotiation does
+        swap_before_import=(D.prepare_weights(params), 1),
+    )
+    assert not ok and reason == "version"
+    assert D.handoff_stats()["import_rejects"] == {"version": 1}
+    assert D.resumed_total == 0  # re-prefilled, never resumed stale KV
+    assert D.prefill_tokens_total > 0
+    assert got == ref
+    assert quantize.is_quantized_tree(D.params)
+    # the eviction path too: a quantized swap AFTER the import evicts
+    # the parked row like any other swap
+    P2, *_ = make_engine(params=params, serving_weight_dtype="int8")
+    D2, *_ = make_engine(params=params, serving_weight_dtype="int8")
+    got2, ok2, _ = _drive_disagg(
+        P2, D2, PROMPT, 10, qid="pdq2",
+        swap_after_import=(D2.prepare_weights(params), 1),
+    )
+    assert ok2 and D2.resumed_total == 0 and D2.prefill_tokens_total > 0
+    assert got2 == ref
+
+
 def test_import_rejects_dense_and_layout_mismatch():
     _, _, params = make_engine()
     P, *_ = make_engine(params=params)
