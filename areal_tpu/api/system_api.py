@@ -256,6 +256,17 @@ class GenServerConfig:
     # (a dead peer must not wedge the prefill server's poll loop; on
     # timeout the continuation re-prefills on the decode server)
     handoff_request_timeout: float = 60.0
+    # STREAMED handoff (default on): export each fill chunk's finalized
+    # blocks as a numbered segment the moment the chunk lands — one
+    # coalesced buffer per segment over the import_handoff_segment RPC,
+    # pushed while later chunks still fill — and the decode server
+    # pre-allocates the row's blocks on segment 0 and async-scatters
+    # each segment under its own decode chunks, so the decode-side
+    # resume gap is O(one chunk) instead of O(prompt).  Every segment
+    # is version-checked fail-closed (skew, sequence gaps, aborts, and
+    # dead peers all release the partial blocks; the continuation
+    # re-prefills).  False = the PR-13 monolithic handoff unit.
+    handoff_streaming: bool = True
     # self-speculative n-gram decoding on the paged path (default off);
     # maps SGLang's ngram speculative mode / vLLM's ngram
     # speculative_config — see SpecDecodeConfig + docs
@@ -341,6 +352,21 @@ class GserverManagerConfig:
     # per-server timeout for the stage RPC — generous, because staging
     # runs OFF the paused critical path (decode continues throughout)
     stage_request_timeout: float = 600.0
+    # load-aware prefill admission (two-stage P/D fleets): prefill
+    # servers report their in-flight prefill-token backlog through the
+    # metrics RPC (scraped at most every prefill_backlog_refresh_s,
+    # with optimistic local increments between scrapes) and a NEW
+    # request's prefill stage goes to the least-backlog-per-chip server
+    # instead of the load-blind chip-weighted rotation.  When EVERY
+    # prefill server's backlog-per-chip exceeds
+    # prefill_saturation_tokens_per_chip, the request is SHED: it
+    # routes straight to its decode owner and serves unified-style
+    # there (prefill + decode on one server) — admission pressure never
+    # queues unboundedly on a saturated prefill pool.  0 disables
+    # shedding; prefill_load_aware=False restores the PR-13 rotation.
+    prefill_load_aware: bool = True
+    prefill_backlog_refresh_s: float = 0.5
+    prefill_saturation_tokens_per_chip: int = 65536
     trace: Optional[TraceConfig] = None
 
 

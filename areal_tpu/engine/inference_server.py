@@ -406,6 +406,7 @@ class ContinuousBatchingEngine:
         spec_decode_params: Optional[spec_decode.SpecDecodeParams] = None,
         slo_tracking: bool = True,
         server_name: str = "",
+        handoff_streaming: bool = False,
     ):
         """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
         shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
@@ -502,6 +503,17 @@ class ContinuousBatchingEngine:
         weight swaps flush both tiers.  Single-process engines only
         (multi-process SPMD serving disables the tier with a warning —
         host buffers would cover just the local pool shard).
+
+        ``handoff_streaming`` (paged mode): stream a handoff-flagged
+        row's KV to the decode peer INCREMENTALLY — as each fill chunk
+        completes, the now-final full pool blocks are gathered (one
+        coalesced buffer per segment) and queued for export
+        (:meth:`drain_handoff_segments`; the worker pushes them over the
+        ``import_handoff_segment`` RPC while later chunks still fill),
+        and the FINAL segment carries the tail block plus the first
+        token + host metadata — so the decode-side resume gap is O(one
+        chunk) instead of O(prompt).  Off (default) keeps the PR-13
+        monolithic ``export_handoff``/``import_handoff`` unit.
         """
         self.cfg = cfg
         self.device = device
@@ -757,6 +769,26 @@ class ContinuousBatchingEngine:
         self.handoff_bytes_total = 0
         self.handoff_seconds_total = 0.0
         self.handoff_import_rejects: Dict[str, int] = {}
+        # streamed (segmented) handoff: per-chunk segment export/import
+        # counters plus exporter-side aborts (a stream cut short by EOS
+        # at the first token, a weight swap restarting the fill, or an
+        # explicit cancel — the decode peer releases its partial blocks)
+        self._handoff_streaming = bool(handoff_streaming)
+        self.handoff_segment_exports_total = 0
+        self.handoff_segment_imports_total = 0
+        self.handoff_segment_aborts_total = 0
+        #: outbound segment queue (engine thread appends; the worker
+        #: drains each poll and pushes per-stream IN ORDER)
+        self._handoff_segments: List[Dict[str, Any]] = []
+        #: export-side stream state per handoff-flagged qid
+        self._handoff_streams: Dict[str, Dict[str, Any]] = {}
+        #: import-side partially-received streams: qid -> {blocks,
+        #: next_seq, received, version, step, total}.  Blocks are owned
+        #: by the record until the final segment parks the row or a
+        #: failure releases them — never evictable, so the TTL below
+        #: bounds how long a dead peer's half-stream can pin pool space.
+        self._handoff_pending: Dict[str, Dict[str, Any]] = {}
+        self.handoff_pending_ttl_steps = 512
         # decode-loop time attribution (cumulative seconds): host = admit/
         # bookkeeping/dispatch-enqueue, device = blocked waiting for chunk
         # compute, fetch = device->host transfer after completion.  The
@@ -1437,6 +1469,381 @@ class ContinuousBatchingEngine:
         )
         return True, ""
 
+    # -- streamed (segmented) handoff: chunk-overlapped export/import --------
+    #
+    # The monolithic unit above ships gather + wire + scatter of the
+    # WHOLE prompt after prefill completes — a serial bubble the size of
+    # the prompt on the decode-resume path.  With ``handoff_streaming``
+    # the prefill engine exports each fill chunk's now-FINAL full blocks
+    # as a numbered segment the moment the chunk lands (one coalesced
+    # buffer per segment, riding the same gather helper), the worker
+    # pushes segments while later chunks still fill, and the decode
+    # engine pre-allocates the row's blocks on segment 0 and
+    # async-scatters each segment under its own decode chunks — so when
+    # the final segment (tail block + first token + metadata) arrives,
+    # the remaining resume gap is O(one chunk), not O(prompt).  Every
+    # segment carries the exporter's weight version and is checked
+    # fail-closed: any skew, sequence gap, abort, or dead-peer timeout
+    # releases the partial blocks and the continuation re-prefills —
+    # stale or incomplete KV is never decoded.
+
+    def _gather_blocks_device(self, blocks: List[int]) -> Tuple[Any, ...]:
+        """Dispatch ONE async whole-block gather (no device_get): the
+        returned device arrays are materialized later — by the worker's
+        push thread, off the engine thread — so the copy-out rides under
+        the fill/decode chunks dispatched after it."""
+        n = len(blocks)
+        n_pad = 1 << (n - 1).bit_length()
+        idx = np.zeros((n_pad,), np.int32)
+        idx[:n] = blocks
+        out = paged.gather_blocks(
+            self.k_pool, self.v_pool, jnp.asarray(idx),
+            k_scale=self.k_scale, v_scale=self.v_scale,
+        )
+        return tuple(a[:n] for a in out)
+
+    def _queue_handoff_segment(
+        self, qid: str, st: Dict[str, Any], blocks: List[int],
+        total: int, final: bool, row: Optional[_Row] = None,
+    ):
+        """Gather ``blocks`` (may be empty on a final segment of a
+        page-aligned prompt) and append one numbered segment to the
+        outbound queue."""
+        tik = time.perf_counter()
+        payload = self._gather_blocks_device(blocks) if blocks else ()
+        seg: Dict[str, Any] = {
+            "qid": qid,
+            "dest": st["dest"],
+            "seq": st["seq"],
+            "block_start": st["exported"],
+            "n_blocks": len(blocks),
+            "total_blocks": total,
+            "version": self.version,
+            "page_size": self.page_size,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "final": final,
+            "payload": payload,
+        }
+        if final:
+            assert row is not None
+            seg["req"] = row.req
+            seg["prompt"] = list(row.prompt)
+            seg["generated"] = list(row.generated)
+            seg["logprobs"] = list(row.logprobs)
+        self._handoff_segments.append(seg)
+        n_bytes = int(sum(a.nbytes for a in payload))
+        self.handoff_segment_exports_total += 1
+        self.handoff_bytes_total += n_bytes
+        self.handoff_seconds_total += time.perf_counter() - tik
+        if final:
+            self.handoff_exports_total += 1
+        self.tracer.event(
+            qid, "engine.handoff_segment",
+            seq=st["seq"], blocks=len(blocks), bytes=n_bytes,
+            final=final, version=self.version,
+        )
+        st["seq"] += 1
+        st["exported"] += len(blocks)
+
+    def _emit_handoff_segments(self, f: _Fill):
+        """Export the blocks a fill chunk just FINALIZED for every
+        handoff-flagged target: full blocks strictly below ``fill_pos``
+        never receive another write (the partial tail keeps appending
+        until the fill completes and travels with the final segment)."""
+        if not f.targets:
+            return
+        full_final = min(
+            min(f.fill_pos, len(f.tokens)) // self.page_size,
+            len(f.blocks),
+        )
+        if full_final <= 0:
+            return
+        for tgt in f.targets:
+            if tgt.resume is not None:
+                continue
+            dest = (tgt.req.metadata or {}).get("handoff_to")
+            if not dest:
+                continue
+            qid = tgt.req.qid
+            st = self._handoff_streams.get(qid)
+            if st is None:
+                st = {"dest": dest, "seq": 0, "exported": 0}
+                self._handoff_streams[qid] = st
+            if st["exported"] >= full_final:
+                continue
+            self._queue_handoff_segment(
+                qid, st, f.blocks[st["exported"] : full_final],
+                total=len(f.blocks), final=False,
+            )
+
+    def _emit_final_handoff_segment(self, rid: int, row: _Row):
+        """The stream's last segment: the tail block(s) not yet exported
+        plus the first generated token and the host request state.  The
+        row is then RELEASED — like the monolithic export, the radix
+        cache's own references (inserted at fill completion) keep the
+        prefix alive for sibling reuse on this server."""
+        qid = row.req.qid
+        dest = (row.req.metadata or {}).get("handoff_to")
+        st = self._handoff_streams.pop(qid, None)
+        if st is None:
+            # no chunk boundary ever emitted (short prompt): the whole
+            # handoff is this one final segment
+            st = {"dest": dest, "seq": 0, "exported": 0}
+        row_blocks = self._row_blocks[rid]
+        self._queue_handoff_segment(
+            qid, st, row_blocks[st["exported"] :],
+            total=len(row_blocks), final=True, row=row,
+        )
+        self._release_row(rid)
+
+    def _abort_handoff_stream(self, qid: str, reason: str = ""):
+        """Cut an export stream short (EOS at the first token, a weight
+        swap restarting the fill): queue an abort marker so the decode
+        peer releases its partial blocks promptly (its TTL sweep is the
+        dead-sender backstop)."""
+        st = self._handoff_streams.pop(qid, None)
+        if st is None or st["seq"] == 0:
+            return  # nothing ever left this server: nothing to clean up
+        self._handoff_segments.append({
+            "qid": qid,
+            "dest": st["dest"],
+            "seq": st["seq"],
+            "abort": True,
+            "version": self.version,
+        })
+        self.handoff_segment_aborts_total += 1
+        self.tracer.event(
+            qid, "engine.handoff_segment",
+            seq=st["seq"], abort=True, reason=reason,
+        )
+
+    def drain_handoff_segments(self) -> List[Dict[str, Any]]:
+        """Pop the outbound export segments (worker poll loop; in-process
+        drivers — bench, dryrun, tests — pump them straight into the
+        decode engine).  Payloads are still device arrays; the pusher
+        materializes them (``jax.device_get``) off the engine thread."""
+        out = self._handoff_segments
+        self._handoff_segments = []
+        return out
+
+    def _scatter_stacked(self, components, blocks: List[int]):
+        """One async scatter of a segment's coalesced payload into
+        ``blocks`` — rides under whatever decode chunks are queued."""
+        out = paged.restore_blocks_host_stacked(
+            self.k_pool, self.v_pool, components, blocks,
+            k_scale=self.k_scale, v_scale=self.v_scale,
+        )
+        if self._kv_quant:
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale) = out
+        else:
+            self.k_pool, self.v_pool = out
+
+    def _release_pending_handoff(self, qid: str, reason: str = ""):
+        """Free a partially-imported stream's blocks (fail-closed: the
+        continuation re-prefills).  ``reason`` counts a reject; empty
+        means a benign replace (a fresh segment 0 restarting a stream)."""
+        pend = self._handoff_pending.pop(qid, None)
+        if pend is None:
+            return
+        self._free_block_list(pend["blocks"])
+        if reason:
+            self._reject_handoff(qid, reason)
+
+    def import_handoff_segment(self, seg: Dict[str, Any]) -> Tuple[bool, str]:
+        """Import ONE segment of a streamed handoff.  Segment 0
+        pre-allocates ALL ``total_blocks`` of the row (so later segments
+        never wait on the allocator); every segment's coalesced payload
+        is scattered with one async dispatch riding under the decode
+        chunks; the final segment validates completeness, parks the row,
+        stamps its device-side length, and radix-inserts the prefix —
+        the continuation resumes through the ordinary ``_try_resume``
+        with zero prefill.
+
+        Fails CLOSED per segment: version skew (a weight swap on either
+        side mid-stream), a sequence gap or unknown stream
+        (``"stream"``), layout/geometry mismatches, pool/row exhaustion,
+        and exporter aborts all release the partial blocks; reasons
+        extend the monolithic set with ``stream`` | ``abort`` |
+        ``expired`` (the TTL sweep for dead peers).  Stale or incomplete
+        KV is never decoded."""
+        t0 = time.perf_counter()
+        qid = seg.get("qid", "?")
+        if seg.get("abort"):
+            if qid in self._handoff_pending:
+                self._release_pending_handoff(qid, reason="abort")
+            return True, ""  # an abort for an unknown stream is a no-op
+        if not self.paged:
+            return self._reject_handoff(qid, "dense")
+        if (
+            seg.get("page_size") != self.page_size
+            or seg.get("kv_cache_dtype") != self.kv_cache_dtype
+        ):
+            self._release_pending_handoff(qid)
+            return self._reject_handoff(qid, "layout")
+        if seg.get("version") != self.version:
+            # per-segment version rule: EVERY segment must match the
+            # current weights — a swap mid-stream invalidates whatever
+            # was already scattered
+            self._release_pending_handoff(qid)
+            return self._reject_handoff(qid, "version")
+        seq = int(seg.get("seq", -1))
+        payload = seg.get("payload") or ()
+        n = int(seg.get("n_blocks", 0))
+        pend = self._handoff_pending.get(qid)
+        if seq == 0:
+            if pend is not None:
+                # a restarted stream (exporter-side fill restart)
+                # replaces the old half-stream — benign, not a reject
+                self._release_pending_handoff(qid)
+            total = int(seg.get("total_blocks", 0))
+            if not 0 < total <= self.blocks_per_row:
+                return self._reject_handoff(qid, "layout")
+            with self._lock:
+                queued = {r.qid for r in self._pending}
+            blocks = self._alloc_blocks_reclaiming(total, keep_qids=queued)
+            if blocks is None:
+                return self._reject_handoff(qid, "pool")
+            pend = {
+                "blocks": blocks,
+                "next_seq": 0,
+                "received": 0,
+                "version": seg.get("version"),
+                "step": self._step_seq,
+                "total": total,
+            }
+            self._handoff_pending[qid] = pend
+        elif (
+            pend is None
+            or pend["next_seq"] != seq
+            or pend["version"] != seg.get("version")
+            or pend["total"] != int(seg.get("total_blocks", -1))
+        ):
+            self._release_pending_handoff(qid)
+            return self._reject_handoff(qid, "stream")
+        start = int(seg.get("block_start", -1))
+        if start != pend["received"] or start + n > pend["total"]:
+            self._release_pending_handoff(qid)
+            return self._reject_handoff(qid, "stream")
+        if n:
+            # per-segment geometry check — a peer built from a different
+            # model config rejects BEFORE the scatter can raise
+            pool_block_shape = (
+                self.k_pool.shape[:1] + self.k_pool.shape[2:]
+            )
+            if (
+                len(payload) != len(self._pool_arrays())
+                or payload[0].shape[0] != n
+                or tuple(payload[0].shape[1:]) != pool_block_shape
+            ):
+                self._release_pending_handoff(qid)
+                return self._reject_handoff(qid, "layout")
+            try:
+                self._scatter_stacked(
+                    payload, pend["blocks"][start : start + n]
+                )
+            except Exception:  # noqa: BLE001 - free and fail closed
+                logger.exception(
+                    "handoff segment scatter failed for %s", qid
+                )
+                self._release_pending_handoff(qid)
+                return self._reject_handoff(qid, "scatter")
+        pend["received"] += n
+        pend["next_seq"] = seq + 1
+        pend["step"] = self._step_seq
+        n_bytes = int(sum(a.nbytes for a in payload))
+        final = bool(seg.get("final"))
+
+        def _count_segment():
+            # counted only once the segment is ACCEPTED: a final segment
+            # rejected below must not let the export/import segment
+            # counters read as balanced while the stream actually failed
+            self.handoff_segment_imports_total += 1
+            self.handoff_bytes_total += n_bytes
+            self.tracer.event(
+                qid, "engine.handoff_segment_import",
+                seq=seq, blocks=n, bytes=n_bytes, final=final,
+                version=self.version,
+            )
+
+        if not final:
+            _count_segment()
+            self.handoff_seconds_total += time.perf_counter() - t0
+            return True, ""
+        # final segment: completeness + host state, then park for resume
+        if pend["received"] != pend["total"]:
+            self._release_pending_handoff(qid)
+            return self._reject_handoff(qid, "stream")
+        prompt = list(seg.get("prompt") or [])
+        generated = list(seg.get("generated") or [])
+        if not generated:
+            self._release_pending_handoff(qid)
+            return self._reject_handoff(qid, "empty")
+        n_kv = len(prompt) + len(generated) - 1
+        if (
+            len(prompt) + len(generated) + 1 >= self.kv_cache_len
+            or -(-n_kv // self.page_size) > pend["total"]
+        ):
+            self._release_pending_handoff(qid)
+            return self._reject_handoff(qid, "layout")
+        rid = next(
+            (i for i, r in enumerate(self.rows) if r is None), None
+        )
+        with self._lock:
+            queued = {r.qid for r in self._pending}
+        if rid is None:
+            rid = self._evict_parked(keep_qids=queued)
+        if rid is None:
+            rid = self._evict_parked()  # unprotected last resort
+        if rid is None:
+            self._release_pending_handoff(qid)
+            return self._reject_handoff(qid, "capacity")
+        blocks = pend["blocks"]
+        del self._handoff_pending[qid]  # ownership moves to the row
+        row = _Row(
+            req=seg["req"],
+            prompt=prompt,
+            generated=generated,
+            logprobs=list(seg.get("logprobs") or []),
+            version_start=self.version,
+            no_eos=True,
+            cur_token=int(generated[-1]),
+            parked=True,
+            park_step=self._step_seq,
+        )
+        self._epoch_counter += 1
+        row.epoch = self._epoch_counter
+        self.rows[rid] = row
+        self._set_row_blocks(rid, blocks)
+        self.kv_lengths = self.kv_lengths.at[
+            np.array([rid], np.int32)
+        ].set(n_kv)
+        self._cache_insert((prompt + generated)[:-1], blocks)
+        _count_segment()
+        self.handoff_imports_total += 1
+        self.handoff_seconds_total += time.perf_counter() - t0
+        self.tracer.event(
+            qid, "engine.handoff_import",
+            ok=True, row=rid, blocks=pend["total"], streamed=True,
+            version=self.version,
+        )
+        return True, ""
+
+    def prefill_backlog_tokens(self) -> int:
+        """In-flight prefill-token backlog: prompt tokens admitted to the
+        fill queue but not yet filled, plus the queued prompts waiting
+        for admission.  Computed fresh from the live structures, so a
+        completed handoff, a finished fill, and a failed/evicted row all
+        decrement it by construction — the load signal the gserver
+        manager's least-backlog prefill admission routes on."""
+        backlog = 0
+        if self.paged:
+            for f in self._filling:
+                backlog += max(0, len(f.tokens) - f.fill_pos)
+        with self._lock:
+            for r in self._pending:
+                backlog += len(r.input_ids or r.prompt_ids)
+        return backlog
+
     def handoff_stats(self) -> Dict[str, Any]:
         """Cumulative KV-handoff counters (worker scrape + metrics RPC +
         bench)."""
@@ -1446,6 +1853,10 @@ class ContinuousBatchingEngine:
             "bytes_total": self.handoff_bytes_total,
             "seconds_total": self.handoff_seconds_total,
             "import_rejects": dict(self.handoff_import_rejects),
+            "segment_exports_total": self.handoff_segment_exports_total,
+            "segment_imports_total": self.handoff_segment_imports_total,
+            "segment_aborts_total": self.handoff_segment_aborts_total,
+            "pending_streams": len(self._handoff_pending),
         }
 
     # -- client API (any thread) -------------------------------------------
@@ -1807,6 +2218,17 @@ class ContinuousBatchingEngine:
             # KV is rejected.
             if self._prefix_cache is not None:
                 self._prefix_cache.flush(new_version=self.version)
+            # streamed-handoff state is version-bound on BOTH sides:
+            # export streams restart with their fills below (segments
+            # re-emit from block 0 under the new version; the abort
+            # tells the peer to drop the dead half-stream promptly),
+            # and partially-IMPORTED streams hold KV computed under the
+            # old weights — released fail-closed, the continuation
+            # re-prefills (same rule as the monolithic version reject)
+            for qid in list(self._handoff_streams):
+                self._abort_handoff_stream(qid, reason="weight_swap")
+            for qid in list(self._handoff_pending):
+                self._release_pending_handoff(qid, reason="version")
             # chunk-filling rows hold KV computed under the OLD weights:
             # restart their fills from scratch (their rows/blocks stay;
             # a cache-matched fill_pos also resets — its prefix blocks
@@ -2063,6 +2485,12 @@ class ContinuousBatchingEngine:
                     f.targets[0].req.qid, "engine.fill_chunk",
                     tokens=take, fill_pos=f.fill_pos,
                 )
+                if self._handoff_streaming:
+                    # streamed handoff: the chunk just finalized some
+                    # full blocks — export them NOW, while the rest of
+                    # the prompt still fills (the overlap that shrinks
+                    # the decode-side resume gap to O(one chunk))
+                    self._emit_handoff_segments(f)
             if f.fill_pos == len(f.tokens):
                 completed.append(f)
                 idxs.append(i)
@@ -2219,6 +2647,13 @@ class ContinuousBatchingEngine:
                     row.no_eos = tok_i not in self.stop_tokens
                     self._finish(tgt.row_id, row, started=False)
                     self._release_row(tgt.row_id)
+                    if self._handoff_streaming:
+                        # the request ends HERE (EOS / 1-token budget):
+                        # any segments already streamed have no final —
+                        # tell the decode peer to release them
+                        self._abort_handoff_stream(
+                            tgt.req.qid, reason="eos"
+                        )
                     continue
                 row.cur_token = int(tok_i)
                 row.budget_left = tgt.max_new - 1
@@ -2234,6 +2669,11 @@ class ContinuousBatchingEngine:
                         np.array([tgt.row_id], np.int32)
                     ].set(plen)
                     self._finish(tgt.row_id, row, park=True)
+                    if self._handoff_streaming:
+                        # streamed mode: the final segment (tail block +
+                        # first token + host state) replaces the
+                        # monolithic export — emitted now, row released
+                        self._emit_final_handoff_segment(tgt.row_id, row)
                     continue
                 self._epoch_counter += 1
                 row.epoch = self._epoch_counter
@@ -2271,6 +2711,13 @@ class ContinuousBatchingEngine:
                 self._step_seq - row.park_step > self.park_ttl_steps
             ):
                 self._release_row(row_id)
+        # dead-peer backstop for streamed imports: a half-received
+        # stream whose sender died mid-push would pin its pre-allocated
+        # blocks forever — release it fail-closed after the TTL (the
+        # continuation re-prefills; zero leaked blocks)
+        for qid, pend in list(self._handoff_pending.items()):
+            if self._step_seq - pend["step"] > self.handoff_pending_ttl_steps:
+                self._release_pending_handoff(qid, reason="expired")
         free = [i for i, r in enumerate(self.rows) if r is None]
 
         def take_row():

@@ -730,12 +730,61 @@ def restore_blocks_from_host(
     n = len(payloads)
     assert n == len(dst) and n > 0
     n_pad = 1 << (n - 1).bit_length()
+    # fill the padded transfer buffers directly (one pass per component)
     stacked = []
     for c, proto in enumerate(payloads[0]):
         buf = np.zeros((n_pad,) + proto.shape, proto.dtype)
         for i, payload in enumerate(payloads):
             buf[i] = payload[c]
         stacked.append(jnp.asarray(buf))
+    return _restore_padded(
+        k_pool, v_pool, stacked, n, dst,
+        k_scale=k_scale, v_scale=v_scale,
+    )
+
+
+def restore_blocks_host_stacked(
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    components: Sequence[np.ndarray],
+    dst: Sequence[int],
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+):
+    """Like :func:`restore_blocks_from_host`, but the payload arrives as
+    ONE contiguous buffer per pool component — ``(k [n, L, Hkv, BS, hd],
+    v, [k_scale [n, L, Hkv, BS], v_scale])`` indexed ``[i] -> dst[i]``,
+    exactly :func:`gather_blocks_host`'s output shape.  This is the
+    segmented KV-handoff wire format: a streamed segment ships its
+    blocks coalesced and scatters them without a per-block
+    split/re-stack round trip.  Pads to a power of two and dispatches
+    ONE async :func:`restore_blocks`; returns the updated pools."""
+    n = len(dst)
+    assert n > 0
+    n_pad = 1 << (n - 1).bit_length()
+    stacked = []
+    for c in components:
+        c = np.asarray(c)
+        assert c.shape[0] == n, (c.shape, n)
+        if n_pad == n:
+            buf = c
+        else:
+            buf = np.zeros((n_pad,) + c.shape[1:], c.dtype)
+            buf[:n] = c
+        stacked.append(jnp.asarray(buf))
+    return _restore_padded(
+        k_pool, v_pool, stacked, n, dst,
+        k_scale=k_scale, v_scale=v_scale,
+    )
+
+
+def _restore_padded(
+    k_pool, v_pool, stacked, n, dst, k_scale=None, v_scale=None
+):
+    """Shared dispatch tail of the two host-restore entry points:
+    ``stacked`` components are already power-of-two padded device-ready
+    buffers covering ``dst[:n]``."""
+    n_pad = stacked[0].shape[0]
     # pad destinations point one past the pool: mode="drop" discards them
     dst_arr = np.full((n_pad,), k_pool.shape[1], np.int32)
     dst_arr[:n] = dst

@@ -251,8 +251,32 @@ METRIC_TABLE = [
         "counter",
         "Handoff imports rejected fail-closed, by reason (version = "
         "weight-swap skew; layout | dense | capacity | pool | empty | "
-        "scatter); the continuation re-prefills on the decode server",
+        "scatter; streamed handoffs add stream = sequence gap/unknown "
+        "stream, abort = exporter cut the stream short, expired = the "
+        "dead-peer TTL released a half-received stream); the "
+        "continuation re-prefills on the decode server",
         ("reason",),
+    ),
+    MetricSpec(
+        "areal_inference_handoff_segment_exports_total",
+        "counter",
+        "Streamed-handoff segments exported by a prefill-role server "
+        "(one per fill-chunk boundary of a handoff-flagged row, plus "
+        "the final tail+metadata segment)",
+    ),
+    MetricSpec(
+        "areal_inference_handoff_segment_imports_total",
+        "counter",
+        "Streamed-handoff segments imported and scattered by a "
+        "decode-role server (the scatters ride under its decode chunks "
+        "while the prefill side is still filling)",
+    ),
+    MetricSpec(
+        "areal_inference_handoff_segment_aborts_total",
+        "counter",
+        "Export streams cut short by the prefill server (EOS at the "
+        "first token, a weight swap restarting the fill) — the decode "
+        "peer releases its partial blocks",
     ),
     MetricSpec(
         "areal_inference_handoff_bytes_total",
@@ -431,6 +455,21 @@ METRIC_TABLE = [
         "counter",
         "New requests routed through the two-stage prefill->handoff->"
         "decode path (continuations sticky-route and are not counted)",
+    ),
+    MetricSpec(
+        "areal_gserver_prefill_backlog_tokens",
+        "gauge",
+        "Estimated in-flight prefill-token backlog per prefill server "
+        "(metrics-RPC scrape + optimistic local increments) — the load "
+        "signal least-backlog prefill admission routes on",
+        ("server",),
+    ),
+    MetricSpec(
+        "areal_gserver_prefill_sheds_total",
+        "counter",
+        "New requests shed to unified-style serving on their decode "
+        "owner because every prefill server's backlog-per-chip "
+        "exceeded prefill_saturation_tokens_per_chip",
     ),
     MetricSpec(
         "areal_gserver_weight_update_pause_seconds",
@@ -721,7 +760,22 @@ TRACE_TABLE = [
         "event",
         "Handoff unit imported (scattered into fresh pool blocks and "
         "parked for resume) or rejected fail-closed (attrs: ok, reason "
-        "on reject, row, blocks, bytes, version)",
+        "on reject, row, blocks, bytes, version; streamed=True when the "
+        "final segment of a streamed handoff parked the row)",
+    ),
+    TraceSpec(
+        "engine.handoff_segment",
+        "event",
+        "One streamed-handoff segment exported at a fill-chunk boundary "
+        "(attrs: seq, blocks, bytes, final, version; abort=True with a "
+        "reason when the exporter cut the stream short)",
+    ),
+    TraceSpec(
+        "engine.handoff_segment_import",
+        "event",
+        "One streamed-handoff segment scattered into the decode "
+        "server's pre-allocated blocks (attrs: seq, blocks, bytes, "
+        "final, version)",
     ),
     TraceSpec(
         "engine.finish",

@@ -15,8 +15,10 @@ from __future__ import annotations
 import pickle
 import threading
 import time
+from collections import deque
 from typing import Dict, Optional, Tuple
 
+import numpy as np
 import zmq
 
 from areal_tpu.api import dataset_api, system_api
@@ -189,6 +191,7 @@ class GenerationServerWorker(worker_base.Worker):
             ),
             slo_tracking=getattr(config, "slo_tracking", True),
             server_name=config.worker_name,
+            handoff_streaming=getattr(config, "handoff_streaming", True),
         )
 
         self._ctx = zmq.Context.instance()
@@ -274,6 +277,22 @@ class GenerationServerWorker(worker_base.Worker):
         self._handoff_pool = None
         self._handoff_futs: Dict[str, object] = {}
         self._handoff_out: Dict[str, object] = {}
+        # STREAMED handoff (handoff_streaming, default on): the engine
+        # queues numbered export segments as fill chunks complete; the
+        # worker pushes them per-stream IN ORDER (one in-flight push per
+        # qid, next submitted when the previous lands) over the
+        # import_handoff_segment RPC while later chunks still fill.  The
+        # client reply for a handoff-flagged request is gated on its
+        # FINAL segment settling, so the continuation always finds the
+        # row parked on the decode server.  A failed/rejected push marks
+        # the stream dead (remaining segments dropped — the decode
+        # side's TTL sweep releases its partial blocks; the continuation
+        # re-prefills there).
+        self._handoff_streaming = bool(
+            getattr(config, "handoff_streaming", True)
+        )
+        self._segment_reply_idents = []  # clients awaiting segment import
+        self._stream_push: Dict[str, Dict] = {}
         # in-flight staged weight restore (update_weights mode="stage"):
         # a background thread restores the snapshot into a device-resident
         # staging tree while decode continues; the RPC reply is deferred
@@ -357,6 +376,15 @@ class GenerationServerWorker(worker_base.Worker):
             ),
             "handoff_seconds": reg.counter(
                 "areal_inference_handoff_seconds_total"
+            ),
+            "handoff_segment_exports": reg.counter(
+                "areal_inference_handoff_segment_exports_total"
+            ),
+            "handoff_segment_imports": reg.counter(
+                "areal_inference_handoff_segment_imports_total"
+            ),
+            "handoff_segment_aborts": reg.counter(
+                "areal_inference_handoff_segment_aborts_total"
             ),
             "swap_stage": reg.counter(
                 "areal_inference_swap_stage_seconds_total"
@@ -468,6 +496,15 @@ class GenerationServerWorker(worker_base.Worker):
             "handoff_imports": float(hstats["imports_total"]),
             "handoff_bytes": float(hstats["bytes_total"]),
             "handoff_seconds": float(hstats["seconds_total"]),
+            "handoff_segment_exports": float(
+                hstats["segment_exports_total"]
+            ),
+            "handoff_segment_imports": float(
+                hstats["segment_imports_total"]
+            ),
+            "handoff_segment_aborts": float(
+                hstats["segment_aborts_total"]
+            ),
             "swap_stage": eng.swap_stage_s,
             "swap_pause": eng.swap_pause_s,
             "swaps": float(eng.swaps_total),
@@ -537,6 +574,13 @@ class GenerationServerWorker(worker_base.Worker):
                     # state-mutating (a pool scatter): rides the lockstep
                     # batch like generate/update; reply after the apply
                     self._import_reply_idents.append(ident)
+                    batch.append((cmd, payload))
+                    continue
+                elif cmd == "import_handoff_segment":
+                    # one segment of a streamed handoff: state-mutating
+                    # (seg-0 block allocation + an async pool scatter),
+                    # so it rides the lockstep batch too
+                    self._segment_reply_idents.append(ident)
                     batch.append((cmd, payload))
                     continue
                 elif cmd == "update_weights":
@@ -617,6 +661,20 @@ class GenerationServerWorker(worker_base.Worker):
                     self._sock.send_multipart(
                         [ident, b"", pickle.dumps(resp)]
                     )
+            elif cmd == "import_handoff_segment":
+                try:
+                    ok, reason = self.engine.import_handoff_segment(
+                        payload["segment"]
+                    )
+                    resp = {"imported": ok, "reason": reason}
+                except Exception as e:  # noqa: BLE001 - peer re-prefills
+                    self.logger.exception("handoff segment import failed")
+                    resp = {"error": repr(e)}
+                if self._is_leader and self._segment_reply_idents:
+                    ident = self._segment_reply_idents.pop(0)
+                    self._sock.send_multipart(
+                        [ident, b"", pickle.dumps(resp)]
+                    )
             elif cmd == "pause":
                 self.engine.pause()
             elif cmd == "resume":
@@ -638,10 +696,22 @@ class GenerationServerWorker(worker_base.Worker):
         for qid in list(self._waiting):
             if qid in self._handoff_futs:
                 continue  # reply deferred until the push settles
+            st = self._stream_push.get(qid)
+            if st is not None and st.get("gate"):
+                # streamed handoff: the final segment is queued or in
+                # flight — the reply waits until it settles (success or
+                # failure) so the continuation's schedule can't race
+                # the decode-side park
+                continue
             out = self.engine.try_get_result(qid)
             if out is not None:
                 dest = self._handoff_dest.pop(qid, None)
-                if dest is not None and out.no_eos and out.output_ids:
+                if (
+                    dest is not None
+                    and not self._handoff_streaming
+                    and out.no_eos
+                    and out.output_ids
+                ):
                     # the handoff COMPLETES before the client reply: the
                     # continuation the client schedules next must find
                     # the imported row already parked on the decode
@@ -696,15 +766,113 @@ class GenerationServerWorker(worker_base.Worker):
                     "re-prefills", qid, dest, e,
                 )
 
+        self._handoff_out[qid] = out
+        self._handoff_futs[qid] = self._pool().submit(push)
+        return True
+
+    # -- streamed handoff: ordered per-stream segment pushes -----------------
+
+    def _pool(self):
         if self._handoff_pool is None:
             import concurrent.futures as cf
 
             self._handoff_pool = cf.ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="kv-handoff"
             )
-        self._handoff_out[qid] = out
-        self._handoff_futs[qid] = self._handoff_pool.submit(push)
-        return True
+        return self._handoff_pool
+
+    def _submit_segment_push(self, qid: str, st: Dict, seg: Dict):
+        """Push ONE segment to the decode peer on the handoff pool.
+        The payload's device arrays are materialized on the push thread
+        (``jax.device_get``), so the engine thread never blocks on the
+        copy-out — the gather it dispatched rides under later fill and
+        decode chunks.  Returns the future (resolves to bool ok)."""
+        dest = seg.get("dest") or st["dest"]
+        if dest not in self._peer_clients:
+            self._peer_clients[dest] = GenServerClient(
+                dest, timeout=self.config.handoff_request_timeout
+            )
+        client = self._peer_clients[dest]
+
+        def push() -> bool:
+            try:
+                import jax
+
+                wire = dict(seg)
+                wire.pop("dest", None)
+                payload = wire.get("payload")
+                if payload:
+                    wire["payload"] = tuple(
+                        np.asarray(a) for a in jax.device_get(payload)
+                    )
+                resp = client.call(
+                    "import_handoff_segment",
+                    {"segment": wire},
+                    timeout=self.config.handoff_request_timeout,
+                )
+                if isinstance(resp, dict) and resp.get("imported"):
+                    return True
+                self.logger.warning(
+                    "handoff segment %s/%s rejected by %s (%s); the "
+                    "decode server re-prefills",
+                    qid, seg.get("seq"), dest,
+                    (resp or {}).get("reason")
+                    if isinstance(resp, dict)
+                    else resp,
+                )
+            except Exception as e:  # noqa: BLE001 - fail closed
+                self.logger.warning(
+                    "handoff segment %s/%s to %s failed (%r); the decode "
+                    "server re-prefills",
+                    qid, seg.get("seq"), dest, e,
+                )
+            return False
+
+        return self._pool().submit(push)
+
+    def _pump_handoff_streams(self):
+        """Each poll: drain the engine's new export segments into their
+        per-stream queues, settle finished pushes, and keep exactly one
+        push in flight per stream (segments must arrive in seq order; a
+        failure drops the stream's remainder — the decode side's TTL
+        sweep releases its partial blocks and the continuation simply
+        re-prefills there)."""
+        for seg in self.engine.drain_handoff_segments():
+            qid = seg["qid"]
+            st = self._stream_push.get(qid)
+            if st is None:
+                st = {
+                    "queue": deque(),
+                    "fut": None,
+                    "failed": False,
+                    "gate": False,
+                    "dest": seg.get("dest"),
+                }
+                self._stream_push[qid] = st
+            if seg.get("final"):
+                st["gate"] = True  # the client reply waits on this one
+            if st["failed"]:
+                continue  # peer dead/rejecting: drop the remainder
+            st["queue"].append(seg)
+        for qid in list(self._stream_push):
+            st = self._stream_push[qid]
+            fut = st["fut"]
+            if fut is not None:
+                if not fut.done():
+                    continue
+                st["fut"] = None
+                if not fut.result():
+                    st["failed"] = True
+                    st["queue"].clear()
+            if st["queue"]:
+                st["fut"] = self._submit_segment_push(
+                    qid, st, st["queue"].popleft()
+                )
+            elif st["fut"] is None:
+                # drained (or failed): drop the record — this releases
+                # the reply gate, and a still-filling stream's next
+                # segment recreates it
+                del self._stream_push[qid]
 
     def _update_weights(self, payload: Dict) -> int:
         """Load new weights (from the trainer's realloc dir) and hot-swap.
@@ -989,7 +1157,11 @@ class GenerationServerWorker(worker_base.Worker):
                 for k, v in self.engine.weight_quant_stats().items()
             },
             # P/D disaggregation: this server's role + KV-handoff volume
+            # + the prefill-token backlog the manager's load-aware
+            # admission routes on (tokens admitted/queued but not yet
+            # filled; falls as fills complete or rows fail/evict)
             "role": self._role,
+            "prefill_backlog_tokens": self.engine.prefill_backlog_tokens(),
             **{
                 f"handoff_{k}": v
                 for k, v in self.engine.handoff_stats().items()
@@ -1026,6 +1198,10 @@ class GenerationServerWorker(worker_base.Worker):
                 self._ctrl_pub.send(pickle.dumps((self._ctrl_seq, batch)))
             self._apply_commands(batch)
             n = self.engine.step()
+            # streamed handoff: new export segments must enter their
+            # queues (and gate their replies) BEFORE _reply_finished
+            # looks at this step's results
+            self._pump_handoff_streams()
             self._reply_finished()
             self._reply_staged()
             self._export_engine_metrics()

@@ -129,6 +129,19 @@ class GserverManager(worker_base.Worker):
         #: reference prompt dedup fire once per group)
         self._group_prefill: Dict[str, str] = {}
         self._pd_rr = 0
+        # load-aware prefill admission: last-scraped prefill-token
+        # backlog per prefill server (metrics RPC), plus optimistic
+        # local increments since the scrape so a burst between scrapes
+        # still spreads instead of piling onto one server.  The scrape
+        # REPLACES the estimate (it already includes whatever the local
+        # adds routed there that is still in flight).
+        self._prefill_backlog: Dict[str, float] = {
+            a: 0.0 for a in self._prefill_addrs
+        }
+        self._prefill_backlog_local: Dict[str, float] = {
+            a: 0.0 for a in self._prefill_addrs
+        }
+        self._prefill_backlog_ts = 0.0
         self._clients = {a: GenServerClient(a) for a in self.server_addrs}
 
         # rollout accounting (reference: monitor.RolloutStat threading
@@ -206,6 +219,16 @@ class GserverManager(worker_base.Worker):
         self._m_pd_routes = reg.counter(
             "areal_gserver_pd_handoff_routes_total"
         )
+        # load-aware prefill admission: the backlog estimate each pick
+        # routes on, and requests shed to unified-style serving on
+        # their decode owner because the whole prefill pool was
+        # saturated
+        self._m_prefill_backlog = reg.gauge(
+            "areal_gserver_prefill_backlog_tokens"
+        )
+        self._m_prefill_sheds = reg.counter(
+            "areal_gserver_prefill_sheds_total"
+        )
         self._m_update_pause = reg.gauge(
             "areal_gserver_weight_update_pause_seconds"
         )
@@ -243,6 +266,13 @@ class GserverManager(worker_base.Worker):
             self._m_pd_roles.set(
                 sum(1 for r in roles.values() if r == role), role=role
             )
+        self._ensure_backlog_state()
+        for addr in getattr(self, "_prefill_addrs", ()):
+            self._m_prefill_backlog.set(
+                self._prefill_backlog.get(addr, 0.0)
+                + self._prefill_backlog_local.get(addr, 0.0),
+                server=addr,
+            )
 
     # -- scheduling / staleness --------------------------------------------
 
@@ -270,22 +300,132 @@ class GserverManager(worker_base.Worker):
             return self._decode_addrs
         return self.server_addrs
 
-    def _pick_prefill(self, group: str) -> str:
-        """Prefill-stage pick: group-affine (every member of a rollout
-        shares one prompt, and colocating their fills fires the engine's
-        block-reference prompt dedup once per group), else a chip-
-        weighted rotation — prefill residency is transient (fill ->
-        handoff -> gone), so there is no resident-token signal to
-        balance on and the rotation keeps every prefill mesh fed."""
+    def _ensure_backlog_state(self):
+        """Lazy-init the backlog maps (hand-built managers — dryrun,
+        unit tests — construct around ``_configure``)."""
+        if not hasattr(self, "_prefill_backlog"):
+            self._prefill_backlog = {}
+            self._prefill_backlog_local = {}
+            self._prefill_backlog_ts = 0.0
+
+    def _refresh_prefill_backlog(self):
+        """Keep the prefill-backlog estimates fresh WITHOUT ever
+        blocking the scheduling path: at most every
+        ``prefill_backlog_refresh_s`` one background scrape of every
+        prefill server's ``prefill_backlog_tokens`` (metrics RPC) is
+        submitted to the update thread pool, and a FINISHED scrape's
+        results are applied on the next call — ``_pick_prefill`` and
+        ``_poll`` only ever harvest/submit, never wait.  A successful
+        scrape REPLACES that server's estimate and zeroes its local
+        increments; a failed or malformed scrape (dead server, an
+        ``{"error": ...}`` reply, an older server without the key)
+        returns None and keeps the last estimate plus local adds, so a
+        broken prefill server never reads as idle."""
+        self._ensure_backlog_state()
+        if not getattr(self, "_prefill_addrs", None) or not getattr(
+            self, "_clients", None
+        ):
+            return
+        fut = getattr(self, "_backlog_fut", None)
+        if fut is not None:
+            if not fut.done():
+                return  # one scrape in flight at a time
+            self._backlog_fut = None
+            for addr, backlog in fut.result().items():
+                if backlog is not None:
+                    self._prefill_backlog[addr] = backlog
+                    self._prefill_backlog_local[addr] = 0.0
+        now = time.monotonic()
+        if now - self._prefill_backlog_ts < max(
+            0.05, getattr(self.config, "prefill_backlog_refresh_s", 0.5)
+        ):
+            return
+        self._prefill_backlog_ts = now
+
+        def _scrape_one(addr):
+            try:
+                m = self._clients[addr].call("metrics", {}, timeout=5.0)
+                v = (
+                    m.get("prefill_backlog_tokens")
+                    if isinstance(m, dict)
+                    else None
+                )
+                if v is None:
+                    self.logger.warning(
+                        "prefill backlog scrape on %s returned no "
+                        "prefill_backlog_tokens (old server?); keeping "
+                        "the last estimate", addr,
+                    )
+                    return None
+                return float(v)
+            except Exception as e:  # noqa: BLE001 - keep last estimate
+                self.logger.warning(
+                    "prefill backlog scrape failed on %s: %r", addr, e
+                )
+                return None
+
+        def _scrape_all(addrs):
+            return {a: _scrape_one(a) for a in addrs}
+
+        import concurrent.futures as cf
+
+        if getattr(self, "_update_pool", None) is None:
+            self._update_pool = cf.ThreadPoolExecutor(
+                max_workers=min(32, max(1, len(self._clients))),
+                thread_name_prefix="weight-update",
+            )
+        self._backlog_fut = self._update_pool.submit(
+            _scrape_all, list(self._prefill_addrs)
+        )
+
+    def _prefill_backlog_per_chip(self, addr: str) -> float:
+        self._ensure_backlog_state()
+        return (
+            self._prefill_backlog.get(addr, 0.0)
+            + self._prefill_backlog_local.get(addr, 0.0)
+        ) / self._devices(addr)
+
+    def _pick_prefill(self, group: str, prompt_len: int = 0) -> Optional[str]:
+        """Prefill-stage pick — LOAD-AWARE admission over the prefill
+        pool.  Group-affine first (every member of a rollout shares one
+        prompt, and colocating their fills fires the engine's block-
+        reference prompt dedup once per group); otherwise the server
+        with the LEAST prefill-token backlog per chip (scraped through
+        the metrics RPC + optimistic local increments, so a burst
+        between scrapes still spreads).  Returns None — SHED — when
+        every prefill server's backlog-per-chip exceeds
+        ``prefill_saturation_tokens_per_chip``: the caller routes the
+        request straight to its decode owner, which serves it
+        unified-style (admission pressure never queues unboundedly on a
+        saturated prefill pool).  ``prefill_load_aware=False`` restores
+        the PR-13 chip-weighted rotation (load-blind, never sheds)."""
         cand = self._group_prefill.get(group)
         if cand is not None:
             return cand
-        wpool = [
-            a for a in self._prefill_addrs
-            for _ in range(self._devices(a))
-        ]
-        addr = wpool[self._pd_rr % len(wpool)]
-        self._pd_rr += 1
+        if not getattr(self.config, "prefill_load_aware", True):
+            wpool = [
+                a for a in self._prefill_addrs
+                for _ in range(self._devices(a))
+            ]
+            addr = wpool[self._pd_rr % len(wpool)]
+            self._pd_rr += 1
+            self._group_prefill[group] = addr
+            return addr
+        self._refresh_prefill_backlog()
+        sat = getattr(
+            self.config, "prefill_saturation_tokens_per_chip", 0
+        )
+        # deterministic argmin: ties break on address order
+        addr = min(
+            sorted(self._prefill_addrs),
+            key=self._prefill_backlog_per_chip,
+        )
+        if sat > 0 and self._prefill_backlog_per_chip(addr) > sat:
+            self._m_prefill_sheds.inc()
+            return None
+        self._prefill_backlog_local[addr] = (
+            self._prefill_backlog_local.get(addr, 0.0) + float(prompt_len)
+        )
         self._group_prefill[group] = addr
         return addr
 
@@ -296,14 +436,20 @@ class GserverManager(worker_base.Worker):
         server's url, as ever.  Two-stage P/D fleets: a NEW request is
         routed to a prefill server with ``handoff_to`` naming the decode
         server that owns it — the prefill server fills the row's blocks,
-        hands the KV off, and every later continuation sticky-routes
-        straight to the decode server."""
+        streams the KV off, and every later continuation sticky-routes
+        straight to the decode server.  A saturated prefill pool SHEDS
+        the request instead: it serves unified-style on its decode
+        owner (``pd_shed`` marks the response)."""
         sticky = qid in self._qid_server  # before _schedule registers it
         addr = self._schedule(qid, prompt_len, new_token_budget)
         resp = {"url": addr, "version": self._model_version}
         if getattr(self, "_pd_enabled", False) and not sticky:
-            prefill = self._pick_prefill(self._group_key(qid))
-            if prefill != addr:
+            prefill = self._pick_prefill(
+                self._group_key(qid), prompt_len=prompt_len
+            )
+            if prefill is None:
+                resp["pd_shed"] = True
+            elif prefill != addr:
                 resp["url"] = prefill
                 resp["handoff_to"] = addr
                 self._m_pd_routes.inc()
@@ -795,6 +941,7 @@ class GserverManager(worker_base.Worker):
                     )
                     resp = "ok"
                 elif cmd == "get_status":
+                    self._ensure_backlog_state()
                     resp = {
                         "version": self._model_version,
                         "n_running_rollouts": self.rollout_stat.running,
@@ -812,6 +959,11 @@ class GserverManager(worker_base.Worker):
                             getattr(self, "_server_role", {})
                         ),
                         "pd_enabled": getattr(self, "_pd_enabled", False),
+                        "prefill_backlog_tokens": {
+                            a: self._prefill_backlog.get(a, 0.0)
+                            + self._prefill_backlog_local.get(a, 0.0)
+                            for a in getattr(self, "_prefill_addrs", ())
+                        },
                     }
                 else:
                     resp = {"error": f"unknown command {cmd}"}
@@ -822,6 +974,9 @@ class GserverManager(worker_base.Worker):
 
     def _poll(self) -> worker_base.PollResult:
         self._serve()
+        # harvest/kick the background prefill-backlog scrape even when
+        # no schedule traffic arrives (never blocks — see the method)
+        self._refresh_prefill_backlog()
         if time.monotonic() - self._last_version_check > 0.5:
             self._last_version_check = time.monotonic()
             info = self._check_new_params()

@@ -126,10 +126,18 @@ class PartialRolloutManager:
                 }
                 if sched.get("handoff_to"):
                     # two-stage P/D routing: this chunk runs on a
-                    # prefill server which hands the KV to the named
-                    # decode server; the next chunk's schedule sticky-
-                    # routes there and resumes prefill-free
+                    # prefill server which streams the KV to the named
+                    # decode server segment by segment; the next
+                    # chunk's schedule sticky-routes there and resumes
+                    # prefill-free
                     metadata["handoff_to"] = sched["handoff_to"]
+                elif sched.get("pd_shed"):
+                    # saturated prefill pool: the manager shed this
+                    # request to its decode owner, which serves it
+                    # unified-style (prefill + decode in one place) —
+                    # carried in metadata so latency attribution can
+                    # separate shed TTFT from two-stage TTFT
+                    metadata["pd_shed"] = True
                 inp = model_api.APIGenerateInput(
                     qid=gen_qid,
                     prompt_ids=prompt_ids,

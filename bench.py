@@ -1599,33 +1599,41 @@ def bench_pd_disagg_ab(
     page=64,
     chunk=8,
     prefill_chunk=128,
+    arms=("unified", "disagg", "disagg_streamed"),
+    prefill_mesh=None,
 ):
-    """Disaggregated prefill/decode A/B under MIXED load (ROADMAP item 2).
+    """Disaggregated prefill/decode A/B under MIXED load (ROADMAP item 2)
+    + the streamed-vs-monolithic handoff A/B (ISSUE 15).
 
     Workload: ``n_interactive`` chat sessions decoding short turns (the
     latency-sensitive stream) while a concurrent wave of ``n_wave``
     long-prompt requests prefills (the throughput batch that, on a
-    unified fleet, steals a fill chunk out of every decode step).  Both
+    unified fleet, steals a fill chunk out of every decode step).  All
     arms get the SAME two engines' worth of hardware:
 
     * **unified** — two unified engines, sessions and wave spread across
       both; every engine interleaves wave fill chunks with interactive
       decode, so interactive TTFT absorbs the wave.
-    * **disagg** — one prefill engine + one decode engine: new requests
-      prefill on P (first token sampled there), the row's paged KV
-      blocks ride a handoff unit into D (export_handoff ->
-      import_handoff, the worker-RPC path's engine halves), and every
-      continuation decodes on D — which never runs a single wave fill.
+    * **disagg** — one prefill engine + one decode engine with the
+      PR-13 MONOLITHIC handoff: the whole unit (gather + wire + scatter
+      of every block) moves serially AFTER prefill completes.
+    * **disagg_streamed** — same split, but each fill chunk's finalized
+      blocks stream into D as numbered segments WHILE the rest of the
+      prompt still fills (import_handoff_segment's engine half), so at
+      prefill-done only the final tail+metadata segment remains.
 
     Reported per (arm, workload): fleet-merged TTFT/TPOT p50/p99 from
     per-request LatencyRecords folded into the SLO plane's
-    ``LatencyDigest`` (the same fixed-bucket digests the master merges),
-    plus handoff count/bytes/latency and greedy stream parity
-    unified-vs-disagg as DATA.  The acceptance bar — interactive p99
-    TTFT strictly better disaggregated — is asserted as a CPU smoke in
-    tests/system/test_pd_disagg.py and recorded here for the TPU run.
-    Setup turns (session establishment before the wave) are drained
-    from the digests so the numbers cover only the contended window.
+    ``LatencyDigest``, handoff count/bytes/latency, greedy stream parity
+    across ALL arms as DATA, and the headline ``stream_ab`` row: the
+    RESUME GAP (prefill-done -> decode-resume, measured on the
+    long-prompt wave) monolithic vs streamed, with the >=2x-reduction
+    and p99-TTFT-no-worse verdicts the acceptance bar names.  Asserted
+    as a CPU smoke in tests/system/test_pd_disagg.py.
+
+    ``prefill_mesh`` runs the PREFILL engine on a device mesh (the
+    heterogeneous big-mesh-prefill / small-mesh-decode deployment) —
+    the hetero sub-arm's driver (see :func:`bench_pd_disagg_hetero`).
     """
     import zlib
 
@@ -1638,12 +1646,13 @@ def bench_pd_disagg_ab(
 
     total_interactive = interactive_new * (1 + turns)
 
-    def mk(name):
+    def mk(name, streaming=False, mesh=None):
         eng = make_engine(
             cfg, params, n_interactive + n_wave, wave_prompt,
             total_interactive, chunk=chunk, cache_mode="paged",
             page_size=page, prefill_chunk_tokens=prefill_chunk,
             sampling=SamplingParams(greedy=True), server_name=name,
+            handoff_streaming=streaming, mesh=mesh,
         )
         # sessions park through the whole wave phase; the default TTL
         # (512 steps) could evict a quiet session mid-measurement
@@ -1675,22 +1684,37 @@ def bench_pd_disagg_ab(
         for i in range(n_wave)
     ]
 
-    def run_arm(disagg):
+    def run_arm(mode):
         """Chunked-generation driver over two interleaved engines —
         each request behaves like a partial_rollout client: submit a
-        chunk, collect, submit the continuation (disagg: first chunk on
-        P with the handoff flag, the driver moving the unit P->D when
-        the prefill result lands, exactly what the generation-server
-        worker does before its client reply)."""
+        chunk, collect, submit the continuation.  Disagg arms put the
+        first chunk on P with the handoff flag; the driver moves the KV
+        P->D exactly like the generation-server worker (monolithic:
+        whole unit when the prefill result lands; streamed: segments
+        pumped into D as P's fill chunks emit them, final segment at
+        the result)."""
+        disagg = mode != "unified"
+        streamed = mode == "disagg_streamed"
         if disagg:
-            P, D = mk("pd-P"), mk("pd-D")
+            P = mk("pd-P", streaming=streamed, mesh=prefill_mesh)
+            D = mk("pd-D")
             engines = [P, D]
         else:
             engines = [mk("uni-0"), mk("uni-1")]
         handoff_ms = []
+        resume_gap_ms = []
         handoff_fail = [0]
+        seg_fail = [0]
 
         recs = {}
+
+        def pump_segments():
+            if not streamed:
+                return
+            for seg in P.drain_handoff_segments():
+                ok, _ = D.import_handoff_segment(seg)
+                if not ok and not seg.get("abort"):
+                    seg_fail[0] += 1
 
         def start(qid, ids, total, per, workload, uni_idx):
             recs[qid] = dict(
@@ -1712,6 +1736,54 @@ def bench_pd_disagg_ab(
                 eng.submit(req(qid, r["ids"], mn, r["workload"]))
             r["cur"], r["waiting"] = eng, True
 
+        def fold_chunk(r, out):
+            r["stream"].extend(out.output_ids)
+            r["ids"].extend(out.output_ids)
+            r["left"] -= len(out.output_ids)
+            r["done"] = (
+                r["left"] <= 0
+                or not out.output_ids
+                or not out.no_eos
+            )
+
+        def finish_handoff(qid, r, out):
+            """Prefill-stage result landed: move the REMAINING KV and
+            time prefill-done -> decode-resume (the resume gap).  The
+            monolithic arm pays gather + import of EVERY block here;
+            the streamed arm only drains the final segment (everything
+            else already scattered under D's decode chunks)."""
+            t0 = time.perf_counter()
+            if streamed:
+                pump_segments()  # the final (tail + metadata) segment
+            else:
+                unit = P.export_handoff(qid)
+                ok = False
+                if unit is not None:
+                    ok, _ = D.import_handoff(unit)
+                if not ok:
+                    handoff_fail[0] += 1
+            r["first"] = False
+            fold_chunk(r, out)
+            if r["done"]:
+                handoff_ms.append((time.perf_counter() - t0) * 1e3)
+                return
+            submit_next(r, qid)
+            # step D until the continuation is RESUMED (decoding, not
+            # filling): the wall clock from prefill-done to here is the
+            # bubble streaming exists to shrink
+            for _ in range(50_000):
+                if any(
+                    row is not None and row.req.qid == qid
+                    and not row.parked and not row.filling
+                    for row in D.rows
+                ):
+                    break
+                D.step()
+            dt = (time.perf_counter() - t0) * 1e3
+            handoff_ms.append(dt)
+            if qid.startswith("pdw"):
+                resume_gap_ms.append(dt)
+
         def pump(max_steps=200_000):
             for _ in range(max_steps):
                 live = False
@@ -1719,6 +1791,9 @@ def bench_pd_disagg_ab(
                     if eng.has_work:
                         eng.step()
                         live = True
+                # streamed: export segments ride into D while P's later
+                # fill chunks are still running — THE overlap
+                pump_segments()
                 for qid, r in recs.items():
                     if not r["waiting"]:
                         continue
@@ -1727,27 +1802,12 @@ def bench_pd_disagg_ab(
                         continue
                     r["waiting"] = False
                     if disagg and r["first"] and out.output_ids:
-                        t0 = time.perf_counter()
-                        unit = P.export_handoff(qid)
-                        ok = False
-                        if unit is not None:
-                            ok, _ = D.import_handoff(unit)
-                        handoff_ms.append(
-                            (time.perf_counter() - t0) * 1e3
-                        )
-                        if not ok:
-                            handoff_fail[0] += 1
+                        finish_handoff(qid, r, out)
+                        live = True
+                        continue
                     r["first"] = False
-                    r["stream"].extend(out.output_ids)
-                    r["ids"].extend(out.output_ids)
-                    r["left"] -= len(out.output_ids)
-                    if (
-                        r["left"] <= 0
-                        or not out.output_ids
-                        or not out.no_eos
-                    ):
-                        r["done"] = True
-                    else:
+                    fold_chunk(r, out)
+                    if not r["done"]:
                         submit_next(r, qid)
                         live = True
                 if not live and all(
@@ -1815,14 +1875,27 @@ def bench_pd_disagg_ab(
             out["handoff"] = {
                 "count": hs[1]["imports_total"],
                 "exports": hs[0]["exports_total"],
-                "failed": handoff_fail[0],
+                "segments": hs[0]["segment_exports_total"],
+                "segment_imports": hs[1]["segment_imports_total"],
+                "failed": handoff_fail[0] + seg_fail[0],
                 "bytes_total": hs[0]["bytes_total"],
                 "mean_ms": round(float(np.mean(handoff_ms)), 2)
                 if handoff_ms else None,
                 "max_ms": round(float(np.max(handoff_ms)), 2)
                 if handoff_ms else None,
+                "resume_gap_wave_ms": {
+                    "n": len(resume_gap_ms),
+                    "mean": round(float(np.mean(resume_gap_ms)), 3)
+                    if resume_gap_ms else None,
+                    "max": round(float(np.max(resume_gap_ms)), 3)
+                    if resume_gap_ms else None,
+                },
                 "import_rejects": hs[1]["import_rejects"],
             }
+            if prefill_mesh is not None:
+                out["prefill_mesh_devices"] = int(
+                    prefill_mesh.devices.size
+                )
         streams = {qid: list(r["stream"]) for qid, r in recs.items()}
         engines.clear()
         return out, streams
@@ -1833,23 +1906,169 @@ def bench_pd_disagg_ab(
 
     out: Dict[str, object] = {}
     streams = {}
-    for arm, disagg in (("unified", False), ("disagg", True)):
+    for arm in arms:
         try:
-            out[arm], streams[arm] = run_arm(disagg)
+            out[arm], streams[arm] = run_arm(arm)
         except Exception as e:  # noqa: BLE001 - dropped sub-arm is data
             import traceback
 
             traceback.print_exc()
             out[arm] = {"error": f"{type(e).__name__}: {e}"[:300]}
-    if all(isinstance(out.get(a), dict) and "error" not in out[a]
-           for a in ("unified", "disagg")):
-        out["parity_ok"] = streams["unified"] == streams["disagg"]
-        u = out["unified"].get("interactive", {}).get("ttft_p99_ms")
-        d = out["disagg"].get("interactive", {}).get("ttft_p99_ms")
-        out["interactive_ttft_p99_improved"] = (
-            u is not None and d is not None and d < u
+
+    def _ok(a):
+        return isinstance(out.get(a), dict) and "error" not in out[a]
+
+    good = [a for a in arms if _ok(a)]
+    if "unified" in good and len(good) > 1:
+        out["parity_ok"] = all(
+            streams[a] == streams["unified"] for a in good
+            if a != "unified"
         )
+        u = out["unified"].get("interactive", {}).get("ttft_p99_ms")
+        best = out[good[1]].get("interactive", {}).get("ttft_p99_ms")
+        out["interactive_ttft_p99_improved"] = (
+            u is not None and best is not None and best < u
+        )
+    if _ok("disagg") and _ok("disagg_streamed"):
+        # the streamed-vs-monolithic headline: resume gap on the wave
+        # (>=2x bar) + interactive p99 TTFT no worse than monolithic
+        # (1.2x slack: both are wall-clock over few records, and the
+        # streamed path must merely not regress)
+        mono = out["disagg"]["handoff"]["resume_gap_wave_ms"]["mean"]
+        strm = out["disagg_streamed"]["handoff"]["resume_gap_wave_ms"][
+            "mean"
+        ]
+        mono_p99 = out["disagg"].get("interactive", {}).get("ttft_p99_ms")
+        strm_p99 = out["disagg_streamed"].get("interactive", {}).get(
+            "ttft_p99_ms"
+        )
+        out["stream_ab"] = {
+            "resume_gap_mono_ms": mono,
+            "resume_gap_streamed_ms": strm,
+            "resume_gap_ratio": (
+                round(mono / strm, 2)
+                if mono is not None and strm not in (None, 0)
+                else None
+            ),
+            "resume_gap_improved_2x": (
+                mono is not None
+                and strm not in (None, 0)
+                and mono / strm >= 2.0
+            ),
+            "mono_interactive_ttft_p99_ms": mono_p99,
+            "streamed_interactive_ttft_p99_ms": strm_p99,
+            "streamed_ttft_no_worse": (
+                mono_p99 is not None
+                and strm_p99 is not None
+                and strm_p99 <= 1.2 * mono_p99
+            ),
+        }
     return out
+
+
+def bench_pd_disagg_hetero(
+    n_chips=2, n_sessions=2, interactive_prompt=24, interactive_new=6,
+    n_wave=2, wave_prompt=96, wave_new=3, page=16, chunk=4,
+    prefill_chunk=32,
+):
+    """Heterogeneous-mesh P/D sub-arm (ROADMAP item 2 called it
+    "routable but unmeasured"): a BIG-mesh prefill engine (dense TP over
+    ``n_chips``) streams KV handoffs into a SMALL single-chip decode
+    engine — parity + TTFT rows recorded as data through the same
+    mixed-load driver.  CPU-smoke capable via a child process with a
+    provisioned virtual CPU mesh, like ``sharded_serving``."""
+    import jax
+
+    if len(jax.devices()) >= n_chips:
+        return _pd_hetero_measure(
+            n_chips=n_chips, n_sessions=n_sessions,
+            interactive_prompt=interactive_prompt,
+            interactive_new=interactive_new, n_wave=n_wave,
+            wave_prompt=wave_prompt, wave_new=wave_new, page=page,
+            chunk=chunk, prefill_chunk=prefill_chunk,
+        )
+    import json as _json
+    import subprocess
+    import sys
+
+    args = dict(
+        n_chips=n_chips, n_sessions=n_sessions,
+        interactive_prompt=interactive_prompt,
+        interactive_new=interactive_new, n_wave=n_wave,
+        wave_prompt=wave_prompt, wave_new=wave_new, page=page,
+        chunk=chunk, prefill_chunk=prefill_chunk,
+    )
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_chips}"
+    )
+    env["PYTHONPATH"] = repo_root
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "bench.py"),
+            "--pd-hetero-child",
+            _json.dumps(args),
+        ],
+        env=env,
+        cwd=repo_root,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    lines = [
+        l for l in proc.stdout.strip().splitlines() if l.startswith("{")
+    ]
+    if proc.returncode != 0 or not lines:
+        return {
+            "error": (
+                f"child rc={proc.returncode}: "
+                + (proc.stderr or proc.stdout)[-500:]
+            )
+        }
+    return _json.loads(lines[-1])
+
+
+def _pd_hetero_measure(
+    n_chips=2, n_sessions=2, interactive_prompt=24, interactive_new=6,
+    n_wave=2, wave_prompt=96, wave_new=3, page=16, chunk=4,
+    prefill_chunk=32,
+):
+    """In-process half of the hetero sub-arm: n_chips-TP prefill mesh,
+    single-chip decode, streamed handoff — rides the pd_disagg driver
+    with ``prefill_mesh`` set, unified arm as the parity reference."""
+    import jax
+
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.models import transformer
+
+    dense_cfg, _ = _sharded_serving_cfgs(jax.default_backend() == "tpu")
+    params = transformer.init_params(dense_cfg, jax.random.PRNGKey(0))
+    mesh = MeshSpec(model=n_chips).make_mesh(jax.devices()[:n_chips])
+    res = bench_pd_disagg_ab(
+        dense_cfg, params,
+        n_interactive=n_sessions, interactive_prompt=interactive_prompt,
+        interactive_new=interactive_new, turns=1, n_wave=n_wave,
+        wave_prompt=wave_prompt, wave_new=wave_new, page=page,
+        chunk=chunk, prefill_chunk=prefill_chunk,
+        arms=("unified", "disagg_streamed"), prefill_mesh=mesh,
+    )
+    res["prefill_mesh"] = f"m{n_chips}"
+    res["decode_mesh_devices"] = 1
+    return res
+
+
+def _pd_hetero_child(argv_json: str) -> None:
+    """Child-process entry for the hetero CPU-smoke path: the parent
+    provisioned the virtual CPU mesh via env; measure and print ONE
+    JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(_pd_hetero_measure(**json.loads(argv_json))))
 
 
 def bench_spec_decode_ab(
@@ -3716,6 +3935,14 @@ def main():
             )
         ),
     )
+    # heterogeneous-mesh sub-arm: big-mesh (TP) prefill streaming into a
+    # single-chip decode engine — parity + TTFT rows as data (off-TPU it
+    # runs in a virtual-CPU-mesh child like sharded_serving)
+    if isinstance(pd_disagg_ab, dict):
+        mark("pd disagg hetero sub-arm")
+        pd_disagg_ab["hetero"] = _section(
+            bench_pd_disagg_hetero, name="pd_disagg_hetero",
+        )
 
     # self-speculative decoding A/B: n-gram draft + batched paged verify
     # on vs off, on a repetitive-trace workload (decode tok/s + accepted
@@ -4046,6 +4273,10 @@ if __name__ == "__main__":
     elif "--weight-swap-child" in _sys.argv:
         _weight_swap_child(
             _sys.argv[_sys.argv.index("--weight-swap-child") + 1]
+        )
+    elif "--pd-hetero-child" in _sys.argv:
+        _pd_hetero_child(
+            _sys.argv[_sys.argv.index("--pd-hetero-child") + 1]
         )
     else:
         main()

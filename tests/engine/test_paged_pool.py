@@ -6,6 +6,7 @@ realhf/impl/model/backend/sglang.py:369)."""
 
 import jax
 import numpy as np
+import pytest
 
 from areal_tpu.api.model_api import (
     APIGenerateInput,
@@ -294,3 +295,52 @@ def test_host_block_round_trip_bit_identical_fp():
 
 def test_host_block_round_trip_bit_identical_int8_with_scales():
     _round_trip_pools("int8")
+
+
+@pytest.mark.parametrize("kv_cache_dtype", ["auto", "int8"])
+def test_stacked_restore_matches_per_block_restore(kv_cache_dtype):
+    """restore_blocks_host_stacked (the streamed-handoff segment wire
+    format: ONE coalesced buffer per component) must land bit-identical
+    pool contents to the per-block-tuple restore path on the same
+    payload."""
+    from areal_tpu.models import paged
+
+    cfg = tiny_config(vocab_size=64, max_position_embeddings=512)
+    rng = np.random.default_rng(11)
+    pools = paged.alloc_kv_pool(cfg, 8, 4, kv_cache_dtype=kv_cache_dtype)
+    k_pool, v_pool, k_scale, v_scale = pools
+    filled = []
+    for a in (k_pool, v_pool):
+        if kv_cache_dtype == "int8":
+            filled.append(jax.numpy.asarray(
+                rng.integers(-127, 128, a.shape).astype(np.int8)
+            ))
+        else:
+            filled.append(jax.numpy.asarray(
+                rng.standard_normal(a.shape).astype(np.float32)
+            ).astype(a.dtype))
+    k_pool, v_pool = filled
+    if kv_cache_dtype == "int8":
+        k_scale = jax.numpy.asarray(
+            rng.random(k_scale.shape).astype(np.float32)
+        )
+        v_scale = jax.numpy.asarray(
+            rng.random(v_scale.shape).astype(np.float32)
+        )
+    src, dst = [5, 1, 3], [0, 6, 2]
+    payload = paged.gather_blocks_host(
+        k_pool, v_pool, src, k_scale=k_scale, v_scale=v_scale
+    )
+    fresh_a = paged.alloc_kv_pool(cfg, 8, 4, kv_cache_dtype=kv_cache_dtype)
+    fresh_b = paged.alloc_kv_pool(cfg, 8, 4, kv_cache_dtype=kv_cache_dtype)
+    per_block = [tuple(a[i] for a in payload) for i in range(len(src))]
+    out_a = paged.restore_blocks_from_host(
+        fresh_a[0], fresh_a[1], per_block, dst,
+        k_scale=fresh_a[2], v_scale=fresh_a[3],
+    )
+    out_b = paged.restore_blocks_host_stacked(
+        fresh_b[0], fresh_b[1], payload, dst,
+        k_scale=fresh_b[2], v_scale=fresh_b[3],
+    )
+    for a, b in zip(out_a, out_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -35,6 +35,11 @@ def three_live_workers():
     gsm.gauge("areal_gserver_pd_role_servers").set(1, role="prefill")
     gsm.gauge("areal_gserver_pd_role_servers").set(2, role="decode")
     gsm.counter("areal_gserver_pd_handoff_routes_total").inc(9)
+    # load-aware prefill admission: per-server backlog gauge + sheds
+    gsm.gauge("areal_gserver_prefill_backlog_tokens").set(
+        1536.0, server="10.0.0.1:1"
+    )
+    gsm.counter("areal_gserver_prefill_sheds_total").inc(2)
 
     trainer = MetricsRegistry()
     trainer.histogram("areal_train_step_seconds").observe(1.5, model="actor")
@@ -75,6 +80,10 @@ def three_live_workers():
     gen.counter(
         "areal_inference_handoff_import_rejects_total"
     ).inc(1, reason="version")
+    # streamed handoff: per-segment export/import volume + an abort
+    gen.counter("areal_inference_handoff_segment_exports_total").inc(7)
+    gen.counter("areal_inference_handoff_segment_imports_total").inc(6)
+    gen.counter("areal_inference_handoff_segment_aborts_total").inc(1)
 
     servers = []
     for wname, reg in (
@@ -233,6 +242,40 @@ def test_discovers_and_scrapes_three_live_workers(
             "areal_inference_handoff_import_rejects_total{reason=version}"
         ]
         == 1.0
+    )
+    # streamed-handoff segment counters + the manager's load-aware
+    # admission families survive the scrape cycle too
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_handoff_segment_exports_total"
+        ]
+        == 7.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_handoff_segment_imports_total"
+        ]
+        == 6.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_handoff_segment_aborts_total"
+        ]
+        == 1.0
+    )
+    assert (
+        flat[
+            "cluster/gserver_manager/"
+            "areal_gserver_prefill_backlog_tokens{server=10.0.0.1:1}"
+        ]
+        == 1536.0
+    )
+    assert (
+        flat["cluster/gserver_manager/areal_gserver_prefill_sheds_total"]
+        == 2.0
     )
     # histogram buckets are dropped from the flat view (sum/count kept)
     assert not any("_bucket" in k for k in flat)
