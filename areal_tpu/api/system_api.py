@@ -267,6 +267,24 @@ class GenServerConfig:
     # dead peers all release the partial blocks; the continuation
     # re-prefills).  False = the PR-13 monolithic handoff unit.
     handoff_streaming: bool = True
+    # how KV segments travel between servers (streamed handoffs AND
+    # fleet prefix pulls).  "host-numpy" (the default and the only
+    # backend in this build) materializes segment payloads on host and
+    # ships them over the worker ZMQ RPC; "tpu-d2d" is the reserved
+    # capability token for a device-to-device ICI/DMA backend (a server
+    # registering it today fails at startup — the token exists so the
+    # registration protocol and mixed-fleet negotiation are already
+    # wire-stable).  The manager reads each server's token from its
+    # registration value and only fabric-routes between servers whose
+    # transports match.
+    segment_transport: str = "host-numpy"
+    # fleet KV fabric, pull side: a kv_source schedule hint triggers a
+    # peer prefix pull only when the pull would cover at least this
+    # many tokens beyond the local radix match (an RPC + scatter round
+    # trip costs more than re-prefilling a short suffix).  The
+    # manager's kv_fabric_min_prefix_tokens gates the hint fleet-side;
+    # this is the engine's own floor.
+    prefix_pull_min_tokens: int = 256
     # self-speculative n-gram decoding on the paged path (default off);
     # maps SGLang's ngram speculative mode / vLLM's ngram
     # speculative_config — see SpecDecodeConfig + docs
@@ -367,6 +385,22 @@ class GserverManagerConfig:
     prefill_load_aware: bool = True
     prefill_backlog_refresh_s: float = 0.5
     prefill_saturation_tokens_per_chip: int = 65536
+    # fleet KV fabric (cross-server prefix reuse): the manager's
+    # per-session hot-prefix map doubles as a fleet prefix DIRECTORY —
+    # when a session's request routes to a server other than its
+    # longest-prefix owner, the schedule response carries a kv_source
+    # hint and the routed engine peer-pulls the cached prefix instead
+    # of re-prefilling it.  Directory entries are stamped with the
+    # owner's (model version, cache flush epoch) and invalidated on
+    # weight updates, server cache flushes (reported through the
+    # existing metrics scrape), and server death — the directory never
+    # advertises dropped prefixes.  Hints only pair servers whose
+    # segment transports match.  False = hot-prefix tracking behaves
+    # exactly as before (affinity only, no hints).
+    kv_fabric: bool = True
+    # minimum advertised prefix length (tokens) worth a pull hint — the
+    # fleet-side floor mirroring the engine's prefix_pull_min_tokens
+    kv_fabric_min_prefix_tokens: int = 256
     trace: Optional[TraceConfig] = None
 
 

@@ -407,6 +407,7 @@ class ContinuousBatchingEngine:
         slo_tracking: bool = True,
         server_name: str = "",
         handoff_streaming: bool = False,
+        prefix_pull_min_tokens: int = 256,
     ):
         """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
         shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
@@ -789,6 +790,24 @@ class ContinuousBatchingEngine:
         #: bounds how long a dead peer's half-stream can pin pool space.
         self._handoff_pending: Dict[str, Dict[str, Any]] = {}
         self.handoff_pending_ttl_steps = 512
+        # fleet KV fabric (cross-server prefix pull): puller-side state.
+        # ``_prefix_pulls`` holds one record per pull qid (state machine
+        # requested -> pulling -> done|failed); intents queue in
+        # ``_prefix_pull_requests`` until the worker drains them and
+        # runs the owner's export_prefix RPC.  Pulled segments re-enter
+        # through :meth:`import_prefix_segment` under the SAME
+        # numbered-segment rules as the streamed handoff: per-segment
+        # version checks, the step-keyed TTL sweep, and zero-leak block
+        # release on any reject.  ``prefix_pull_min_tokens`` is the
+        # minimum token gap (advertised prefix beyond the local
+        # resident match) worth an RPC + scatter instead of a local
+        # re-prefill.
+        self.prefix_pull_min_tokens = max(1, int(prefix_pull_min_tokens))
+        self._prefix_pulls: Dict[str, Dict[str, Any]] = {}
+        self._prefix_pull_requests: List[Dict[str, Any]] = []
+        self.prefix_peer_pulls_total = 0
+        self.prefix_peer_pull_bytes_total = 0
+        self.prefix_peer_pull_rejects: Dict[str, int] = {}
         # decode-loop time attribution (cumulative seconds): host = admit/
         # bookkeeping/dispatch-enqueue, device = blocked waiting for chunk
         # compute, fetch = device->host transfer after completion.  The
@@ -1859,6 +1878,305 @@ class ContinuousBatchingEngine:
             "pending_streams": len(self._handoff_pending),
         }
 
+    # -- fleet KV fabric: cross-server prefix pull ---------------------------
+    #
+    # The radix cache above makes cached prefixes a PER-SERVER resource;
+    # the fabric makes them a FLEET one.  When the gserver manager's
+    # schedule response names a peer that owns a longer hot prefix for a
+    # session (``kv_source`` metadata — the manager's directory tracks
+    # per-session longest-prefix owners), the admission registers a pull
+    # intent instead of re-prefilling, and requeues step-keyed.  The
+    # worker runs the owner's export_prefix RPC off-thread and replays
+    # the returned numbered segments through import_prefix_segment as
+    # lockstep commands; the final segment radix-inserts the pulled
+    # blocks, so the requeued admission's next match lands on them and
+    # only the un-pulled suffix prefills.  Every reject — version skew,
+    # geometry, pool pressure, dead owner, TTL — releases the partial
+    # blocks and falls back to a plain re-prefill: the fabric is an
+    # optimization, never a correctness dependency.
+
+    def export_prefix(self, qid: str, tokens: List[int]):
+        """Owner side: the longest cached full-block run covering
+        ``tokens`` as numbered wire segments (numpy payloads in
+        :func:`paged.restore_blocks_host_stacked`'s stacked component
+        format — the streamed-handoff segment format minus the row
+        state).  Device-resident blocks pay ONE batched gather
+        (:func:`paged.gather_blocks_host`); host-spilled blocks ship
+        their spill payloads directly — the spill buffer already IS the
+        wire format.  Returns ``[]`` when nothing exportable is cached
+        (the puller re-prefills)."""
+        if not self.paged or self._prefix_cache is None or len(tokens) < 2:
+            return []
+        entries = self._prefix_cache.export_walk(
+            tokens, step=self._step_seq
+        )
+        if not entries:
+            return []
+        dev_ids = [v for kind, v in entries if kind == "device"]
+        dev = (
+            paged.gather_blocks_host(
+                self.k_pool, self.v_pool, dev_ids,
+                k_scale=self.k_scale, v_scale=self.v_scale,
+            )
+            if dev_ids
+            else None
+        )
+        per_block = []
+        di = 0
+        for kind, v in entries:
+            if kind == "device":
+                per_block.append(tuple(np.asarray(a[di]) for a in dev))
+                di += 1
+            else:
+                per_block.append(v)
+        total = len(per_block)
+        n_tokens = total * self.page_size
+        # segment at fill-chunk granularity — the same unit the
+        # streamed handoff exports, so segment sizes (and the import
+        # side's scatter batches) look identical on the wire
+        seg_blocks = max(1, self.prefill_chunk_tokens // self.page_size)
+        segs = []
+        start = 0
+        while start < total:
+            n = min(seg_blocks, total - start)
+            final = start + n == total
+            seg = {
+                "qid": qid,
+                "seq": len(segs),
+                "block_start": start,
+                "n_blocks": n,
+                "total_blocks": total,
+                "version": self.version,
+                "page_size": self.page_size,
+                "kv_cache_dtype": self.kv_cache_dtype,
+                "final": final,
+                "payload": paged.stack_host_payloads(
+                    per_block[start : start + n]
+                ),
+            }
+            if final:
+                seg["n_tokens"] = n_tokens
+            segs.append(seg)
+            start += n
+        self.tracer.event(
+            qid, "engine.prefix_export",
+            blocks=total, tokens=n_tokens, segments=len(segs),
+            version=self.version,
+        )
+        return segs
+
+    def _reject_prefix_pull(self, qid: str, reason: str) -> Tuple[bool, str]:
+        """Fail ONE pull closed: release any partially-imported blocks
+        (zero-leak — the radix insert never saw them) and mark the
+        record failed so the requeued admission falls back to a plain
+        re-prefill at its next step."""
+        rec = self._prefix_pulls.get(qid)
+        if rec is not None:
+            blocks = rec.get("blocks")
+            if blocks:
+                self._free_block_list(blocks)
+                rec["blocks"] = []
+            rec["state"] = "failed"
+            rec["step"] = self._step_seq
+        self.prefix_pull_rejects_inc(reason)
+        self.tracer.event(
+            qid, "engine.prefix_pull", ok=False, reason=reason
+        )
+        logger.info("prefix pull for %s rejected: %s", qid, reason)
+        return False, reason
+
+    def prefix_pull_rejects_inc(self, reason: str):
+        self.prefix_peer_pull_rejects[reason] = (
+            self.prefix_peer_pull_rejects.get(reason, 0) + 1
+        )
+
+    def prefix_pull_failed(self, qid: str, reason: str = "rpc"):
+        """The worker's pull RPC died or the owner had nothing (a
+        lockstep command, so every controller fails the record at the
+        identical step)."""
+        if qid in self._prefix_pulls:
+            self._reject_prefix_pull(qid, reason)
+
+    def drain_prefix_pull_requests(self) -> List[Dict[str, Any]]:
+        """Pop the queued pull intents (worker poll loop; in-process
+        drivers pump them straight into the owner engine's
+        export_prefix)."""
+        out = self._prefix_pull_requests
+        self._prefix_pull_requests = []
+        for req in out:
+            rec = self._prefix_pulls.get(req["qid"])
+            if rec is not None and rec["state"] == "requested":
+                rec["state"] = "pulling"
+        return out
+
+    def _maybe_pull_prefix(self, req, prompt: List[int]) -> bool:
+        """Admission-side fabric gate: when the schedule response named
+        a peer owning a longer hot prefix (``kv_source`` metadata) and
+        the local radix match is short, register a pull intent and tell
+        the caller to requeue step-keyed (never a readiness probe —
+        SPMD lockstep).  Returns True while the pull is in flight;
+        False once it landed (the next radix walk hits the pulled
+        blocks), failed closed, or was never worth the RPC."""
+        meta = req.metadata or {}
+        source = meta.get("kv_source")
+        if not source or not self.paged or self._prefix_cache is None:
+            return False
+        qid = req.qid
+        rec = self._prefix_pulls.get(qid)
+        if rec is not None:
+            if rec["state"] in ("requested", "pulling"):
+                return True
+            # done or failed: consume the hint so pool churn can never
+            # re-trigger the same pull in a loop
+            del self._prefix_pulls[qid]
+            meta.pop("kv_source", None)
+            return False
+        want = len(prompt) - 1
+        resident = self._match_prefix(prompt).n_tokens
+        if want - resident < max(
+            self.page_size, self.prefix_pull_min_tokens
+        ):
+            meta.pop("kv_source", None)
+            return False
+        self._prefix_pulls[qid] = {
+            "state": "requested",
+            "step": self._step_seq,
+            "source": source,
+            "tokens": list(prompt),
+            "blocks": [],
+            "bytes": 0,
+        }
+        self._prefix_pull_requests.append(
+            {"qid": qid, "source": source, "tokens": list(prompt)}
+        )
+        self.tracer.event(
+            qid, "engine.prefix_pull", source=source,
+            prompt_len=len(prompt), resident=resident,
+        )
+        return True
+
+    def import_prefix_segment(self, seg: Dict[str, Any]) -> Tuple[bool, str]:
+        """Import ONE segment of a fleet prefix pull — the pull-side
+        twin of :meth:`import_handoff_segment`, same fail-closed rules:
+        segment 0 pre-allocates ALL ``total_blocks``; every segment's
+        version must match the current weights; sequence gaps, geometry
+        mismatches, pool exhaustion, and scatter failures release the
+        partial blocks (zero-leak) and the admission re-prefills.  The
+        final segment radix-inserts the pulled prefix — the cache takes
+        its own references and the pull's are dropped, so ownership
+        rules are identical to a locally-computed prefix."""
+        t0 = time.perf_counter()
+        qid = seg.get("qid", "?")
+        if not self.paged:
+            return self._reject_prefix_pull(qid, "dense")
+        rec = self._prefix_pulls.get(qid)
+        if rec is None or rec["state"] not in ("requested", "pulling"):
+            # a late segment for a pull the TTL/weight sweep already
+            # settled: count it, nothing to release
+            return self._reject_prefix_pull(qid, "stream")
+        if (
+            seg.get("page_size") != self.page_size
+            or seg.get("kv_cache_dtype") != self.kv_cache_dtype
+        ):
+            return self._reject_prefix_pull(qid, "layout")
+        if seg.get("version") != self.version:
+            # per-segment version rule: a swap on either side mid-pull
+            # invalidates whatever was already scattered
+            return self._reject_prefix_pull(qid, "version")
+        seq = int(seg.get("seq", -1))
+        payload = seg.get("payload") or ()
+        n = int(seg.get("n_blocks", 0))
+        if seq == 0:
+            if rec.get("blocks"):
+                # one RPC per pull — a duplicate segment 0 is skew
+                return self._reject_prefix_pull(qid, "stream")
+            total = int(seg.get("total_blocks", 0))
+            if not 0 < total <= self.blocks_per_row:
+                return self._reject_prefix_pull(qid, "layout")
+            with self._lock:
+                queued = {r.qid for r in self._pending}
+            blocks = self._alloc_blocks_reclaiming(
+                total, keep_qids=queued
+            )
+            if blocks is None:
+                return self._reject_prefix_pull(qid, "pool")
+            rec.update(
+                blocks=blocks, next_seq=0, received=0,
+                version=seg.get("version"), total=total,
+            )
+        elif (
+            not rec.get("blocks")
+            or rec.get("next_seq") != seq
+            or rec.get("version") != seg.get("version")
+            or rec.get("total") != int(seg.get("total_blocks", -1))
+        ):
+            return self._reject_prefix_pull(qid, "stream")
+        start = int(seg.get("block_start", -1))
+        if start != rec["received"] or start + n > rec["total"]:
+            return self._reject_prefix_pull(qid, "stream")
+        if n:
+            pool_block_shape = (
+                self.k_pool.shape[:1] + self.k_pool.shape[2:]
+            )
+            if (
+                len(payload) != len(self._pool_arrays())
+                or payload[0].shape[0] != n
+                or tuple(payload[0].shape[1:]) != pool_block_shape
+            ):
+                return self._reject_prefix_pull(qid, "layout")
+            try:
+                self._scatter_stacked(
+                    payload, rec["blocks"][start : start + n]
+                )
+            except Exception:  # noqa: BLE001 - free and fail closed
+                logger.exception(
+                    "prefix pull scatter failed for %s", qid
+                )
+                return self._reject_prefix_pull(qid, "scatter")
+        rec["received"] += n
+        rec["next_seq"] = seq + 1
+        rec["step"] = self._step_seq
+        rec["bytes"] += int(sum(a.nbytes for a in payload))
+        if not seg.get("final"):
+            self.handoff_seconds_total += time.perf_counter() - t0
+            return True, ""
+        if rec["received"] != rec["total"]:
+            return self._reject_prefix_pull(qid, "stream")
+        n_tokens = int(
+            seg.get("n_tokens") or rec["total"] * self.page_size
+        )
+        key = list(rec["tokens"][:n_tokens])
+        blocks = rec["blocks"]
+        rec["blocks"] = []
+        # the radix insert takes its OWN references; the pull's are
+        # dropped right after, so the cache is the sole owner — exactly
+        # the ownership a locally-filled prefix ends up with, and the
+        # zero-leak invariant holds even if a raced flush drops the
+        # insert (refs then hit zero and the blocks recycle)
+        self._cache_insert(key, blocks)
+        self._free_block_list(blocks)
+        rec["state"] = "done"
+        rec["step"] = self._step_seq
+        self.prefix_peer_pulls_total += 1
+        self.prefix_peer_pull_bytes_total += rec["bytes"]
+        self.handoff_seconds_total += time.perf_counter() - t0
+        self.tracer.event(
+            qid, "engine.prefix_pull", ok=True,
+            blocks=rec["total"], tokens=len(key), bytes=rec["bytes"],
+            version=self.version,
+        )
+        return True, ""
+
+    def prefix_peer_stats(self) -> Dict[str, Any]:
+        """Cumulative fleet-fabric pull counters (worker scrape +
+        metrics RPC + bench)."""
+        return {
+            "pulls_total": self.prefix_peer_pulls_total,
+            "pull_bytes_total": self.prefix_peer_pull_bytes_total,
+            "pull_rejects": dict(self.prefix_peer_pull_rejects),
+            "pending_pulls": len(self._prefix_pulls),
+        }
+
     # -- client API (any thread) -------------------------------------------
 
     def submit(self, req: model_api.APIGenerateInput) -> str:
@@ -2229,6 +2547,12 @@ class ContinuousBatchingEngine:
                 self._abort_handoff_stream(qid, reason="weight_swap")
             for qid in list(self._handoff_pending):
                 self._release_pending_handoff(qid, reason="version")
+            # in-flight fleet prefix pulls hold (or are about to hold)
+            # old-version KV: fail them closed too — the requeued
+            # admission re-prefills under the new weights
+            for qid, rec in list(self._prefix_pulls.items()):
+                if rec["state"] in ("requested", "pulling"):
+                    self._reject_prefix_pull(qid, "version")
             # chunk-filling rows hold KV computed under the OLD weights:
             # restart their fills from scratch (their rows/blocks stay;
             # a cache-matched fill_pos also resets — its prefix blocks
@@ -2718,6 +3042,15 @@ class ContinuousBatchingEngine:
         for qid, pend in list(self._handoff_pending.items()):
             if self._step_seq - pend["step"] > self.handoff_pending_ttl_steps:
                 self._release_pending_handoff(qid, reason="expired")
+        # same backstop for fleet prefix pulls: a dead owner (or a pull
+        # whose requester was aborted before re-admission) must not pin
+        # blocks or intent records forever
+        for qid, rec in list(self._prefix_pulls.items()):
+            if self._step_seq - rec["step"] > self.handoff_pending_ttl_steps:
+                if rec["state"] in ("requested", "pulling"):
+                    self._reject_prefix_pull(qid, "expired")
+                else:  # settled but never collected by an admission
+                    del self._prefix_pulls[qid]
         free = [i for i, r in enumerate(self.rows) if r is None]
 
         def take_row():
@@ -2782,6 +3115,13 @@ class ContinuousBatchingEngine:
             fill = next(
                 (f for f in self._filling if f.key == key), None
             )
+            if fill is None and self._maybe_pull_prefix(req, prompt):
+                # fleet pull in flight: requeue step-keyed until the
+                # imported prefix lands in the radix cache (or the pull
+                # fails closed and the next pass re-prefills plainly)
+                with self._lock:
+                    self._pending.insert(0, req)
+                break
             rid = take_row()
             if rid is None:
                 with self._lock:

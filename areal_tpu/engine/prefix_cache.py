@@ -343,6 +343,47 @@ class RadixPrefixCache:
         else:
             self.misses_total += 1
 
+    def export_walk(
+        self, tokens: Sequence[int], step: int
+    ) -> List[Tuple[str, Any]]:
+        """Walk the longest cached full-block run covering ``tokens``
+        for a FLEET EXPORT (a peer's prefix pull), returning ordered
+        per-block entries: ``("device", block_id)`` for resident blocks,
+        ``("host", host_kv)`` for spilled ones — the exporter gathers
+        the device run in one batch and ships spill payloads directly
+        (they are already the wire format).  Unlike :meth:`match`, both
+        tiers export in place: no restore round trip, no pinning, no
+        hit/miss stats (the pull is the owner serving a peer, not the
+        owner serving itself).  Stops at the first gap: a missing
+        child, a swap-in still in flight (``ready_step`` in the future
+        — its KV is not host-readable anymore and not device-complete
+        yet), or a spilled node whose payload was trimmed.  Refreshes
+        LRU on the exported path (a fleet-hot prefix should not be the
+        next eviction victim).  Capped at ``len(tokens) - 1`` like
+        every match, so the puller keeps a suffix token to prefill."""
+        BS = self.page_size
+        max_match = len(tokens) - 1
+        node = self._root
+        out: List[Tuple[str, Any]] = []
+        depth = 0
+        while (depth + 1) * BS <= max_match:
+            key = tuple(tokens[depth * BS : (depth + 1) * BS])
+            child = node.children.get(key)
+            if child is None:
+                break
+            if child.spilled:
+                if child.host_kv is None:
+                    break
+                out.append(("host", child.host_kv))
+            elif child.ready_step > step:
+                break
+            else:
+                out.append(("device", child.block))
+            child.last_use = step
+            node = child
+            depth += 1
+        return out
+
     # -- insertion ----------------------------------------------------------
 
     def insert(

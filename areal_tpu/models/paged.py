@@ -743,6 +743,22 @@ def restore_blocks_from_host(
     )
 
 
+def stack_host_payloads(
+    payloads: Sequence[Tuple[np.ndarray, ...]],
+) -> Tuple[np.ndarray, ...]:
+    """Stack per-block payload tuples (each :func:`gather_blocks_host`
+    output indexed ``[i]``, e.g. host-spill entries) into the ONE
+    contiguous buffer per component that
+    :func:`restore_blocks_host_stacked` scatters — the segmented-handoff
+    wire format.  Lets an exporter mix batch-gathered device blocks and
+    already-host spill payloads into one segment."""
+    assert payloads
+    return tuple(
+        np.stack([np.asarray(p[c]) for p in payloads], axis=0)
+        for c in range(len(payloads[0]))
+    )
+
+
 def restore_blocks_host_stacked(
     k_pool: jax.Array,
     v_pool: jax.Array,

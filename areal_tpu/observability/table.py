@@ -292,6 +292,30 @@ METRIC_TABLE = [
         "decode side)",
     ),
     MetricSpec(
+        "areal_inference_prefix_peer_pulls_total",
+        "counter",
+        "Fleet KV-fabric prefix pulls COMPLETED by this engine (a peer's "
+        "cached prefix imported segment by segment and radix-inserted; "
+        "the admission's re-prefill shrank to the un-pulled suffix)",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_peer_pull_bytes_total",
+        "counter",
+        "Host bytes imported by completed fleet prefix pulls (int8 "
+        "pools move quantized bytes + scales)",
+    ),
+    MetricSpec(
+        "areal_inference_prefix_peer_pull_rejects_total",
+        "counter",
+        "Fleet prefix pulls failed closed, by reason (version = weight-"
+        "swap skew mid-pull; layout | dense | pool | scatter | stream "
+        "mirror the handoff-segment rules; miss = the owner no longer "
+        "held the prefix; rpc = the export call to the owner died; "
+        "spmd = a multi-controller owner refused the export; expired = "
+        "the dead-owner TTL) — the admission re-prefills plainly",
+        ("reason",),
+    ),
+    MetricSpec(
         "areal_inference_inflight_rows",
         "gauge",
         "Rows currently decoding or chunk-filling",
@@ -470,6 +494,29 @@ METRIC_TABLE = [
         "New requests shed to unified-style serving on their decode "
         "owner because every prefill server's backlog-per-chip "
         "exceeded prefill_saturation_tokens_per_chip",
+    ),
+    MetricSpec(
+        "areal_gserver_kv_fabric_directory_entries",
+        "gauge",
+        "Live entries in the manager's fleet prefix directory (version-"
+        "and-flush-epoch-stamped hot-prefix records a kv_source pull "
+        "hint may cite)",
+    ),
+    MetricSpec(
+        "areal_gserver_kv_fabric_pull_routes_total",
+        "counter",
+        "Schedule responses that carried a kv_source hint (the routed "
+        "engine peer-pulls the named owner's cached prefix instead of "
+        "re-prefilling it)",
+    ),
+    MetricSpec(
+        "areal_gserver_kv_fabric_invalidations_total",
+        "counter",
+        "Fleet prefix-directory entries dropped, by reason "
+        "(weight_update = fleet-wide flush on a version bump; flush = "
+        "the owner's scraped prefix_cache_flushes_total moved; death = "
+        "consecutive failed epoch scrapes declared the owner dead)",
+        ("reason",),
     ),
     MetricSpec(
         "areal_gserver_weight_update_pause_seconds",
@@ -692,6 +739,13 @@ TRACE_TABLE = [
         "owning the request after the handoff)",
     ),
     TraceSpec(
+        "gserver.kv_fabric_route",
+        "event",
+        "Schedule response carried a kv_source pull hint (attrs: "
+        "target = the routed server, source = the prefix owner, "
+        "prompt_len)",
+    ),
+    TraceSpec(
         "gserver.finish",
         "event",
         "Rollout slot released at the manager (attrs: accepted)",
@@ -776,6 +830,20 @@ TRACE_TABLE = [
         "One streamed-handoff segment scattered into the decode "
         "server's pre-allocated blocks (attrs: seq, blocks, bytes, "
         "final, version)",
+    ),
+    TraceSpec(
+        "engine.prefix_export",
+        "event",
+        "Owner side of a fleet prefix pull: the cached run covering the "
+        "peer's tokens gathered into wire segments (attrs: blocks, "
+        "tokens, segments, version)",
+    ),
+    TraceSpec(
+        "engine.prefix_pull",
+        "event",
+        "Puller side of a fleet prefix pull: intent registered (attrs: "
+        "source, prompt_len, resident), completed (ok=True, blocks, "
+        "tokens, bytes), or failed closed (ok=False, reason)",
     ),
     TraceSpec(
         "engine.finish",

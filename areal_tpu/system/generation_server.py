@@ -34,43 +34,170 @@ logger = logging_.getLogger("generation_server")
 #: what every legacy registration parses as) does both.
 SERVER_ROLES = ("prefill", "decode", "unified")
 
+#: segment transports a generation server may register: the wire
+#: mechanics a streamed KV segment (P/D handoff pushes, fleet prefix
+#: pulls) travels over.  ``host-numpy`` (the default, and what every
+#: legacy registration parses as) materializes payloads on host and
+#: ships numpy over the peer ZMQ RPC.  ``tpu-d2d`` is a RESERVED
+#: capability token for the device-to-device ICI/DMA window — it
+#: parses (so a mixed fleet negotiates cleanly) but has no backend in
+#: this build; see :func:`make_segment_transport`.
+SEGMENT_TRANSPORTS = ("host-numpy", "tpu-d2d")
+
 
 def format_server_registration(
-    addr: str, mesh_spec, role: str = "unified"
+    addr: str, mesh_spec, role: str = "unified",
+    transport: str = "host-numpy",
 ) -> str:
     """Registration value for the gen_servers name-resolve subtree:
-    ``addr|mesh_devices|mesh_spec|role``.  One "server" = one mesh: the
-    gserver manager scales capacity accounting and routing weights by
-    the chip count, so a 4-chip TP/EP server absorbs 4x the load of a
-    single-chip one instead of being treated as an equal peer.  ``role``
-    opts the server into the manager's two-stage prefill/decode routing
-    (omitted for ``unified``, so unified registrations are byte-stable
-    across versions)."""
+    ``addr|mesh_devices|mesh_spec[|role][|transport]``.  One "server" =
+    one mesh: the gserver manager scales capacity accounting and
+    routing weights by the chip count, so a 4-chip TP/EP server absorbs
+    4x the load of a single-chip one instead of being treated as an
+    equal peer.  ``role`` opts the server into the manager's two-stage
+    prefill/decode routing; ``transport`` advertises the segment
+    transport the server's KV fabric speaks (the manager only routes
+    segment traffic — handoffs, prefix pulls — between servers on the
+    same transport).  Both are capability TOKENS appended only when
+    they differ from the defaults (``unified`` / ``host-numpy``), so
+    legacy-shaped registrations stay byte-stable across versions."""
     base = f"{addr}|{mesh_spec.world_size}|{mesh_spec}"
     if role and role != "unified":
         if role not in SERVER_ROLES:
             raise ValueError(f"unknown server role {role!r}")
         base += f"|{role}"
+    if transport and transport != "host-numpy":
+        if transport not in SEGMENT_TRANSPORTS:
+            raise ValueError(f"unknown segment transport {transport!r}")
+        base += f"|{transport}"
     return base
 
 
-def parse_server_registration(value: str) -> Tuple[str, int, str, str]:
-    """``(addr, mesh_devices, mesh_spec_str, role)`` from a registration
-    value; bare-address values (older registrations) parse as one device,
-    and registrations without a role field parse as ``unified``."""
+def parse_server_registration(
+    value: str,
+) -> Tuple[str, int, str, str, str]:
+    """``(addr, mesh_devices, mesh_spec_str, role, transport)`` from a
+    registration value; bare-address values (older registrations) parse
+    as one device, registrations without a role field parse as
+    ``unified``, and ones without a transport capability parse as
+    ``host-numpy``.  The trailing fields are capability TOKENS, not
+    positions: everything past the mesh spec is matched against the
+    known role and transport vocabularies, so ``addr|d|spec|tpu-d2d``
+    (a unified server on a d2d fabric) and ``addr|d|spec|decode|tpu-d2d``
+    both parse, and an unknown token from a newer peer degrades to the
+    defaults instead of failing the whole fleet discovery."""
     parts = value.split("|")
     addr = parts[0]
     devices = int(parts[1]) if len(parts) > 1 and parts[1] else 1
     spec = parts[2] if len(parts) > 2 else ""
-    role = parts[3] if len(parts) > 3 and parts[3] else "unified"
-    if role not in SERVER_ROLES:
-        role = "unified"
-    return addr, max(1, devices), spec, role
+    role, transport = "unified", "host-numpy"
+    for token in parts[3:]:
+        if token in SERVER_ROLES:
+            role = token
+        elif token in SEGMENT_TRANSPORTS:
+            transport = token
+    return addr, max(1, devices), spec, role, transport
 
 # ctrl-stream high-water mark (messages, each ~100s of bytes): bounds the
 # leader's buffer at ~10s of MB if a follower wedges, yet is ~100x deeper
 # than any observed leader/follower skew, so in practice nothing is dropped
 _CTRL_HWM = 1 << 17
+
+
+class SegmentTransport:
+    """Wire mechanics for ONE streamed KV segment.
+
+    The segment PROTOCOL — numbering, per-segment version checks, TTL
+    sweeps, abort markers, fail-closed rejects — lives above this
+    interface (engine + worker); a transport only moves a segment's
+    bytes to a peer.  ``submit`` runs off the engine thread and returns
+    a future resolving to ``bool`` ok (False = the peer rejected or the
+    push died — the protocol layer drops the stream's remainder and the
+    decode side re-prefills).  The negotiated transport name rides the
+    server registration (see :func:`format_server_registration`), so a
+    TPU device-to-device backend slots in here without touching the
+    protocol logic."""
+
+    name = "abstract"
+
+    def __init__(self, worker: "GenerationServerWorker"):
+        self._worker = worker
+
+    def submit(self, qid: str, dest: str, seg: Dict):
+        """Push ``seg`` (one numbered segment, device or host payload)
+        to ``dest``; returns a Future[bool]."""
+        raise NotImplementedError
+
+
+class HostNumpyTransport(SegmentTransport):
+    """The default transport: materialize the payload on host
+    (``jax.device_get`` on the push thread, so the engine thread never
+    blocks on the copy-out — the gather it dispatched rides under later
+    fill and decode chunks) and ship numpy over the peer's ZMQ RPC."""
+
+    name = "host-numpy"
+
+    def submit(self, qid: str, dest: str, seg: Dict):
+        worker = self._worker
+        client = worker._peer_client(dest)
+        log = worker.logger
+        timeout = worker.config.handoff_request_timeout
+
+        def push() -> bool:
+            try:
+                import jax
+
+                wire = dict(seg)
+                wire.pop("dest", None)
+                payload = wire.get("payload")
+                if payload:
+                    wire["payload"] = tuple(
+                        np.asarray(a) for a in jax.device_get(payload)
+                    )
+                resp = client.call(
+                    "import_handoff_segment",
+                    {"segment": wire},
+                    timeout=timeout,
+                )
+                if isinstance(resp, dict) and resp.get("imported"):
+                    return True
+                log.warning(
+                    "handoff segment %s/%s rejected by %s (%s); the "
+                    "decode server re-prefills",
+                    qid, seg.get("seq"), dest,
+                    (resp or {}).get("reason")
+                    if isinstance(resp, dict)
+                    else resp,
+                )
+            except Exception as e:  # noqa: BLE001 - fail closed
+                log.warning(
+                    "handoff segment %s/%s to %s failed (%r); the decode "
+                    "server re-prefills",
+                    qid, seg.get("seq"), dest, e,
+                )
+            return False
+
+        return worker._pool().submit(push)
+
+
+def make_segment_transport(
+    name: str, worker: "GenerationServerWorker"
+) -> SegmentTransport:
+    """Instantiate the segment transport ``name`` for ``worker``.
+    ``tpu-d2d`` is a recognized capability with no backend in this
+    build (the ICI/DMA path stays open for the TPU window — ROADMAP
+    item 2 remainder), so asking for it is a configuration error, not a
+    silent host-numpy fallback that would lie to the fleet directory."""
+    if name == "host-numpy":
+        return HostNumpyTransport(worker)
+    if name in SEGMENT_TRANSPORTS:
+        raise ValueError(
+            f"segment transport {name!r} has no backend in this build"
+        )
+    raise ValueError(
+        f"unknown segment transport {name!r}; expected one of "
+        f"{SEGMENT_TRANSPORTS}"
+    )
 
 
 class GenerationServerWorker(worker_base.Worker):
@@ -123,6 +250,16 @@ class GenerationServerWorker(worker_base.Worker):
                 "prefill/decode roles need a single-process server; "
                 "multi-host SPMD servers must register as unified"
             )
+        # fleet KV fabric: the segment transport this server registers
+        # (negotiated through the registration value — the manager only
+        # routes segment traffic between servers on the same transport)
+        self._transport_name = (
+            getattr(config, "segment_transport", "host-numpy")
+            or "host-numpy"
+        )
+        self._segment_transport = make_segment_transport(
+            self._transport_name, self
+        )
         if self._n_procs > 1:
             from areal_tpu.parallel import distributed as dist
 
@@ -192,6 +329,9 @@ class GenerationServerWorker(worker_base.Worker):
             slo_tracking=getattr(config, "slo_tracking", True),
             server_name=config.worker_name,
             handoff_streaming=getattr(config, "handoff_streaming", True),
+            prefix_pull_min_tokens=getattr(
+                config, "prefix_pull_min_tokens", 256
+            ),
         )
 
         self._ctx = zmq.Context.instance()
@@ -216,7 +356,8 @@ class GenerationServerWorker(worker_base.Worker):
             name_resolve.add(
                 base_key,
                 format_server_registration(
-                    self.addr, config.mesh_spec, role=self._role
+                    self.addr, config.mesh_spec, role=self._role,
+                    transport=self._transport_name,
                 ),
                 replace=True,
             )
@@ -293,6 +434,13 @@ class GenerationServerWorker(worker_base.Worker):
         )
         self._segment_reply_idents = []  # clients awaiting segment import
         self._stream_push: Dict[str, Dict] = {}
+        # fleet KV fabric: in-flight peer prefix pulls.  Each pull runs
+        # the owner's export_prefix RPC on the handoff pool (a dead or
+        # slow owner never stalls the poll loop); the returned segments
+        # (numpy payloads, the segment wire format) are injected into
+        # the lockstep command batch as import_prefix_segment commands,
+        # so SPMD followers replay the identical import stream.
+        self._pull_futs: Dict[str, object] = {}
         # in-flight staged weight restore (update_weights mode="stage"):
         # a background thread restores the snapshot into a device-resident
         # staging tree while decode continues; the RPC reply is deferred
@@ -386,6 +534,12 @@ class GenerationServerWorker(worker_base.Worker):
             "handoff_segment_aborts": reg.counter(
                 "areal_inference_handoff_segment_aborts_total"
             ),
+            "prefix_peer_pulls": reg.counter(
+                "areal_inference_prefix_peer_pulls_total"
+            ),
+            "prefix_peer_pull_bytes": reg.counter(
+                "areal_inference_prefix_peer_pull_bytes_total"
+            ),
             "swap_stage": reg.counter(
                 "areal_inference_swap_stage_seconds_total"
             ),
@@ -426,6 +580,12 @@ class GenerationServerWorker(worker_base.Worker):
             "areal_inference_handoff_import_rejects_total"
         )
         self._obs_handoff_rejects_last: Dict[str, int] = {}
+        # fleet prefix pulls that failed closed, by reason (rpc failure,
+        # version skew, expired TTL, ...); same delta-mirroring shape
+        self._obs_pull_rejects = reg.counter(
+            "areal_inference_prefix_peer_pull_rejects_total"
+        )
+        self._obs_pull_rejects_last: Dict[str, int] = {}
         self._obs_accept_hist = reg.histogram(
             "areal_inference_spec_accept_rate",
             buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
@@ -459,6 +619,7 @@ class GenerationServerWorker(worker_base.Worker):
         qstats = eng.kv_quant_stats()
         wstats = eng.weight_quant_stats()
         hstats = eng.handoff_stats()
+        fstats = eng.prefix_peer_stats()
         totals = {
             "chunks": float(eng.chunks_total),
             "host": eng.time_host_s,
@@ -505,6 +666,8 @@ class GenerationServerWorker(worker_base.Worker):
             "handoff_segment_aborts": float(
                 hstats["segment_aborts_total"]
             ),
+            "prefix_peer_pulls": float(fstats["pulls_total"]),
+            "prefix_peer_pull_bytes": float(fstats["pull_bytes_total"]),
             "swap_stage": eng.swap_stage_s,
             "swap_pause": eng.swap_pause_s,
             "swaps": float(eng.swaps_total),
@@ -520,6 +683,11 @@ class GenerationServerWorker(worker_base.Worker):
             if delta > 0:
                 self._obs_handoff_rejects.inc(delta, reason=reason)
                 self._obs_handoff_rejects_last[reason] = total
+        for reason, total in fstats["pull_rejects"].items():
+            delta = total - self._obs_pull_rejects_last.get(reason, 0)
+            if delta > 0:
+                self._obs_pull_rejects.inc(delta, reason=reason)
+                self._obs_pull_rejects_last[reason] = total
         for frac in eng.drain_spec_accept_samples():
             self._obs_accept_hist.observe(frac)
         for rec in eng.drain_slo_records():
@@ -595,6 +763,12 @@ class GenerationServerWorker(worker_base.Worker):
                     resp = "resumed"
                 elif cmd == "metrics":
                     resp = self.metrics()
+                elif cmd == "export_prefix":
+                    # fleet KV fabric, owner side: a read-only gather
+                    # (device blocks -> host numpy), answered on the
+                    # leader like ``metrics`` — nothing in engine state
+                    # mutates, so it never rides the lockstep batch
+                    resp = self._export_prefix(payload)
                 else:
                     resp = {"error": f"unknown command {cmd}"}
             except Exception as e:  # noqa: BLE001
@@ -675,6 +849,17 @@ class GenerationServerWorker(worker_base.Worker):
                     self._sock.send_multipart(
                         [ident, b"", pickle.dumps(resp)]
                     )
+            elif cmd == "import_prefix_segment":
+                # fleet KV fabric, puller side: one pulled segment —
+                # injected by the leader's pull driver, replayed by
+                # followers (the engine rejects fail-closed on any skew
+                # and the admission falls back to a plain re-prefill)
+                try:
+                    self.engine.import_prefix_segment(payload["segment"])
+                except Exception:  # noqa: BLE001 - fail closed
+                    self.logger.exception("prefix segment import failed")
+            elif cmd == "prefix_pull_failed":
+                self.engine.prefix_pull_failed(payload["qid"])
             elif cmd == "pause":
                 self.engine.pause()
             elif cmd == "resume":
@@ -738,11 +923,7 @@ class GenerationServerWorker(worker_base.Worker):
         unit = self.engine.export_handoff(qid)
         if unit is None:
             return False  # row already evicted (swap/TTL): re-prefill
-        if dest not in self._peer_clients:
-            self._peer_clients[dest] = GenServerClient(
-                dest, timeout=self.config.handoff_request_timeout
-            )
-        client = self._peer_clients[dest]
+        client = self._peer_client(dest)
 
         def push():
             try:
@@ -781,54 +962,111 @@ class GenerationServerWorker(worker_base.Worker):
             )
         return self._handoff_pool
 
-    def _submit_segment_push(self, qid: str, st: Dict, seg: Dict):
-        """Push ONE segment to the decode peer on the handoff pool.
-        The payload's device arrays are materialized on the push thread
-        (``jax.device_get``), so the engine thread never blocks on the
-        copy-out — the gather it dispatched rides under later fill and
-        decode chunks.  Returns the future (resolves to bool ok)."""
-        dest = seg.get("dest") or st["dest"]
+    def _peer_client(self, dest: str) -> "GenServerClient":
+        """Lazily-created RPC client for a peer server (handoff pushes,
+        fleet prefix pulls)."""
         if dest not in self._peer_clients:
             self._peer_clients[dest] = GenServerClient(
                 dest, timeout=self.config.handoff_request_timeout
             )
-        client = self._peer_clients[dest]
+        return self._peer_clients[dest]
 
-        def push() -> bool:
-            try:
-                import jax
+    def _submit_segment_push(self, qid: str, st: Dict, seg: Dict):
+        """Push ONE segment to the decode peer over the negotiated
+        segment transport (host-numpy unless configured otherwise —
+        see :class:`SegmentTransport`).  Returns the future (resolves
+        to bool ok)."""
+        dest = seg.get("dest") or st["dest"]
+        return self._segment_transport.submit(qid, dest, seg)
 
-                wire = dict(seg)
-                wire.pop("dest", None)
-                payload = wire.get("payload")
-                if payload:
-                    wire["payload"] = tuple(
-                        np.asarray(a) for a in jax.device_get(payload)
+    # -- fleet KV fabric: cross-server prefix pulls --------------------------
+
+    def _export_prefix(self, payload: Dict) -> Dict:
+        """Owner side of a fleet prefix pull: the longest resident
+        full-block prefix of the peer's tokens as numbered wire
+        segments (numpy payloads — host-spilled blocks ARE the wire
+        format already; device runs pay one gather).  Sharded SPMD
+        export stays open for the TPU window: a multi-process server
+        only addresses its local kv-head shard, so it refuses and the
+        puller re-prefills (fail closed, like every fabric path)."""
+        if self._n_procs > 1:
+            return {"segments": [], "reason": "spmd"}
+        try:
+            segs = self.engine.export_prefix(
+                payload.get("qid", "?"), payload.get("tokens") or []
+            )
+        except Exception as e:  # noqa: BLE001 - puller re-prefills
+            self.logger.exception("prefix export failed")
+            return {"segments": [], "reason": repr(e)}
+        if not segs:
+            return {"segments": [], "reason": "miss"}
+        return {"segments": segs}
+
+    def _pump_prefix_pulls(self):
+        """Start one owner-side ``export_prefix`` RPC per pull intent
+        the engine registered.  The RPC runs on the handoff thread pool
+        — a dead or slow owner never stalls the poll loop, and the
+        engine's step-keyed TTL sweep bounds how long the requeued
+        admission waits — and resolves to the owner's segment list, or
+        None on any failure (the pull fails closed to a re-prefill)."""
+        for req in self.engine.drain_prefix_pull_requests():
+            qid, source = req["qid"], req["source"]
+            client = self._peer_client(source)
+            timeout = self.config.handoff_request_timeout
+            tokens = req["tokens"]
+            log = self.logger
+
+            def pull(qid=qid, source=source, tokens=tokens, client=client):
+                try:
+                    resp = client.call(
+                        "export_prefix",
+                        {"qid": qid, "tokens": tokens},
+                        timeout=timeout,
                     )
-                resp = client.call(
-                    "import_handoff_segment",
-                    {"segment": wire},
-                    timeout=self.config.handoff_request_timeout,
-                )
-                if isinstance(resp, dict) and resp.get("imported"):
-                    return True
-                self.logger.warning(
-                    "handoff segment %s/%s rejected by %s (%s); the "
-                    "decode server re-prefills",
-                    qid, seg.get("seq"), dest,
-                    (resp or {}).get("reason")
-                    if isinstance(resp, dict)
-                    else resp,
-                )
-            except Exception as e:  # noqa: BLE001 - fail closed
-                self.logger.warning(
-                    "handoff segment %s/%s to %s failed (%r); the decode "
-                    "server re-prefills",
-                    qid, seg.get("seq"), dest, e,
-                )
-            return False
+                    segs = (
+                        resp.get("segments")
+                        if isinstance(resp, dict)
+                        else None
+                    )
+                    if segs:
+                        return segs
+                    log.info(
+                        "prefix pull %s from %s returned nothing (%s); "
+                        "re-prefilling locally",
+                        qid, source,
+                        (resp or {}).get("reason")
+                        if isinstance(resp, dict)
+                        else resp,
+                    )
+                except Exception as e:  # noqa: BLE001 - fail closed
+                    log.warning(
+                        "prefix pull %s from %s failed (%r); "
+                        "re-prefilling locally", qid, source, e,
+                    )
+                return None
 
-        return self._pool().submit(push)
+            self._pull_futs[qid] = self._pool().submit(pull)
+
+    def _drain_pull_commands(self):
+        """Finished pulls -> lockstep commands: the owner's segments in
+        seq order, or one failure marker.  Appended to the leader's
+        command batch BEFORE the publish, so followers replay the
+        identical imports at the identical step."""
+        cmds = []
+        for qid in list(self._pull_futs):
+            fut = self._pull_futs[qid]
+            if not fut.done():
+                continue
+            del self._pull_futs[qid]
+            segs = fut.result()
+            if segs:
+                for seg in segs:
+                    cmds.append(
+                        ("import_prefix_segment", {"segment": seg})
+                    )
+            else:
+                cmds.append(("prefix_pull_failed", {"qid": qid}))
+        return cmds
 
     def _pump_handoff_streams(self):
         """Each poll: drain the engine's new export segments into their
@@ -1166,6 +1404,15 @@ class GenerationServerWorker(worker_base.Worker):
                 f"handoff_{k}": v
                 for k, v in self.engine.handoff_stats().items()
             },
+            # fleet KV fabric: the negotiated segment transport and the
+            # puller-side counters (the manager's directory scrape also
+            # reads prefix_cache_flushes_total above for its flush-epoch
+            # coherence — see gserver_manager._refresh_fabric_epochs)
+            "segment_transport": self._transport_name,
+            **{
+                f"prefix_peer_{k}": v
+                for k, v in self.engine.prefix_peer_stats().items()
+            },
             # decode-loop host/device/fetch attribution (cumulative s)
             **{
                 f"time_{k}": v
@@ -1190,6 +1437,12 @@ class GenerationServerWorker(worker_base.Worker):
     def _poll(self) -> worker_base.PollResult:
         if self._is_leader:
             batch = self._serve_api()
+            # fleet KV fabric: start owner RPCs for new pull intents and
+            # append finished pulls' segments (or failure markers) to
+            # THIS batch — they ride the publish below, so follower
+            # controllers replay the identical import stream
+            self._pump_prefix_pulls()
+            batch.extend(self._drain_pull_commands())
             if self._ctrl_pub is not None:
                 # publish BEFORE applying: followers must dispatch their
                 # part of this step's device programs (TP collectives span

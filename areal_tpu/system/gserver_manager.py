@@ -30,6 +30,11 @@ from areal_tpu.system.generation_server import GenServerClient
 
 logger = logging_.getLogger("gserver_manager")
 
+#: consecutive failed fabric-epoch scrapes after which a server is
+#: declared dead and its fleet-prefix directory entries are dropped (a
+#: dead owner must never be advertised as a pull source)
+_FABRIC_DEATH_MISSES = 3
+
 
 class GserverManager(worker_base.Worker):
     def _configure(self, config: system_api.GserverManagerConfig):
@@ -75,11 +80,21 @@ class GserverManager(worker_base.Worker):
                 )
             time.sleep(0.1)
         parsed = [parse_server_registration(v) for v in values]
-        self.server_addrs = [a for a, _, _, _ in parsed]
+        self.server_addrs = [a for a, _, _, _, _ in parsed]
         self._server_devices: Dict[str, int] = {
-            a: d for a, d, _, _ in parsed
+            a: d for a, d, _, _, _ in parsed
         }
-        self._server_mesh: Dict[str, str] = {a: s for a, _, s, _ in parsed}
+        self._server_mesh: Dict[str, str] = {
+            a: s for a, _, s, _, _ in parsed
+        }
+        # fleet KV fabric: each server's segment-transport capability
+        # (registration token; legacy registrations parse as the
+        # host-numpy default).  Pull hints only ever pair servers whose
+        # transports match — a d2d server never gets told to pull from
+        # a host-numpy one.
+        self._server_transport: Dict[str, str] = {
+            a: t for a, _, _, _, t in parsed
+        }
         # P/D disaggregation: servers register a serving role (prefill |
         # decode | unified; legacy registrations parse as unified).  Two-
         # stage routing activates iff the fleet holds BOTH a prefill and
@@ -96,7 +111,9 @@ class GserverManager(worker_base.Worker):
         # re-prefill.  Unified servers in a P/D fleet keep serving
         # whatever reaches them directly, but receive no two-stage
         # traffic.
-        self._server_role: Dict[str, str] = {a: r for a, _, _, r in parsed}
+        self._server_role: Dict[str, str] = {
+            a: r for a, _, _, r, _ in parsed
+        }
         self._prefill_addrs = [
             a for a in self.server_addrs
             if self._server_role[a] == "prefill"
@@ -129,19 +146,7 @@ class GserverManager(worker_base.Worker):
         #: reference prompt dedup fire once per group)
         self._group_prefill: Dict[str, str] = {}
         self._pd_rr = 0
-        # load-aware prefill admission: last-scraped prefill-token
-        # backlog per prefill server (metrics RPC), plus optimistic
-        # local increments since the scrape so a burst between scrapes
-        # still spreads instead of piling onto one server.  The scrape
-        # REPLACES the estimate (it already includes whatever the local
-        # adds routed there that is still in flight).
-        self._prefill_backlog: Dict[str, float] = {
-            a: 0.0 for a in self._prefill_addrs
-        }
-        self._prefill_backlog_local: Dict[str, float] = {
-            a: 0.0 for a in self._prefill_addrs
-        }
-        self._prefill_backlog_ts = 0.0
+        self._init_runtime_state()
         self._clients = {a: GenServerClient(a) for a in self.server_addrs}
 
         # rollout accounting (reference: monitor.RolloutStat threading
@@ -195,6 +200,10 @@ class GserverManager(worker_base.Worker):
         from areal_tpu.observability import get_registry
         from areal_tpu.observability import tracing
 
+        # hand-built managers (dryrun, unit tests) reach here without
+        # _configure: wire the full runtime state too, not just metrics
+        self._init_runtime_state()
+
         self._tracer = tracing.configure(
             getattr(self.config, "trace", None),
             worker=getattr(self, "worker_name", "gserver_manager"),
@@ -228,6 +237,19 @@ class GserverManager(worker_base.Worker):
         )
         self._m_prefill_sheds = reg.counter(
             "areal_gserver_prefill_sheds_total"
+        )
+        # fleet KV fabric: live directory entries (stamped hot-prefix
+        # records a hint may cite), pull hints actually emitted, and
+        # entries invalidated (weight updates, scraped cache flushes,
+        # server death)
+        self._m_fabric_entries = reg.gauge(
+            "areal_gserver_kv_fabric_directory_entries"
+        )
+        self._m_fabric_routes = reg.counter(
+            "areal_gserver_kv_fabric_pull_routes_total"
+        )
+        self._m_fabric_invalidations = reg.counter(
+            "areal_gserver_kv_fabric_invalidations_total"
         )
         self._m_update_pause = reg.gauge(
             "areal_gserver_weight_update_pause_seconds"
@@ -266,13 +288,14 @@ class GserverManager(worker_base.Worker):
             self._m_pd_roles.set(
                 sum(1 for r in roles.values() if r == role), role=role
             )
-        self._ensure_backlog_state()
+        self._init_runtime_state()
         for addr in getattr(self, "_prefill_addrs", ()):
             self._m_prefill_backlog.set(
                 self._prefill_backlog.get(addr, 0.0)
                 + self._prefill_backlog_local.get(addr, 0.0),
                 server=addr,
             )
+        self._m_fabric_entries.set(len(self._fabric_stamp))
 
     # -- scheduling / staleness --------------------------------------------
 
@@ -300,13 +323,47 @@ class GserverManager(worker_base.Worker):
             return self._decode_addrs
         return self.server_addrs
 
-    def _ensure_backlog_state(self):
-        """Lazy-init the backlog maps (hand-built managers — dryrun,
-        unit tests — construct around ``_configure``)."""
+    def _init_runtime_state(self):
+        """Idempotent init of every post-registration runtime map:
+        prefill-backlog estimates AND the fleet KV-fabric directory
+        state.  ``_configure`` calls it on the normal path;
+        ``_init_metrics`` calls it too, so hand-built managers (dryrun,
+        unit tests — the PR-3 pattern that used to skip lazily-inited
+        attrs) get the full state the moment they wire observability;
+        and the hot-path users still call it defensively.  Per-attribute
+        guards: a test that pre-seeded one map keeps it."""
         if not hasattr(self, "_prefill_backlog"):
-            self._prefill_backlog = {}
-            self._prefill_backlog_local = {}
+            # load-aware prefill admission: last-scraped prefill-token
+            # backlog per prefill server (metrics RPC), plus optimistic
+            # local increments since the scrape so a burst between
+            # scrapes still spreads instead of piling onto one server.
+            # The scrape REPLACES the estimate (it already includes
+            # whatever the local adds routed there is still in flight).
+            self._prefill_backlog = {
+                a: 0.0 for a in getattr(self, "_prefill_addrs", ())
+            }
+            self._prefill_backlog_local = {
+                a: 0.0 for a in getattr(self, "_prefill_addrs", ())
+            }
             self._prefill_backlog_ts = 0.0
+        if not hasattr(self, "_fabric_stamp"):
+            # fleet prefix DIRECTORY: every hot-prefix entry the
+            # cache-aware router records is stamped with the owner's
+            # (model version, cache-flush epoch) at record time.  A
+            # kv_source hint is emitted only while the stamp still
+            # matches the CURRENT version and epoch — a weight update,
+            # a scraped flush, or a dead server moves them and the
+            # directory stops advertising the dropped prefix.
+            self._fabric_stamp: Dict[Tuple[str, str], Tuple[int, int]] = {}
+            #: last scraped prefix_cache_flushes_total per server (the
+            #: flush-epoch signal riding the existing metrics RPC)
+            self._server_flush_epoch: Dict[str, float] = {}
+            self._fabric_scrape_fut = None
+            self._fabric_scrape_ts = 0.0
+            #: consecutive failed epoch scrapes per server; at
+            #: _FABRIC_DEATH_MISSES the server is declared dead and its
+            #: directory entries drop
+            self._fabric_scrape_misses: Dict[str, int] = {}
 
     def _refresh_prefill_backlog(self):
         """Keep the prefill-backlog estimates fresh WITHOUT ever
@@ -321,7 +378,7 @@ class GserverManager(worker_base.Worker):
         ``{"error": ...}`` reply, an older server without the key)
         returns None and keeps the last estimate plus local adds, so a
         broken prefill server never reads as idle."""
-        self._ensure_backlog_state()
+        self._init_runtime_state()
         if not getattr(self, "_prefill_addrs", None) or not getattr(
             self, "_clients", None
         ):
@@ -379,11 +436,182 @@ class GserverManager(worker_base.Worker):
         )
 
     def _prefill_backlog_per_chip(self, addr: str) -> float:
-        self._ensure_backlog_state()
+        self._init_runtime_state()
         return (
             self._prefill_backlog.get(addr, 0.0)
             + self._prefill_backlog_local.get(addr, 0.0)
         ) / self._devices(addr)
+
+    # -- fleet KV fabric: prefix directory ----------------------------------
+
+    def _transport_of(self, addr: str) -> str:
+        """A server's segment-transport capability (registration token;
+        hand-built/legacy managers default everything to host-numpy)."""
+        return getattr(self, "_server_transport", {}).get(
+            addr, "host-numpy"
+        )
+
+    def _invalidate_fabric_server(self, addr: str, reason: str):
+        """Drop every directory entry owned by ``addr`` (its cache
+        flushed, or the server died): the directory must never
+        advertise a prefix the owner no longer holds.  Affinity state
+        survives — routing a session back to its usual server is still
+        right even when the pull hint would be stale."""
+        self._init_runtime_state()
+        stale = [k for k in self._fabric_stamp if k[1] == addr]
+        for k in stale:
+            del self._fabric_stamp[k]
+        if stale:
+            self._m_fabric_invalidations.inc(len(stale), reason=reason)
+            self.logger.info(
+                "kv fabric: dropped %d directory entries for %s (%s)",
+                len(stale), addr, reason,
+            )
+
+    def _invalidate_fabric_all(self, reason: str):
+        """Weight update: every server flushes both cache tiers, so the
+        whole directory AND the hot-prefix affinity sums are stale —
+        leaving the sums in place would pin sessions to servers whose
+        caches are empty (the stale-affinity bug).  Plain group
+        affinity (``_group_server``) and resident-token load survive:
+        they track live rows, not cached KV."""
+        self._init_runtime_state()
+        n = len(self._fabric_stamp)
+        self._fabric_stamp.clear()
+        for by_srv in getattr(self, "_group_prefix", {}).values():
+            by_srv.clear()
+        if n:
+            self._m_fabric_invalidations.inc(n, reason=reason)
+
+    def _refresh_fabric_epochs(self):
+        """Keep the directory honest about evictions WITHOUT blocking
+        scheduling: at most every ``prefill_backlog_refresh_s`` one
+        background scrape of every route-pool server's
+        ``prefix_cache_flushes_total`` (the existing metrics RPC — no
+        new engine surface).  An epoch BUMP means the server flushed
+        its cache since the last look: its directory entries drop.
+        ``_FABRIC_DEATH_MISSES`` consecutive scrape failures declare
+        the server dead — same effect.  Harvest-then-submit like the
+        backlog scrape: the scheduling path never waits."""
+        self._init_runtime_state()
+        if not getattr(self.config, "kv_fabric", True):
+            return
+        if not getattr(self, "_clients", None):
+            return
+        fut = self._fabric_scrape_fut
+        if fut is not None:
+            if not fut.done():
+                return  # one scrape in flight at a time
+            self._fabric_scrape_fut = None
+            for addr, epoch in fut.result().items():
+                if epoch is None:
+                    misses = self._fabric_scrape_misses.get(addr, 0) + 1
+                    self._fabric_scrape_misses[addr] = misses
+                    if misses == _FABRIC_DEATH_MISSES:
+                        self._invalidate_fabric_server(addr, "death")
+                    continue
+                self._fabric_scrape_misses[addr] = 0
+                prev = self._server_flush_epoch.get(addr)
+                if prev is not None and epoch > prev:
+                    self._invalidate_fabric_server(addr, "flush")
+                self._server_flush_epoch[addr] = epoch
+        now = time.monotonic()
+        if now - self._fabric_scrape_ts < max(
+            0.05, getattr(self.config, "prefill_backlog_refresh_s", 0.5)
+        ):
+            return
+        self._fabric_scrape_ts = now
+
+        def _scrape_one(addr):
+            try:
+                m = self._clients[addr].call("metrics", {}, timeout=5.0)
+                v = (
+                    m.get("prefix_cache_flushes_total")
+                    if isinstance(m, dict)
+                    else None
+                )
+                return None if v is None else float(v)
+            except Exception as e:  # noqa: BLE001 - counted as a miss
+                self.logger.warning(
+                    "kv fabric epoch scrape failed on %s: %r", addr, e
+                )
+                return None
+
+        def _scrape_all(addrs):
+            return {a: _scrape_one(a) for a in addrs}
+
+        import concurrent.futures as cf
+
+        if getattr(self, "_update_pool", None) is None:
+            self._update_pool = cf.ThreadPoolExecutor(
+                max_workers=min(32, max(1, len(self._clients))),
+                thread_name_prefix="weight-update",
+            )
+        self._fabric_scrape_fut = self._update_pool.submit(
+            _scrape_all, list(self._route_pool())
+        )
+
+    def _kv_source_hint(
+        self,
+        qid: str,
+        addr: str,
+        prompt_len: int,
+        prior: Optional[Dict[str, float]] = None,
+    ) -> Optional[str]:
+        """The peer a request routed to ``addr`` should pull its cached
+        prefix from, or None.  Emitted only when every gate holds: the
+        fabric is on; some OTHER route-pool server's recorded hot
+        prefix for this session beats both the floor
+        (``kv_fabric_min_prefix_tokens``) and the routed server's own
+        record; the owner's directory stamp still matches the current
+        (model version, flush epoch); and both servers speak the same
+        segment transport.  Deterministic: candidate owners scan in
+        sorted address order, longest prefix wins, ties break on
+        address.
+
+        ``prior`` is the group's hot-prefix map SNAPSHOTTED BEFORE this
+        turn was scheduled: scheduling optimistically records the whole
+        prompt as the routed server's hot prefix, so judging "does a
+        peer hold more than the target" against the post-schedule map
+        would always answer no — the migration that most needs the pull
+        would never get the hint."""
+        self._init_runtime_state()
+        if not getattr(self.config, "kv_fabric", True):
+            return None
+        prefixes = (
+            prior
+            if prior is not None
+            else getattr(self, "_group_prefix", {}).get(
+                self._group_key(qid)
+            )
+        )
+        if not prefixes:
+            return None
+        floor = max(
+            1.0,
+            float(
+                getattr(self.config, "kv_fabric_min_prefix_tokens", 256)
+            ),
+        )
+        own = prefixes.get(addr, 0.0)
+        group = self._group_key(qid)
+        best, best_len = None, 0.0
+        for owner in sorted(prefixes):
+            plen = prefixes[owner]
+            if owner == addr or plen <= best_len:
+                continue
+            if plen < floor or plen <= own:
+                continue
+            stamp = self._fabric_stamp.get((group, owner))
+            if stamp is None or stamp != (
+                self._model_version,
+                self._server_flush_epoch.get(owner, 0),
+            ):
+                continue
+            if self._transport_of(owner) != self._transport_of(addr):
+                continue
+            best, best_len = owner, plen
+        return best
 
     def _pick_prefill(self, group: str, prompt_len: int = 0) -> Optional[str]:
         """Prefill-stage pick — LOAD-AWARE admission over the prefill
@@ -441,6 +669,16 @@ class GserverManager(worker_base.Worker):
         the request instead: it serves unified-style on its decode
         owner (``pd_shed`` marks the response)."""
         sticky = qid in self._qid_server  # before _schedule registers it
+        # snapshot the session's hot-prefix records BEFORE scheduling:
+        # _schedule_inner optimistically records this turn's whole
+        # prompt under the routed server, which must not mask a peer's
+        # genuinely-resident longer prefix (see _kv_source_hint)
+        prior_prefix = dict(
+            getattr(self, "_group_prefix", {}).get(
+                self._group_key(qid)
+            )
+            or {}
+        )
         addr = self._schedule(qid, prompt_len, new_token_budget)
         resp = {"url": addr, "version": self._model_version}
         if getattr(self, "_pd_enabled", False) and not sticky:
@@ -457,6 +695,25 @@ class GserverManager(worker_base.Worker):
                     qid, "gserver.handoff_route",
                     root=self._group_key(qid),
                     prefill=prefill, decode=addr,
+                )
+        if "handoff_to" not in resp:
+            # fleet KV fabric: the serving target re-prefills this
+            # session's context unless a peer's cached prefix can be
+            # pulled — name the owner when the directory has a live,
+            # longer, transport-compatible entry.  Never alongside a
+            # handoff route: there the prefill server streams the KV
+            # to the owner anyway.
+            source = self._kv_source_hint(
+                qid, resp["url"], prompt_len, prior=prior_prefix
+            )
+            if source is not None:
+                resp["kv_source"] = source
+                self._m_fabric_routes.inc()
+                self._tracer.event(
+                    qid, "gserver.kv_fabric_route",
+                    root=self._group_key(qid),
+                    target=resp["url"], source=source,
+                    prompt_len=prompt_len,
                 )
         return resp
 
@@ -537,6 +794,14 @@ class GserverManager(worker_base.Worker):
             # turns of this session route on
             by_srv = self._group_prefix.setdefault(group, {})
             by_srv[addr] = max(by_srv.get(addr, 0.0), float(prompt_len))
+            # directory stamp: this entry is advertisable as a pull
+            # source only while the owner keeps the (version, epoch) it
+            # was recorded under — see _kv_source_hint
+            self._init_runtime_state()
+            self._fabric_stamp[(group, addr)] = (
+                self._model_version,
+                self._server_flush_epoch.get(addr, 0),
+            )
         self._server_load[addr] += 1
         est = float(prompt_len) + 0.4 * float(new_token_budget)
         self._qid_tokens[qid] = est
@@ -680,6 +945,12 @@ class GserverManager(worker_base.Worker):
         self._group_server.pop(qid, None)
         self._group_prefix.pop(qid, None)
         self._group_tokens.pop(qid, None)
+        for k in [
+            k
+            for k in getattr(self, "_fabric_stamp", {})
+            if k[0] == qid
+        ]:
+            del self._fabric_stamp[k]
         getattr(self, "_group_prefill", {}).pop(qid, None)
         # a rollout abandoned between reject and ok must not leak its
         # gate stamp (and must not pollute a later same-qid rollout)
@@ -907,6 +1178,13 @@ class GserverManager(worker_base.Worker):
             )
             return
         self._model_version = version
+        # the fleet-wide flush that just happened emptied every cache
+        # tier: drop the prefix directory AND the hot-prefix affinity
+        # sums (leaving them would pin sessions to servers whose caches
+        # are empty — the stale-affinity bug — and would let the
+        # directory advertise flushed prefixes until the next epoch
+        # scrape noticed)
+        self._invalidate_fabric_all("weight_update")
         self.logger.info(
             "weights updated to v%d on %d servers (%d interrupted, "
             "%s, fleet paused %.3fs)",
@@ -941,7 +1219,7 @@ class GserverManager(worker_base.Worker):
                     )
                     resp = "ok"
                 elif cmd == "get_status":
-                    self._ensure_backlog_state()
+                    self._init_runtime_state()
                     resp = {
                         "version": self._model_version,
                         "n_running_rollouts": self.rollout_stat.running,
@@ -964,6 +1242,12 @@ class GserverManager(worker_base.Worker):
                             + self._prefill_backlog_local.get(a, 0.0)
                             for a in getattr(self, "_prefill_addrs", ())
                         },
+                        "kv_fabric_directory_entries": len(
+                            self._fabric_stamp
+                        ),
+                        "server_transports": dict(
+                            getattr(self, "_server_transport", {})
+                        ),
                     }
                 else:
                     resp = {"error": f"unknown command {cmd}"}
@@ -974,9 +1258,11 @@ class GserverManager(worker_base.Worker):
 
     def _poll(self) -> worker_base.PollResult:
         self._serve()
-        # harvest/kick the background prefill-backlog scrape even when
-        # no schedule traffic arrives (never blocks — see the method)
+        # harvest/kick the background prefill-backlog and fabric-epoch
+        # scrapes even when no schedule traffic arrives (never block —
+        # see the methods)
         self._refresh_prefill_backlog()
+        self._refresh_fabric_epochs()
         if time.monotonic() - self._last_version_check > 0.5:
             self._last_version_check = time.monotonic()
             info = self._check_new_params()

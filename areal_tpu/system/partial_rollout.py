@@ -138,6 +138,13 @@ class PartialRolloutManager:
                     # carried in metadata so latency attribution can
                     # separate shed TTFT from two-stage TTFT
                     metadata["pd_shed"] = True
+                if sched.get("kv_source"):
+                    # fleet KV fabric: the manager's prefix directory
+                    # says a peer owns a longer cached prefix for this
+                    # session than the routed server holds — the engine
+                    # peer-pulls it instead of re-prefilling, falling
+                    # back to a plain re-prefill on any reject
+                    metadata["kv_source"] = sched["kv_source"]
                 inp = model_api.APIGenerateInput(
                     qid=gen_qid,
                     prompt_ids=prompt_ids,

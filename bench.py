@@ -778,6 +778,212 @@ def bench_prefix_cache_hier(
     return out
 
 
+def bench_kv_fabric_ab(
+    cfg,
+    params,
+    counts=(2, 8),
+    turns=3,
+    prompt_len=128,
+    user_len=24,
+    max_new=24,
+    page=32,
+    chunk=32,
+):
+    """Fleet-wide KV fabric A/B: session-migration replay on a 2-server
+    in-process fleet, cross-server prefix pull on vs off.
+
+    Every session runs turn 0 on the OWNER server, then migrates to the
+    TARGET for all later turns — the poster-child workload for the
+    fabric (cache-aware routing just lost, e.g. on a rebalance or a
+    server death).  Fabric ON, the target is handed ``kv_source`` and
+    pulls the owner's cached prefix over the segment transport
+    (export_prefix -> import_prefix_segment, the worker's pump driven
+    in-process); OFF, it re-prefills the whole conversation.  The
+    diffable wins: FLEET ``cached_token_frac`` (both servers' radix
+    hits over all prompt tokens submitted anywhere) and the target's
+    re-prefill token count — the acceptance bar is a strictly higher
+    fleet frac and a >=2x re-prefill reduction, with greedy streams
+    token-identical across arms (the fabric buys FLOPs, never tokens)
+    and both pools pristine after a flush.
+
+    Sub-arms are never silently capped: a (count, arm) cell that raises
+    is recorded as ``{"error": ...}`` and named in ``dropped``; parity
+    for that count is then reported as unverified, not assumed."""
+    import zlib
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine.sampling import SamplingParams
+
+    final_prompt = prompt_len + (turns - 1) * (max_new + user_len)
+    cache_len = bench_gen_cache_len(final_prompt, max_new)
+
+    def submit(eng, qid, ids, source=None):
+        eng.submit(
+            APIGenerateInput(
+                qid=qid,
+                prompt_ids=ids,
+                input_ids=ids,
+                gconfig=GenerationHyperparameters(
+                    max_new_tokens=max_new, greedy=True
+                ),
+            )
+        )
+        if source is not None:
+            # the schedule response's kv_source hint, as partial_rollout
+            # attaches it (request metadata on the queued admission)
+            with eng._lock:
+                eng._pending[-1].metadata = {"kv_source": source}
+
+    def pump(target, owner, max_steps=6000):
+        """Step the target while servicing its pull intents from the
+        owner — the generation-server worker's pull pump in-process."""
+        for _ in range(max_steps):
+            if not target.has_work:
+                return
+            target.step()
+            for preq in target.drain_prefix_pull_requests():
+                segs = owner.export_prefix(preq["qid"], preq["tokens"])
+                if not segs:
+                    target.prefix_pull_failed(preq["qid"], "miss")
+                    continue
+                for seg in segs:
+                    ok, _ = target.import_prefix_segment(seg)
+                    if not ok:
+                        break
+        raise RuntimeError("kv_fabric replay did not drain")
+
+    def pristine(eng):
+        eng.step()
+        eng.step()
+        if eng._prefix_cache is not None:
+            eng._prefix_cache.flush()
+        return bool(
+            eng.free_pool_blocks == eng.n_blocks
+            and (np.asarray(eng._block_ref) == 0).all()
+        )
+
+    def arm(n_conv, fabric, tag):
+        servers = {}
+        for role in ("owner", "target"):
+            eng = make_engine(
+                cfg, params, 2, final_prompt, max_new, chunk=chunk,
+                cache_mode="paged",
+                page_size=page,
+                # roomy pool: the owner keeps every session's turn-0
+                # prefix radix-resident for the later pulls
+                kv_pool_tokens=(n_conv + 2) * cache_len,
+                prefix_cache=True,
+                prefix_pull_min_tokens=page,
+                sampling=SamplingParams(greedy=True),
+            )
+            eng.park_ttl_steps = 0  # fresh-qid turns never resume rows
+            servers[role] = eng
+        owner, target = servers["owner"], servers["target"]
+        rngs = [
+            np.random.default_rng(zlib.crc32(f"{tag}s{s}".encode()))
+            for s in range(n_conv)
+        ]
+        convs = [
+            rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+            for rng in rngs
+        ]
+        streams = {}
+        prompt_toks = 0
+        migrated_toks = 0
+        gen_toks = 0
+        t0 = time.perf_counter()
+        for j in range(turns):
+            for s in range(n_conv):
+                qid = f"{tag}s{s}t{j}"
+                prompt_toks += len(convs[s])
+                if j == 0:  # warm turn on the owner
+                    submit(owner, qid, convs[s])
+                    while owner.has_work:
+                        owner.step()
+                    out = owner.drain_results()[qid]
+                else:  # the session migrated: later turns on the target
+                    migrated_toks += len(convs[s])
+                    submit(
+                        target, qid, convs[s],
+                        source="owner" if fabric else None,
+                    )
+                    pump(target, owner)
+                    out = target.drain_results()[qid]
+                streams[(s, j)] = list(out.output_ids)
+                gen_toks += len(out.output_ids)
+                convs[s] = (
+                    convs[s]
+                    + list(out.output_ids)
+                    + rngs[s].integers(
+                        0, cfg.vocab_size, (user_len,)
+                    ).tolist()
+                )
+        fleet_cached = sum(
+            e.prefix_cache_stats()["cached_tokens_total"]
+            for e in servers.values()
+        )
+        pst = target.prefix_peer_stats()
+        row = {
+            "replay_s": round(time.perf_counter() - t0, 3),
+            "generated_tokens": int(gen_toks),
+            "prompt_tokens_submitted": int(prompt_toks),
+            "migrated_prompt_tokens": int(migrated_toks),
+            "fleet_cached_token_frac": round(
+                fleet_cached / max(prompt_toks, 1), 3
+            ),
+            "target_prefill_tokens": int(target.prefill_tokens_total),
+            "pulls_total": int(pst["pulls_total"]),
+            "pull_bytes_total": int(pst["pull_bytes_total"]),
+            "pull_rejects": dict(pst["pull_rejects"]),
+            # leak audit: drain parked rows, flush the radix tiers, and
+            # require both pools pristine (tier-1 asserts this)
+            "leak_free": pristine(owner) and pristine(target),
+        }
+        del owner, target, servers
+        return streams, row
+
+    out = {
+        "counts": list(counts),
+        "turns": turns,
+        "prompt_len": prompt_len,
+        "user_len": user_len,
+        "max_new": max_new,
+        "page_size": page,
+        "sweep": {},
+        "dropped": [],
+    }
+    for n_conv in counts:
+        cell = {}
+        arms = {}
+        for name, fabric in (("fabric_on", True), ("fabric_off", False)):
+            try:
+                streams, row = arm(n_conv, fabric, f"c{n_conv}")
+                arms[name] = streams
+                cell[name] = row
+            except Exception as e:  # noqa: BLE001 - a cell is data
+                cell[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                out["dropped"].append(f"c{n_conv}/{name}")
+        if len(arms) == 2:
+            cell["token_parity"] = arms["fabric_on"] == arms["fabric_off"]
+            cell["cached_token_frac_gain"] = round(
+                cell["fabric_on"]["fleet_cached_token_frac"]
+                - cell["fabric_off"]["fleet_cached_token_frac"],
+                3,
+            )
+            cell["reprefill_token_reduction"] = round(
+                cell["fabric_off"]["target_prefill_tokens"]
+                / max(cell["fabric_on"]["target_prefill_tokens"], 1),
+                2,
+            )
+        else:
+            cell["token_parity"] = None  # unverified, not assumed
+        out["sweep"][f"c{n_conv}"] = cell
+    return out
+
+
 def bench_kv_quant_ab(
     cfg,
     params,
@@ -3005,6 +3211,7 @@ SUMMARY_REQUIRED_KEYS = (
     "prefill_ab",
     "prefix_cache_ab",
     "prefix_cache_hier",
+    "kv_fabric_ab",
     "kv_quant_ab",
     "weight_quant_ab",
     "trace_overhead_ab",
@@ -3025,6 +3232,7 @@ def build_summary(
     prefill_ab=None,
     prefix_cache_ab=None,
     prefix_cache_hier=None,
+    kv_fabric_ab=None,
     kv_quant_ab=None,
     weight_quant_ab=None,
     trace_overhead_ab=None,
@@ -3065,6 +3273,7 @@ def build_summary(
         "prefill_ab": prefill_ab,
         "prefix_cache_ab": prefix_cache_ab,
         "prefix_cache_hier": prefix_cache_hier,
+        "kv_fabric_ab": kv_fabric_ab,
         "kv_quant_ab": kv_quant_ab,
         "weight_quant_ab": weight_quant_ab,
         "trace_overhead_ab": trace_overhead_ab,
@@ -3849,6 +4058,27 @@ def main():
         ),
     )
 
+    # fleet-wide KV fabric A/B: session-migration replay on a 2-server
+    # in-process fleet, cross-server prefix pull on vs off — fleet
+    # cached_token_frac, target re-prefill tokens (>=2x reduction bar),
+    # pull bytes, greedy parity as data.  Runs off-TPU too — tiny
+    # shapes — so the summary always carries the acceptance numbers.
+    mark("kv fabric A/B")
+    kv_fabric_ab = _section(
+        bench_kv_fabric_ab,
+        cfg,
+        gen_params,
+        name="kv_fabric_ab",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                counts=(2,), turns=2, prompt_len=48, user_len=8,
+                max_new=8, page=16, chunk=16,
+            )
+        ),
+    )
+
     # quantized KV cache A/B: fp vs int8 paged pools at equal budgets —
     # blocks-per-HBM-byte gain, decode tok/s, max rows at a fixed byte
     # budget, prefix-cache cached_token_frac at equal HBM, and the
@@ -4186,6 +4416,7 @@ def main():
         prefill_ab=prefill_ab,
         prefix_cache_ab=prefix_cache_ab,
         prefix_cache_hier=prefix_cache_hier,
+        kv_fabric_ab=kv_fabric_ab,
         kv_quant_ab=kv_quant_ab,
         weight_quant_ab=weight_quant_ab,
         trace_overhead_ab=trace_overhead_ab,
@@ -4250,6 +4481,7 @@ def main():
                     "prefix_reuse": prefix_reuse,
                     "prefix_cache_ab": prefix_cache_ab,
                     "prefix_cache_hier": prefix_cache_hier,
+                    "kv_fabric_ab": kv_fabric_ab,
                     "kv_quant_ab": kv_quant_ab,
                     "weight_quant_ab": weight_quant_ab,
                     "trace_overhead_ab": trace_overhead_ab,

@@ -324,6 +324,23 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
             },
             "dropped": [],
         },
+        kv_fabric_ab={
+            "sweep": {
+                "c8": {
+                    "fabric_on": {
+                        "fleet_cached_token_frac": 0.58,
+                        "target_prefill_tokens": 900,
+                    },
+                    "fabric_off": {
+                        "fleet_cached_token_frac": 0.21,
+                        "target_prefill_tokens": 4100,
+                    },
+                    "token_parity": True,
+                    "reprefill_token_reduction": 4.56,
+                }
+            },
+            "dropped": [],
+        },
         trace_overhead_ab=None,
         spec_decode_ab=spec_ab,
         train_packing_ab={
@@ -376,6 +393,14 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
         > hier["host_off"]["cached_token_frac"]
     )
     assert blob["prefix_cache_hier"]["dropped"] == []
+    fab = blob["kv_fabric_ab"]["sweep"]["c8"]
+    assert fab["token_parity"] is True
+    assert (
+        fab["fabric_on"]["fleet_cached_token_frac"]
+        > fab["fabric_off"]["fleet_cached_token_frac"]
+    )
+    assert fab["reprefill_token_reduction"] >= 2.0
+    assert blob["kv_fabric_ab"]["dropped"] == []
     assert blob["weight_swap_ab"]["dense"]["staged_pause_ms"] < (
         blob["weight_swap_ab"]["dense"]["full_pause_ms"]
     )

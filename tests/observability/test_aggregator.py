@@ -40,6 +40,13 @@ def three_live_workers():
         1536.0, server="10.0.0.1:1"
     )
     gsm.counter("areal_gserver_prefill_sheds_total").inc(2)
+    # fleet KV fabric: directory size + pull routes + reasoned
+    # invalidations on the manager
+    gsm.gauge("areal_gserver_kv_fabric_directory_entries").set(5)
+    gsm.counter("areal_gserver_kv_fabric_pull_routes_total").inc(3)
+    gsm.counter("areal_gserver_kv_fabric_invalidations_total").inc(
+        2, reason="flush"
+    )
 
     trainer = MetricsRegistry()
     trainer.histogram("areal_train_step_seconds").observe(1.5, model="actor")
@@ -84,6 +91,12 @@ def three_live_workers():
     gen.counter("areal_inference_handoff_segment_exports_total").inc(7)
     gen.counter("areal_inference_handoff_segment_imports_total").inc(6)
     gen.counter("areal_inference_handoff_segment_aborts_total").inc(1)
+    # fleet KV fabric: peer-pull volume + a reasoned fail-closed reject
+    gen.counter("areal_inference_prefix_peer_pulls_total").inc(2)
+    gen.counter("areal_inference_prefix_peer_pull_bytes_total").inc(4096)
+    gen.counter(
+        "areal_inference_prefix_peer_pull_rejects_total"
+    ).inc(1, reason="version")
 
     servers = []
     for wname, reg in (
@@ -276,6 +289,50 @@ def test_discovers_and_scrapes_three_live_workers(
     assert (
         flat["cluster/gserver_manager/areal_gserver_prefill_sheds_total"]
         == 2.0
+    )
+    # the fleet KV fabric families survive the scrape cycle: directory
+    # gauge + route/invalidation counters on the manager, peer-pull
+    # volume + reasoned rejects on the gen server
+    assert (
+        flat[
+            "cluster/gserver_manager/"
+            "areal_gserver_kv_fabric_directory_entries"
+        ]
+        == 5.0
+    )
+    assert (
+        flat[
+            "cluster/gserver_manager/"
+            "areal_gserver_kv_fabric_pull_routes_total"
+        ]
+        == 3.0
+    )
+    assert (
+        flat[
+            "cluster/gserver_manager/"
+            "areal_gserver_kv_fabric_invalidations_total{reason=flush}"
+        ]
+        == 2.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/areal_inference_prefix_peer_pulls_total"
+        ]
+        == 2.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_prefix_peer_pull_bytes_total"
+        ]
+        == 4096.0
+    )
+    assert (
+        flat[
+            "cluster/gen_server_0/"
+            "areal_inference_prefix_peer_pull_rejects_total{reason=version}"
+        ]
+        == 1.0
     )
     # histogram buckets are dropped from the flat view (sum/count kept)
     assert not any("_bucket" in k for k in flat)

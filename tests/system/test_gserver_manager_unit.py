@@ -74,21 +74,46 @@ def test_registration_value_round_trip():
     )
 
     v = format_server_registration("10.0.0.1:5555", MeshSpec(model=2, expert=2))
-    addr, devices, spec, role = parse_server_registration(v)
+    addr, devices, spec, role, transport = parse_server_registration(v)
     assert addr == "10.0.0.1:5555"
     assert devices == 4
     assert MeshSpec.from_str(spec) == MeshSpec(model=2, expert=2)
     assert role == "unified"  # role-less registrations parse unified
+    assert transport == "host-numpy"  # legacy = host-numpy transport
     assert parse_server_registration("10.0.0.2:80") == (
-        "10.0.0.2:80", 1, "", "unified"
+        "10.0.0.2:80", 1, "", "unified", "host-numpy"
     )
     # role round trip (the P/D registration knob)
     vp = format_server_registration(
         "10.0.0.3:90", MeshSpec(model=2), role="prefill"
     )
     assert parse_server_registration(vp) == (
-        "10.0.0.3:90", 2, str(MeshSpec(model=2)), "prefill"
+        "10.0.0.3:90", 2, str(MeshSpec(model=2)), "prefill", "host-numpy"
     )
+    # transport capability round trip, with and without a role token —
+    # the parser scans trailing tokens against both vocabularies, so
+    # order and omission both work (legacy wire compatibility)
+    vt = format_server_registration(
+        "10.0.0.4:91", MeshSpec(model=2), transport="tpu-d2d"
+    )
+    assert "|tpu-d2d" in vt and "|unified" not in vt
+    assert parse_server_registration(vt) == (
+        "10.0.0.4:91", 2, str(MeshSpec(model=2)), "unified", "tpu-d2d"
+    )
+    vrt = format_server_registration(
+        "10.0.0.5:92", MeshSpec(model=2), role="decode",
+        transport="tpu-d2d",
+    )
+    assert parse_server_registration(vrt) == (
+        "10.0.0.5:92", 2, str(MeshSpec(model=2)), "decode", "tpu-d2d"
+    )
+    # the DEFAULT transport is never emitted: a host-numpy fleet's
+    # registration values are byte-identical to the pre-fabric wire
+    assert v.count("|") == 2
+    with pytest.raises(ValueError):
+        format_server_registration(
+            "10.0.0.6:93", MeshSpec(model=2), transport="carrier-pigeon"
+        )
 
 
 def test_least_requests_weighs_mesh_devices():
@@ -633,6 +658,202 @@ def test_staged_commit_failure_keeps_version_and_resumes():
     assert m._model_version == 0  # barrier failed: no bump
     for c in m._clients.values():
         assert c.cmds()[-1] == "resume"
+
+
+# -- fleet KV fabric: directory, hints, invalidation --------------------------
+
+
+def test_init_runtime_state_covers_fabric_and_backlog():
+    """Satellite regression: hand-built managers (dryrun, these tests)
+    get the FULL runtime state at _init_metrics time — no lazily-inited
+    attribute is left for a hot-path hasattr to discover."""
+    m = _manager()
+    for attr in (
+        "_prefill_backlog",
+        "_prefill_backlog_local",
+        "_prefill_backlog_ts",
+        "_fabric_stamp",
+        "_server_flush_epoch",
+        "_fabric_scrape_misses",
+        "_fabric_scrape_ts",
+    ):
+        assert hasattr(m, attr), attr
+    # idempotent: a pre-seeded map survives a second call
+    m._fabric_stamp[("g", "s0")] = (0, 0)
+    m._init_runtime_state()
+    assert m._fabric_stamp == {("g", "s0"): (0, 0)}
+
+
+def _fabric_session(m, prompt_len=500, turn=0):
+    """Route one turn of a conversation; returns the owning server.
+    Distinct turns get distinct qids (a repeated qid is sticky and
+    skips the cache-aware record)."""
+    return m._schedule(f"fab@t{turn}-0", prompt_len=prompt_len,
+                       new_token_budget=100)
+
+
+def test_kv_source_hint_names_longer_stamped_owner():
+    m = _manager(policy="least_token_usage")
+    t0 = _fabric_session(m, prompt_len=500)
+    other = next(a for a in m.server_addrs if a != t0)
+    # routed elsewhere, the directory names t0 as the pull source
+    assert m._kv_source_hint("fab@t1-0", other, 900) == t0
+    # ...but never itself
+    assert m._kv_source_hint("fab@t1-0", t0, 900) is None
+
+
+def test_kv_source_hint_respects_floor_and_own_prefix():
+    m = _manager(policy="least_token_usage",
+                 kv_fabric_min_prefix_tokens=256)
+    t0 = _fabric_session(m, prompt_len=100)  # below the 256 floor
+    other = next(a for a in m.server_addrs if a != t0)
+    assert m._kv_source_hint("fab@t1-0", other, 900) is None
+    # above the floor but the target's OWN record is just as long:
+    # pulling saves nothing over its local radix hit
+    m._group_prefix["fab"] = {t0: 500.0, other: 500.0}
+    m._fabric_stamp[("fab", t0)] = (0, 0)
+    assert m._kv_source_hint("fab@t1-0", other, 900) is None
+
+
+def test_kv_source_hint_fails_closed_on_stamp_skew():
+    """A directory entry whose owner moved on — weight version bump or
+    scraped cache flush — must never be advertised."""
+    m = _manager(policy="least_token_usage")
+    t0 = _fabric_session(m, prompt_len=500)
+    other = next(a for a in m.server_addrs if a != t0)
+    assert m._kv_source_hint("fab@t1-0", other, 900) == t0
+    m._model_version = 1  # version skew
+    assert m._kv_source_hint("fab@t1-0", other, 900) is None
+    m._model_version = 0
+    m._server_flush_epoch[t0] = 3.0  # epoch skew (owner flushed)
+    assert m._kv_source_hint("fab@t1-0", other, 900) is None
+
+
+def test_kv_source_hint_requires_matching_transport():
+    m = _manager(policy="least_token_usage")
+    t0 = _fabric_session(m, prompt_len=500)
+    other = next(a for a in m.server_addrs if a != t0)
+    m._server_transport = {t0: "tpu-d2d", other: "host-numpy"}
+    assert m._kv_source_hint("fab@t1-0", other, 900) is None
+    m._server_transport[other] = "tpu-d2d"
+    assert m._kv_source_hint("fab@t1-0", other, 900) == t0
+
+
+def test_kv_source_hint_longest_prefix_wins_deterministically():
+    m = _manager(policy="least_token_usage")
+    m._group_prefix["fab"] = {"s0": 500.0, "s1": 800.0}
+    m._fabric_stamp[("fab", "s0")] = (0, 0)
+    m._fabric_stamp[("fab", "s1")] = (0, 0)
+    assert m._kv_source_hint("fab@t1-0", "s2", 900) == "s1"
+    # equal lengths: sorted-address order breaks the tie
+    m._group_prefix["fab"]["s0"] = 800.0
+    assert m._kv_source_hint("fab@t1-0", "s2", 900) == "s0"
+
+
+def test_kv_fabric_off_emits_no_hint():
+    m = _manager(policy="least_token_usage", kv_fabric=False)
+    t0 = _fabric_session(m, prompt_len=500)
+    other = next(a for a in m.server_addrs if a != t0)
+    assert m._kv_source_hint("fab@t1-0", other, 900) is None
+
+
+def test_schedule_request_emits_kv_source_on_session_migration():
+    """End to end: the imbalance escape re-routes a session, and the
+    schedule response names the old server as the pull source (counted
+    + the directory entry survives for the pull)."""
+    m = _manager(
+        policy="least_token_usage",
+        affinity_imbalance_factor=1.5,
+        affinity_imbalance_slack_tokens=100.0,
+    )
+    t0 = m._schedule("mig@t0-0", prompt_len=500, new_token_budget=100)
+    m._server_tokens[t0] += 50_000.0  # force the escape hatch
+    base = m._m_fabric_routes.value()
+    r = m._schedule_request("mig@t1-0", prompt_len=900,
+                            new_token_budget=100)
+    assert r["url"] != t0
+    assert r["kv_source"] == t0
+    assert m._m_fabric_routes.value() == base + 1
+
+
+def test_weight_update_clears_prefix_affinity_and_directory():
+    """Satellite fix: a weight update flushes every server's cache, so
+    the hot-prefix sums and the fabric directory must clear with it —
+    stale sums would pin sessions to servers with empty caches.  Plain
+    group affinity and resident-token load survive (live-row state)."""
+    m = _staged_manager()
+    m._clients = {a: _FakeClient() for a in m.server_addrs}
+    t0 = m._schedule("aff@t0-0", prompt_len=500, new_token_budget=100)
+    assert m._group_prefix["aff"] == {t0: 500.0}
+    assert m._fabric_stamp == {("aff", t0): (0, 0)}
+    toks = m._server_tokens[t0]
+    m._flush_and_update(_update_info(version=5))
+    assert m._model_version == 5
+    assert m._group_prefix["aff"] == {}  # sums cleared
+    assert m._fabric_stamp == {}  # directory cleared
+    assert m._group_server["aff"] == t0  # plain affinity survives
+    assert m._server_tokens[t0] == toks  # live-row load survives
+    assert (
+        m._m_fabric_invalidations.value(reason="weight_update") == 1.0
+    )
+
+
+def test_failed_weight_update_keeps_affinity():
+    """No version bump -> the caches were NOT flushed: the directory
+    and the hot-prefix sums must stay routable."""
+    m = _legacy_manager()
+    m._clients = {"s0": _FakeClient(always_error=True)}
+    t0 = m._schedule("keep@t0-0", prompt_len=500, new_token_budget=100)
+    m._flush_and_update(_update_info(version=5))
+    assert m._model_version == 0
+    assert m._group_prefix["keep"] == {t0: 500.0}
+    assert m._fabric_stamp == {("keep", t0): (0, 0)}
+
+
+class _DoneFut:
+    def __init__(self, res):
+        self._res = res
+
+    def done(self):
+        return True
+
+    def result(self):
+        return self._res
+
+
+def test_fabric_epoch_scrape_invalidates_on_flush_and_death():
+    """Harvest semantics of the background epoch scrape: an epoch BUMP
+    drops the server's directory entries (it flushed since the last
+    look); _FABRIC_DEATH_MISSES consecutive failed scrapes do too."""
+    import time as _time
+
+    from areal_tpu.system.gserver_manager import _FABRIC_DEATH_MISSES
+
+    m = _manager(policy="least_token_usage")
+    m._clients = {a: _FakeClient() for a in m.server_addrs}
+    m._fabric_scrape_ts = _time.monotonic() + 1e9  # never re-submit
+    t0 = _fabric_session(m, prompt_len=500)
+    # first scrape establishes the baseline epoch; entry survives
+    m._fabric_scrape_fut = _DoneFut({t0: 2.0})
+    m._refresh_fabric_epochs()
+    assert ("fab", t0) in m._fabric_stamp
+    # stamp was recorded at epoch 0, scrape says 2.0: hint fails closed
+    other = next(a for a in m.server_addrs if a != t0)
+    assert m._kv_source_hint("fab@t9-0", other, 900) is None
+    # re-record under the current epoch, then a BUMP invalidates
+    assert _fabric_session(m, prompt_len=500, turn=1) == t0  # affine
+    assert m._kv_source_hint("fab@t9-0", other, 900) == t0
+    m._fabric_scrape_fut = _DoneFut({t0: 3.0})
+    m._refresh_fabric_epochs()
+    assert ("fab", t0) not in m._fabric_stamp
+    assert m._m_fabric_invalidations.value(reason="flush") >= 1.0
+    # death: consecutive misses
+    _fabric_session(m, prompt_len=500, turn=2)
+    for _ in range(_FABRIC_DEATH_MISSES):
+        m._fabric_scrape_fut = _DoneFut({t0: None})
+        m._refresh_fabric_epochs()
+    assert ("fab", t0) not in m._fabric_stamp
+    assert m._m_fabric_invalidations.value(reason="death") >= 1.0
 
 
 def test_staged_disabled_for_hf_format_checkpoints():

@@ -106,7 +106,7 @@ def test_multihost_tp_generation(cluster):
 
     from areal_tpu.system.generation_server import parse_server_registration
 
-    addr, _devices, _spec, _role = parse_server_registration(reg)
+    addr = parse_server_registration(reg)[0]
     client = GenServerClient(addr, timeout=180.0)
     out = client.generate(
         APIGenerateInput(

@@ -163,7 +163,7 @@ def test_prefill_pick_least_backlog_per_chip():
     """The pick is backlog PER CHIP: a 2-chip prefill mesh absorbs 2x
     the backlog of a 1-chip one before looking busier."""
     m = _pd2_manager(policy="least_token_usage")
-    m._ensure_backlog_state()
+    m._init_runtime_state()
     m._prefill_backlog.update({"s0": 1000.0, "s3": 1500.0})
     m._prefill_backlog_ts = 1e18  # freeze: no scrape (no clients)
     r = m._schedule_request("b0-0", prompt_len=64, new_token_budget=8)
@@ -175,7 +175,7 @@ def test_prefill_pick_least_backlog_per_chip():
 
 def test_prefill_local_increments_spread_a_burst():
     m = _pd2_manager(policy="least_token_usage")
-    m._ensure_backlog_state()
+    m._init_runtime_state()
     m._prefill_backlog_ts = 1e18
     picks = [
         m._schedule_request(f"b{i}-0", prompt_len=100, new_token_budget=4)[
@@ -196,7 +196,7 @@ def test_prefill_saturation_sheds_to_decode_owner():
         policy="least_token_usage",
         prefill_saturation_tokens_per_chip=500,
     )
-    m._ensure_backlog_state()
+    m._init_runtime_state()
     m._prefill_backlog.update({"s0": 5000.0, "s3": 5000.0})
     m._prefill_backlog_ts = 1e18
     base = m._m_prefill_sheds.value()
@@ -839,7 +839,7 @@ def test_pd_fleet_e2e_over_worker_rpc(monkeypatch, tmp_path):
             parse_server_registration,
         )
 
-        p_addr, _, _, p_role = parse_server_registration(reg)
+        p_addr, _, _, p_role, _ = parse_server_registration(reg)
         assert p_role == "prefill"
         p_metrics = GenServerClient(p_addr, timeout=10.0).call(
             "metrics", {}
