@@ -121,6 +121,11 @@ class RolloutWorkerConfig:
     dataset_seed: int = 1
     rollout_request_timeout: float = 600.0
     new_tokens_per_chunk: int = 1 << 30  # interruptible-generation chunking
+    # SLO/tenant label this worker's traffic carries end-to-end: it
+    # lands in LatencyRecord.workload (fleet-merged per-workload
+    # percentile rows) and charges the matching admission-plane tenant.
+    # Default: the bulk rollout tenant.
+    workload: str = "rollout"
     trace: Optional[TraceConfig] = None
 
 
@@ -401,6 +406,34 @@ class GserverManagerConfig:
     # minimum advertised prefix length (tokens) worth a pull hint — the
     # fleet-side floor mirroring the engine's prefix_pull_min_tokens
     kv_fabric_min_prefix_tokens: int = 256
+    # per-tenant admission policies (gateway/admission.TenantPolicy rows
+    # or plain dicts of their fields): priority class, token-bucket rate
+    # limit, cumulative token budget.  Unknown tenants run under the
+    # permissive interactive default; rollout traffic charges the
+    # "rollout" tenant.  Empty = admit everything.
+    tenants: List = dataclasses.field(default_factory=list)
+    trace: Optional[TraceConfig] = None
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    """The OpenAI-style HTTP/SSE serving gateway (gateway/server.py):
+    one front-door worker per fleet, scheduling through the gserver
+    manager and streaming tokens off the gen servers' harvest
+    streams."""
+
+    worker_name: str = "gateway"
+    host: str = "0.0.0.0"
+    port: int = 8081
+    # tenant attributed to requests carrying neither an x-tenant header
+    # nor a body ``user`` field
+    default_tenant: str = "anonymous"
+    # byte-codec vocab for string prompts (see gateway/sse.py); set to
+    # the serving model's vocab size
+    vocab_size: int = 256
+    max_new_tokens_cap: int = 1024
+    request_timeout_s: float = 600.0
+    poll_interval_s: float = 0.002
     trace: Optional[TraceConfig] = None
 
 

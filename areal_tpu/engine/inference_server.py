@@ -861,6 +861,30 @@ class ContinuousBatchingEngine:
             "tpot_s": LatencyDigest(),
             "stall_s": LatencyDigest(),
         }
+        # gateway token streams: per-qid incremental harvest queues,
+        # fed at chunk-fold time (_harvest_oldest) plus the two
+        # first-token sites (dense admit, paged fill distribution) and
+        # drained by the gen-server worker into SSE frames.  The deque
+        # is the ISSUE's bounded queue: SPMD follower controllers open
+        # streams too (submit rides the command batch) but never drain
+        # them, so their buffers cap out harmlessly — dropped tokens on
+        # a follower are never read; the leader drains promptly.
+        self._streams: Dict[str, Dict[str, Any]] = {}
+        self.stream_buffer_cap = 4096
+        # step-keyed staleness (never wall clock — SPMD determinism):
+        # a stream nobody polled for this many steps is auto-cancelled
+        # by the leader (dead gateway client backstop)
+        self.stream_stale_steps = 2048
+        self.streams_opened_total = 0
+        self.stream_dropped_total = 0
+        self.cancelled_total = 0
+        # pool-pressure evictions split by the victim's priority class
+        # (interactive vs bulk — the admission plane's classes)
+        self.preempted_by_class: Dict[str, int] = {}
+        # cancels that arrived while the target row was mid-fill (its
+        # blocks belong to the fill machinery); retried each step after
+        # _advance_fill
+        self._cancel_wanted: set = set()
 
     # -- paged-cache state --------------------------------------------------
 
@@ -2186,6 +2210,13 @@ class ContinuousBatchingEngine:
             self._result_events[req.qid] = ev
             if self._slo_enabled:
                 self._submit_ts[req.qid] = time.monotonic()
+            if (req.metadata or {}).get("stream"):
+                self._streams[req.qid] = {
+                    "toks": deque(maxlen=self.stream_buffer_cap),
+                    "drain_step": self._step_seq,
+                    "dropped": 0,
+                }
+                self.streams_opened_total += 1
         return req.qid
 
     # -- request-level SLO plane ---------------------------------------------
@@ -2298,7 +2329,148 @@ class ContinuousBatchingEngine:
             self._results.clear()
             for qid in out:
                 self._result_events.pop(qid, None)
+                # follower controllers never poll streams: prune each
+                # finished request's buffer with its discarded result
+                self._streams.pop(qid, None)
         return out
+
+    # -- gateway token streams + cancel --------------------------------------
+
+    def _stream_push(self, row: _Row, toks: List[int]):
+        """Feed a row's freshly-folded tokens into its gateway stream
+        (no-op for non-streaming requests — one dict miss)."""
+        if not toks:
+            return
+        with self._lock:
+            st = self._streams.get(row.req.qid)
+            if st is None:
+                return
+            q = st["toks"]
+            before = len(q)
+            q.extend(int(t) for t in toks)
+            dropped = before + len(toks) - len(q)
+            if dropped > 0:  # bounded buffer overflowed (undrained)
+                st["dropped"] += dropped
+                self.stream_dropped_total += dropped
+
+    def drain_stream(self, qid: str) -> Optional[List[int]]:
+        """Pop a stream's buffered tokens (None = unknown/closed stream).
+        Read-only from the SPMD view — safe on the leader off the
+        command batch, like metrics."""
+        with self._lock:
+            st = self._streams.get(qid)
+            if st is None:
+                return None
+            st["drain_step"] = self._step_seq
+            out = list(st["toks"])
+            st["toks"].clear()
+            return out
+
+    def stream_close(self, qid: str):
+        with self._lock:
+            self._streams.pop(qid, None)
+
+    def stale_stream_qids(self) -> List[str]:
+        """Streams nobody drained for ``stream_stale_steps`` engine steps
+        (step-keyed, never wall clock): the leader turns these into
+        cancel commands — the dead-gateway-client backstop."""
+        with self._lock:
+            return [
+                qid for qid, st in self._streams.items()
+                if self._step_seq - st["drain_step"]
+                > self.stream_stale_steps
+            ]
+
+    def stream_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "open_streams": len(self._streams),
+                "opened_total": self.streams_opened_total,
+                "dropped_tokens_total": self.stream_dropped_total,
+                "cancelled_total": self.cancelled_total,
+            }
+
+    def _finalize_cancel(self, qid: str):
+        with self._lock:
+            self._results.pop(qid, None)
+            self._result_events.pop(qid, None)
+            self._submit_ts.pop(qid, None)
+            self._streams.pop(qid, None)
+        self._cancel_wanted.discard(qid)
+        self.cancelled_total += 1
+        self.tracer.event(qid, "engine.cancel", step=self._step_seq)
+
+    def cancel(self, qid: str) -> bool:
+        """Cancel a request wherever it lives — pending, preempted,
+        decoding, parked, or finished-but-uncollected — releasing every
+        block it pins (the disconnect leak audit rides on this).
+
+        MUST be called from the engine-stepping thread: cancelling an
+        active row rewrites the pool, so under SPMD it rides the
+        command batch like submit (every controller replays it at the
+        same step).  A mid-fill row defers into ``_cancel_wanted`` and
+        is retried after ``_advance_fill`` each step."""
+        # pending: never admitted, nothing on device
+        with self._lock:
+            for i, req in enumerate(self._pending):
+                if req.qid == qid:
+                    self._pending.pop(i)
+                    break
+            else:
+                req = None
+        if req is not None:
+            self._finalize_cancel(qid)
+            return True
+        # preempted: host-side row awaiting re-admission
+        if self.paged:
+            for i, row in enumerate(self._preempted):
+                if row.req.qid == qid:
+                    self._preempted.pop(i)
+                    self._finalize_cancel(qid)
+                    return True
+        for row_id, row in enumerate(self.rows):
+            if row is None or row.req.qid != qid:
+                continue
+            if row.filling:
+                # the fill machinery owns this row's blocks mid-prefill;
+                # retried next step once the fill completes or dies
+                self._cancel_wanted.add(qid)
+                return True
+            if not row.parked:
+                # fold every in-flight chunk first: the ring snapshots
+                # reference this row (same flush as preemption)
+                self._drain_ring()
+                row = self.rows[row_id]
+                if row is None or row.req.qid != qid:
+                    # finished (or slot reused) during the drain
+                    self._finalize_cancel(qid)
+                    return True
+                if row.filling:
+                    self._cancel_wanted.add(qid)
+                    return True
+            if not row.parked:
+                self.active = self.active.at[row_id].set(False)
+            self._release_row(row_id)
+            self._finalize_cancel(qid)
+            return True
+        # already finished (result awaiting pickup) or residual state
+        with self._lock:
+            known = (
+                qid in self._results
+                or qid in self._result_events
+                or qid in self._streams
+            )
+        if known:
+            self._finalize_cancel(qid)
+            return True
+        return False
+
+    def _process_deferred_cancels(self):
+        if not self._cancel_wanted:
+            return
+        for qid in list(self._cancel_wanted):
+            self._cancel_wanted.discard(qid)
+            self.cancel(qid)  # re-defers itself if still mid-fill
 
     def update_weights(
         self,
@@ -2966,6 +3138,7 @@ class ContinuousBatchingEngine:
                 row.logprobs = [float(logp)]
                 row.filling = False
                 self._slo_first_token(row, now=t_first)
+                self._stream_push(row, [int(tok_i)])
                 plen = len(f.tokens)
                 if tok_i in self.stop_tokens or tgt.max_new <= 1:
                     row.no_eos = tok_i not in self.stop_tokens
@@ -3244,18 +3417,31 @@ class ContinuousBatchingEngine:
                     self.blocks_per_row,
                 )
 
+    def _row_priority(self, row: _Row) -> str:
+        """The admission plane's priority class, stamped into request
+        metadata by the gateway/manager; unlabeled traffic is bulk.
+        Metadata rides the SPMD command batch, so every controller
+        computes the same class."""
+        return str((row.req.metadata or {}).get("priority_class", "bulk"))
+
     def _pick_preemption_victim(self, exclude: int) -> Optional[int]:
-        """Youngest active row (highest epoch) — deterministic, and the
-        youngest has the least cached work to throw away."""
-        best, best_epoch = None, -1
+        """Priority-aware: the youngest (highest-epoch) BULK row first —
+        bulk rollout rows yield to interactive chat rows under pool
+        pressure; an interactive row is evicted only when no bulk
+        candidate exists.  Within a class the youngest has the least
+        cached work to throw away.  Deterministic (epochs + metadata
+        are identical on every SPMD controller)."""
+        best, best_key = None, (-1, -1)
         for row_id, row in enumerate(self.rows):
             if (
                 row is None or row.parked or row.filling
                 or row_id == exclude
             ):
                 continue
-            if row.epoch > best_epoch:
-                best, best_epoch = row_id, row.epoch
+            is_bulk = 0 if self._row_priority(row) == "interactive" else 1
+            key = (is_bulk, row.epoch)
+            if key > best_key:
+                best, best_key = row_id, key
         return best
 
     def _preempt_row(self, row_id: int):
@@ -3274,6 +3460,10 @@ class ContinuousBatchingEngine:
             row.t_preempt = time.monotonic()  # stall until re-activation
         self._preempted.append(row)
         self.preempted_total += 1
+        cls = self._row_priority(row)
+        self.preempted_by_class[cls] = (
+            self.preempted_by_class.get(cls, 0) + 1
+        )
         self.tracer.event(
             row.req.qid, "engine.preempt", row=row_id,
             cached_tokens=len(row.prompt) + len(row.generated),
@@ -3607,6 +3797,7 @@ class ContinuousBatchingEngine:
             )
             self._slo_admitted(row, now=t_admit)
             self._slo_first_token(row, now=t_first)
+            self._stream_push(row, [int(tok_i)])
             if tok_i in self.stop_tokens or max_new <= 1:
                 row.no_eos = tok_i not in self.stop_tokens
                 self._finish(row_id, row, started=False)
@@ -3837,6 +4028,7 @@ class ContinuousBatchingEngine:
                     accepted=n_acc, emitted=len(toks),
                 )
             if toks:
+                self._stream_push(row, toks)
                 self.tracer.event(
                     row.req.qid, "engine.chunk", row=row_id,
                     epoch=epoch, n_tokens=len(toks), step=self._step_seq,
@@ -3927,6 +4119,7 @@ class ContinuousBatchingEngine:
             if self.paged:
                 self._admit_paged()
                 self._advance_fill()
+                self._process_deferred_cancels()
                 self._ensure_decode_blocks()
                 dispatched = False
                 if (

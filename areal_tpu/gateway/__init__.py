@@ -1,0 +1,9 @@
+"""Streaming multi-tenant serving gateway.
+
+An OpenAI-style HTTP/SSE front door over the generation-server fleet
+(``server.py``) plus the per-tenant admission plane the gserver manager
+enforces at allocate/schedule time (``admission.py``).  Submodules are
+imported lazily by consumers — this package intentionally has no eager
+imports so the admission plane (pure Python, no jax/zmq) stays cheap to
+pull into the manager and unit tests.
+"""

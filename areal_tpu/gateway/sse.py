@@ -1,0 +1,96 @@
+"""Server-Sent-Events framing + the gateway's token<->text codec.
+
+The wire protocol is the OpenAI streaming dialect: each chunk is one
+``data: <json>\\n\\n`` frame, the final content frame carries the
+``finish_reason`` and a ``usage`` block, and the stream terminates with
+the literal ``data: [DONE]`` sentinel.  ``iter_sse_events`` is the
+client-side parser the conformance tests (and any Python consumer)
+drive against a readable byte stream.
+
+Text codec: the reproduction has no HF tokenizer on the serving image,
+so the gateway speaks TOKEN IDS natively (OpenAI's ``prompt`` field
+legitimately accepts token arrays) and falls back to a reversible
+byte-level codec for string prompts/messages — each UTF-8 byte maps to
+one token id modulo the serving vocab.  Real deployments swap
+``encode_text``/``decode_tokens`` for a tokenizer; everything else is
+codec-agnostic.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterator, List
+
+DONE_SENTINEL = "[DONE]"
+
+
+def sse_frame(payload: Any) -> bytes:
+    """One SSE frame: ``data: <json>`` + blank-line terminator (the
+    ``[DONE]`` sentinel is passed through as a bare string)."""
+    if isinstance(payload, str):
+        body = payload
+    else:
+        body = json.dumps(payload, separators=(",", ":"))
+    return f"data: {body}\n\n".encode()
+
+
+def sse_done() -> bytes:
+    return sse_frame(DONE_SENTINEL)
+
+
+def iter_sse_events(stream) -> Iterator[Any]:
+    """Parse ``data:`` frames off a readable byte stream, yielding
+    decoded JSON objects; the ``[DONE]`` sentinel yields the literal
+    string ``"[DONE]"`` and ends iteration."""
+    buf = b""
+    while True:
+        chunk = stream.read(1)
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n\n" in buf:
+            raw, buf = buf.split(b"\n\n", 1)
+            for line in raw.splitlines():
+                if not line.startswith(b"data:"):
+                    continue
+                body = line[len(b"data:"):].strip().decode()
+                if body == DONE_SENTINEL:
+                    yield DONE_SENTINEL
+                    return
+                yield json.loads(body)
+
+
+def encode_text(text: str, vocab_size: int) -> List[int]:
+    """Byte-level fallback encoding for string prompts (reversible when
+    ``vocab_size >= 256``; degraded-but-deterministic below that)."""
+    return [b % max(1, vocab_size) for b in text.encode("utf-8")]
+
+
+def decode_tokens(tokens: List[int]) -> str:
+    """Inverse of :func:`encode_text` for byte-range ids; out-of-range
+    ids render as ``<id>`` placeholders so streams stay lossless to
+    read even under a tiny test vocab."""
+    parts = []
+    run: List[int] = []
+
+    def flush():
+        if run:
+            parts.append(bytes(run).decode("utf-8", errors="replace"))
+            run.clear()
+
+    for t in tokens:
+        if 0 <= t < 256:
+            run.append(t)
+        else:
+            flush()
+            parts.append(f"<{t}>")
+    flush()
+    return "".join(parts)
+
+
+def usage_block(prompt_tokens: int, completion_tokens: int) -> Dict[str, int]:
+    return {
+        "prompt_tokens": int(prompt_tokens),
+        "completion_tokens": int(completion_tokens),
+        "total_tokens": int(prompt_tokens) + int(completion_tokens),
+    }

@@ -532,6 +532,40 @@ METRIC_TABLE = [
         "(staged | full)",
         ("mode",),
     ),
+    # -- serving gateway (gateway/server.py + admission plane) ---------------
+    MetricSpec(
+        "areal_gateway_requests_total",
+        "counter",
+        "HTTP requests received at the gateway front door "
+        "(/v1/completions + /v1/chat/completions, streaming or not)",
+    ),
+    MetricSpec(
+        "areal_gateway_streams_total",
+        "counter",
+        "SSE streaming responses started at the gateway",
+    ),
+    MetricSpec(
+        "areal_gateway_active_streams",
+        "gauge",
+        "SSE streams currently open at the gateway",
+    ),
+    MetricSpec(
+        "areal_gateway_admission_rejects_total",
+        "counter",
+        "Tenant admission-plane rejects, by typed reason "
+        "(rate_limited | budget_exhausted | request_too_large) — "
+        "incremented at the gateway front door (HTTP 429/403) and at "
+        "the gserver manager's gateway_admit command",
+        ("reason",),
+    ),
+    MetricSpec(
+        "areal_gateway_preemptions_total",
+        "counter",
+        "Pool-pressure row preemptions by the victim's priority class "
+        "(interactive | bulk) — priority-aware eviction picks bulk "
+        "rollout rows before interactive gateway rows",
+        ("class",),
+    ),
     # -- master buffer (system/buffer.py) ------------------------------------
     MetricSpec(
         "areal_buffer_size",
@@ -750,6 +784,12 @@ TRACE_TABLE = [
         "event",
         "Rollout slot released at the manager (attrs: accepted)",
     ),
+    TraceSpec(
+        "gserver.gateway_admit",
+        "event",
+        "Tenant admission-plane decision for a gateway request "
+        "(attrs: tenant, ok, reason)",
+    ),
     # -- generation engine ---------------------------------------------------
     TraceSpec(
         "engine.admit",
@@ -856,6 +896,12 @@ TRACE_TABLE = [
         "event",
         "Row preempted under pool pressure (recompute-on-readmit; "
         "attrs: row, cached_tokens)",
+    ),
+    TraceSpec(
+        "engine.cancel",
+        "event",
+        "Request cancelled (gateway client disconnect or stale-stream "
+        "backstop); the row's pool blocks are released (attrs: step)",
     ),
     TraceSpec(
         "engine.recompute",

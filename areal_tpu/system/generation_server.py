@@ -405,6 +405,10 @@ class GenerationServerWorker(worker_base.Worker):
             )
         # qid -> ROUTER identity awaiting the result (leader only)
         self._waiting: Dict[str, bytes] = {}
+        # gateway streams opened but possibly not yet applied to the
+        # engine (a stream_poll can race the generate_stream's command
+        # batch by one poll cycle); leader-local bookkeeping only
+        self._open_streams: set = set()
         self._update_reply_idents = []  # clients awaiting update_weights
         self._import_reply_idents = []  # clients awaiting import_handoff
         # P/D handoff plumbing: destination decode server per in-flight
@@ -586,6 +590,13 @@ class GenerationServerWorker(worker_base.Worker):
             "areal_inference_prefix_peer_pull_rejects_total"
         )
         self._obs_pull_rejects_last: Dict[str, int] = {}
+        # pool-pressure preemptions split by the victim's priority class
+        # (the gateway admission plane's interactive/bulk split); same
+        # per-label delta-mirroring shape as the reject counters
+        self._obs_preempt_class = reg.counter(
+            "areal_gateway_preemptions_total"
+        )
+        self._obs_preempt_class_last: Dict[str, int] = {}
         self._obs_accept_hist = reg.histogram(
             "areal_inference_spec_accept_rate",
             buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
@@ -688,6 +699,12 @@ class GenerationServerWorker(worker_base.Worker):
             if delta > 0:
                 self._obs_pull_rejects.inc(delta, reason=reason)
                 self._obs_pull_rejects_last[reason] = total
+        for cls, total in eng.preempted_by_class.items():
+            delta = total - self._obs_preempt_class_last.get(cls, 0)
+            if delta > 0:
+                # "class" is a Python keyword: pass the label via **
+                self._obs_preempt_class.inc(delta, **{"class": cls})
+                self._obs_preempt_class_last[cls] = total
         for frac in eng.drain_spec_accept_samples():
             self._obs_accept_hist.observe(frac)
         for rec in eng.drain_slo_records():
@@ -738,6 +755,29 @@ class GenerationServerWorker(worker_base.Worker):
                         self._handoff_dest[payload.qid] = dest
                     batch.append((cmd, payload))
                     continue  # reply when the result is ready
+                elif cmd == "generate_stream":
+                    # gateway streaming generate: ack immediately; the
+                    # submit rides the lockstep batch with the stream
+                    # flag set (every controller opens the buffer, only
+                    # the leader drains it).  NO _waiting entry — the
+                    # final result stays parked for stream_poll to
+                    # collect instead of _reply_finished pushing it.
+                    md = dict(payload.metadata or {})
+                    md["stream"] = True
+                    payload.metadata = md
+                    self._open_streams.add(payload.qid)
+                    batch.append(("generate", payload))
+                    resp = {"ok": True, "qid": payload.qid}
+                elif cmd == "stream_poll":
+                    # read-only leader query (like ``metrics``): drain
+                    # buffered tokens + the final result when done
+                    resp = self._stream_poll(payload)
+                elif cmd == "stream_cancel":
+                    # state-mutating (releases the row's pool blocks):
+                    # rides the lockstep batch; ack immediately
+                    self._open_streams.discard(payload["qid"])
+                    batch.append((cmd, payload))
+                    resp = {"ok": True}
                 elif cmd == "import_handoff":
                     # state-mutating (a pool scatter): rides the lockstep
                     # batch like generate/update; reply after the apply
@@ -860,6 +900,10 @@ class GenerationServerWorker(worker_base.Worker):
                     self.logger.exception("prefix segment import failed")
             elif cmd == "prefix_pull_failed":
                 self.engine.prefix_pull_failed(payload["qid"])
+            elif cmd == "stream_cancel":
+                # gateway client went away (disconnect or staleness):
+                # cancel the row wherever it lives, freeing its blocks
+                self.engine.cancel(payload["qid"])
             elif cmd == "pause":
                 self.engine.pause()
             elif cmd == "resume":
@@ -1355,6 +1399,38 @@ class GenerationServerWorker(worker_base.Worker):
             return 0
         return self.engine.commit_staged(expected_version=version)
 
+    def _stream_poll(self, payload: Dict) -> Dict:
+        """One gateway poll: buffered tokens since the last poll, plus
+        the final result (and stream teardown) once the row finished.
+        Read-only from the SPMD view — answered on the leader without
+        riding the command batch, exactly like ``metrics``."""
+        qid = payload["qid"]
+        toks = self.engine.drain_stream(qid)
+        out = self.engine.try_get_result(qid)
+        if out is not None:
+            extra = self.engine.drain_stream(qid)
+            if extra:
+                toks = (toks or []) + extra
+            self.engine.stream_close(qid)
+            self._open_streams.discard(qid)
+            return {
+                "tokens": toks or [],
+                "done": True,
+                "result": {
+                    "output_ids": list(out.output_ids),
+                    "no_eos": bool(out.no_eos),
+                    "version_start": out.version_start,
+                    "version_end": out.version_end,
+                },
+            }
+        if toks is None:
+            if qid in self._open_streams:
+                # the generate_stream's command batch has not applied
+                # yet (one-poll race); nothing buffered, keep polling
+                return {"tokens": [], "done": False, "result": None}
+            return {"error": f"unknown stream {qid}"}
+        return {"tokens": toks, "done": False, "result": None}
+
     def metrics(self) -> Dict:
         return {
             "n_inflight": self.engine.n_inflight,
@@ -1430,6 +1506,11 @@ class GenerationServerWorker(worker_base.Worker):
             # raw mergeable digest state for external consumers
             "slo": self.engine.slo_stats(),
             "slo_digests": self.engine.slo_digests(),
+            # gateway token streams + priority-aware preemption split
+            "streams": self.engine.stream_stats(),
+            "cancelled_total": self.engine.cancelled_total,
+            "preempted_total": self.engine.preempted_total,
+            "preempted_by_class": dict(self.engine.preempted_by_class),
         }
 
     # -- poll ---------------------------------------------------------------
@@ -1437,6 +1518,15 @@ class GenerationServerWorker(worker_base.Worker):
     def _poll(self) -> worker_base.PollResult:
         if self._is_leader:
             batch = self._serve_api()
+            # dead-gateway-client backstop: a stream nobody drained for
+            # stream_stale_steps engine steps auto-cancels — the cancel
+            # rides THIS batch so followers release the row in lockstep
+            for qid in self.engine.stale_stream_qids():
+                self.logger.warning(
+                    "auto-cancelling stale gateway stream %s", qid
+                )
+                self._open_streams.discard(qid)
+                batch.append(("stream_cancel", {"qid": qid}))
             # fleet KV fabric: start owner RPCs for new pull intents and
             # append finished pulls' segments (or failure markers) to
             # THIS batch — they ride the publish below, so follower

@@ -13,6 +13,16 @@ The service is a ZMQ REP socket (the control plane's HTTP equivalent):
   ("allocate_rollout", {qid})            -> {"ok": bool, "reason": str}
   ("finish_rollout", {qid, accepted})    -> "ok"
   ("get_status", {})                     -> counters
+  ("gateway_admit", {tenant, tokens})    -> AdmissionDecision dict
+  ("gateway_finish", {qid, tenant, reserved_tokens, used_tokens}) -> "ok"
+  ("gateway_reset_budget", {tenant})     -> "ok"
+
+The gateway commands expose the per-tenant admission plane
+(``gateway/admission.py``): priority classes, token-bucket rate limits,
+and cumulative token budgets, enforced here at allocate/schedule time.
+Rollout traffic rides the SAME plane under a default bulk tenant (the
+``allocate_rollout`` gate), so training and serving genuinely share
+one accounting surface.
 """
 
 from __future__ import annotations
@@ -25,6 +35,7 @@ import zmq
 
 from areal_tpu.api import system_api
 from areal_tpu.base import constants, logging_, name_resolve, names, network
+from areal_tpu.gateway.admission import DEFAULT_BULK_TENANT, AdmissionPlane
 from areal_tpu.system import worker_base
 from areal_tpu.system.generation_server import GenServerClient
 
@@ -268,6 +279,13 @@ class GserverManager(worker_base.Worker):
             "areal_slo_schedule_wait_seconds", buckets=SLO_BUCKETS
         )
         self._gate_first_reject: Dict[str, float] = {}
+        # gateway admission plane: typed per-reason rejects (the same
+        # family the gateway's HTTP front door increments — one
+        # vocabulary whether a reject happened at the manager or at an
+        # in-process gateway backend)
+        self._m_gw_rejects = reg.counter(
+            "areal_gateway_admission_rejects_total"
+        )
         self._update_pool = None
 
     def _devices(self, addr: str) -> int:
@@ -364,6 +382,15 @@ class GserverManager(worker_base.Worker):
             #: _FABRIC_DEATH_MISSES the server is declared dead and its
             #: directory entries drop
             self._fabric_scrape_misses: Dict[str, int] = {}
+        if not hasattr(self, "_admission"):
+            # per-tenant admission plane: gateway requests admit through
+            # ``gateway_admit``; rollout traffic charges the default
+            # bulk tenant inside ``allocate_rollout``.  Tenant policies
+            # come from GserverManagerConfig.tenants (unknown tenants
+            # run under the permissive interactive default).
+            self._admission = AdmissionPlane.from_config(
+                getattr(getattr(self, "config", None), "tenants", ())
+            )
 
     def _refresh_prefill_backlog(self):
         """Keep the prefill-backlog estimates fresh WITHOUT ever
@@ -888,8 +915,10 @@ class GserverManager(worker_base.Worker):
         match ``train_batch_size`` units."""
         return self.version_lag() > self.config.max_head_offpolicyness
 
-    def _allocate_rollout(self, qid: str) -> Dict:
-        resp = self._allocate_rollout_inner(qid)
+    def _allocate_rollout(
+        self, qid: str, tokens: float = 0.0, tenant: Optional[str] = None
+    ) -> Dict:
+        resp = self._allocate_rollout_inner(qid, tokens, tenant)
         # qid here is the ROLLOUT id (its own trace root); the gate
         # decision — including the version-lag headroom it judged — is
         # the first event of a sampled rollout's timeline
@@ -900,7 +929,10 @@ class GserverManager(worker_base.Worker):
         )
         return resp
 
-    def _allocate_rollout_inner(self, qid: str) -> Dict:
+    def _allocate_rollout_inner(
+        self, qid: str, tokens: float = 0.0, tenant: Optional[str] = None
+    ) -> Dict:
+        self._init_runtime_state()
         cap = self.config.max_concurrent_rollouts or 10**9
         if self.rollout_stat.running >= cap:
             self._m_rejects.inc(reason="capacity")
@@ -910,6 +942,21 @@ class GserverManager(worker_base.Worker):
             self._m_rejects.inc(reason="staled")
             self._gate_first_reject.setdefault(qid, time.monotonic())
             return {"ok": False, "reason": "staled"}
+        # the tenant admission plane gates rollouts too: rollout traffic
+        # charges the default bulk tenant (permissive unless the
+        # operator configured a "rollout" policy), so serving quota
+        # storms and training throttles share one accounting surface
+        tenant = tenant or DEFAULT_BULK_TENANT
+        dec = self._admission.admit(
+            tenant, float(tokens), time.monotonic()
+        )
+        if not dec.ok:
+            self._m_rejects.inc(reason=dec.reason)
+            self._gate_first_reject.setdefault(qid, time.monotonic())
+            resp = {"ok": False, "reason": dec.reason}
+            if dec.retry_after_s:
+                resp["retry_after_s"] = dec.retry_after_s
+            return resp
         self.rollout_stat.submitted += 1
         self.rollout_stat.running += 1
         # schedule wait: gate-queueing latency of this rollout (0 when
@@ -917,7 +964,7 @@ class GserverManager(worker_base.Worker):
         t0 = self._gate_first_reject.pop(qid, None)
         self._m_slo_sched.observe(
             0.0 if t0 is None else max(0.0, time.monotonic() - t0),
-            workload="rollout",
+            workload=str(tenant),
         )
         return {"ok": True, "reason": ""}
 
@@ -929,9 +976,14 @@ class GserverManager(worker_base.Worker):
         if accepted:
             self.rollout_stat.accepted += 1
             self._m_accepted.inc()
-        # scheduling registered per-group-member qids "{qid}-{i}"; multi-turn
-        # agents prefix per-turn requests as "{qid}@t{j}" before the member
-        # suffix, so both derived forms must be swept
+        self._release_scheduled(qid)
+
+    def _release_scheduled(self, qid: str):
+        """Sweep every scheduling record a request (rollout OR gateway)
+        registered.  Scheduling registered per-group-member qids
+        "{qid}-{i}"; multi-turn agents prefix per-turn requests as
+        "{qid}@t{j}" before the member suffix, so both derived forms
+        must be swept."""
         for k in [
             k
             for k in self._qid_server
@@ -1212,7 +1264,41 @@ class GserverManager(worker_base.Worker):
                         payload.get("new_token_budget", 0),
                     )
                 elif cmd == "allocate_rollout":
-                    resp = self._allocate_rollout(payload["qid"])
+                    resp = self._allocate_rollout(
+                        payload["qid"],
+                        float(payload.get("tokens", 0.0)),
+                        payload.get("tenant"),
+                    )
+                elif cmd == "gateway_admit":
+                    self._init_runtime_state()
+                    tenant = str(payload["tenant"])
+                    dec = self._admission.admit(
+                        tenant,
+                        float(payload.get("tokens", 0.0)),
+                        time.monotonic(),
+                    )
+                    if not dec.ok:
+                        self._m_gw_rejects.inc(reason=dec.reason)
+                    root = str(payload.get("qid") or tenant)
+                    self._tracer.event(
+                        root, "gserver.gateway_admit", root=root,
+                        tenant=tenant, ok=dec.ok, reason=dec.reason,
+                    )
+                    resp = dec.as_dict()
+                elif cmd == "gateway_finish":
+                    self._init_runtime_state()
+                    self._admission.settle(
+                        str(payload["tenant"]),
+                        float(payload.get("reserved_tokens", 0.0)),
+                        float(payload.get("used_tokens", 0.0)),
+                    )
+                    if payload.get("qid"):
+                        self._release_scheduled(str(payload["qid"]))
+                    resp = "ok"
+                elif cmd == "gateway_reset_budget":
+                    self._init_runtime_state()
+                    self._admission.reset_budget(str(payload["tenant"]))
+                    resp = "ok"
                 elif cmd == "finish_rollout":
                     self._finish_rollout(
                         payload["qid"], payload.get("accepted", True)
@@ -1248,6 +1334,7 @@ class GserverManager(worker_base.Worker):
                         "server_transports": dict(
                             getattr(self, "_server_transport", {})
                         ),
+                        "tenants": self._admission.stats(),
                     }
                 else:
                     resp = {"error": f"unknown command {cmd}"}

@@ -37,9 +37,16 @@ class PartialRolloutManager:
         request_timeout: float = 600.0,
         max_rpc_retries: int = 3,
         rpc_retry_backoff_s: float = 0.5,
+        workload: str = "rollout",
     ):
         self.manager_client = manager_client
         self.gconfig = gconfig
+        # SLO/tenant label every chunk of this manager's traffic carries
+        # (RolloutWorkerConfig.workload): it segments the fleet-merged
+        # latency percentiles AND marks the rows as bulk-priority so the
+        # engine's pool-pressure preemption evicts them before
+        # interactive gateway rows.
+        self.workload = str(workload or "rollout")
         self.new_tokens_per_chunk = max(1, new_tokens_per_chunk)
         self.request_timeout = request_timeout
         self.max_rpc_retries = max(1, max_rpc_retries)
@@ -122,7 +129,12 @@ class PartialRolloutManager:
                     # SLO plane: client-observed routing latency, stamped
                     # on THIS clock (no cross-host skew) — the engine
                     # folds it into the request's LatencyRecord
-                    "slo_schedule_wait_s": time.monotonic() - t_sched
+                    "slo_schedule_wait_s": time.monotonic() - t_sched,
+                    # tenant/workload label (per-workload SLO rows) +
+                    # bulk priority class: rollout rows yield to
+                    # interactive gateway rows under pool pressure
+                    "workload": self.workload,
+                    "priority_class": "bulk",
                 }
                 if sched.get("handoff_to"):
                     # two-stage P/D routing: this chunk runs on a

@@ -60,6 +60,7 @@ class RolloutWorker(worker_base.AsyncWorker):
             config.gconfig,
             new_tokens_per_chunk=config.new_tokens_per_chunk,
             request_timeout=config.rollout_request_timeout,
+            workload=getattr(config, "workload", "rollout"),
         )
         self.pusher = NameResolvingZmqPusher(
             self._expr, self._trial, pusher_index=dp_rank

@@ -3150,6 +3150,271 @@ def _sharded_serving_child(argv_json: str) -> None:
     print(json.dumps(_sharded_serving_measure(**json.loads(argv_json))))
 
 
+def bench_gateway_ab(
+    cfg,
+    params,
+    n_bulk=8,
+    n_interactive=8,
+    prompt_len=128,
+    bulk_new=256,
+    inter_new=16,
+    page=32,
+    chunk=16,
+    max_batch=4,
+    max_steps=6000,
+):
+    """Serving-gateway A/B: an interactive SSE burst landing on a
+    2-engine fleet mid bulk-rollout storm, tenant admission ON vs OFF.
+
+    The load shape is the gateway's worst case: ``n_bulk`` long
+    bulk-tenant generations claim the fleet's cache rows first, then
+    ``n_interactive`` short interactive streams burst in.  Admission
+    OFF, every bulk request admits and the burst queues behind the
+    storm (TTFT ~ the bulk generation length).  Admission ON, the bulk
+    tenant's token bucket caps the storm at half the fleet's rows
+    (typed ``rate_limited`` rejects for the rest — the 429s a real
+    client would retry) and stamps priority classes, so the burst finds
+    free rows immediately.  The diffable win is interactive p99 TTFT
+    (steps is the deterministic unit; wall seconds reported alongside);
+    the acceptance bar is STRICTLY better p99 with admission on, plus
+    SSE-stream/rollout-path token parity and a zero-leak block audit on
+    every engine of both arms."""
+    import zlib
+
+    from areal_tpu.api.model_api import (
+        APIGenerateInput,
+        GenerationHyperparameters,
+    )
+    from areal_tpu.engine.sampling import SamplingParams
+    from areal_tpu.gateway.admission import AdmissionPlane, TenantPolicy
+    from areal_tpu.gateway.server import (
+        EngineBackend,
+        estimate_tokens,
+        run_request,
+    )
+
+    cache_len = bench_gen_cache_len(prompt_len, bulk_new)
+    bulk_est = estimate_tokens(prompt_len, bulk_new)
+    inter_est = estimate_tokens(prompt_len, inter_new)
+
+    def prompt_ids(tag):
+        rng = np.random.default_rng(zlib.crc32(tag.encode()))
+        return rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+
+    def ginp(qid, ids, max_new):
+        return APIGenerateInput(
+            qid=qid,
+            prompt_ids=list(ids),
+            input_ids=list(ids),
+            gconfig=GenerationHyperparameters(
+                max_new_tokens=max_new, greedy=True
+            ),
+        )
+
+    def pristine(eng):
+        eng.step()
+        eng.step()
+        if eng._prefix_cache is not None:
+            eng._prefix_cache.flush()
+        return bool(
+            eng.free_pool_blocks == eng.n_blocks
+            and (np.asarray(eng._block_ref) == 0).all()
+        )
+
+    def mk_fleet():
+        engines = {}
+        for name in ("srv0", "srv1"):
+            eng = make_engine(
+                cfg, params, max_batch, prompt_len, bulk_new, chunk=chunk,
+                cache_mode="paged",
+                page_size=page,
+                kv_pool_tokens=(max_batch + 1) * cache_len,
+                sampling=SamplingParams(greedy=True),
+            )
+            eng.park_ttl_steps = 0  # fresh qids never resume: no parked rows
+            engines[name] = eng
+        return engines
+
+    def _pct(vals, q):
+        return round(float(np.percentile(np.asarray(vals, float), q)), 4)
+
+    def arm(admission, tag):
+        engines = mk_fleet()
+        plane = None
+        if admission:
+            plane = AdmissionPlane([
+                # the storm's cap: a bucket holding half the storm up
+                # front, refilling too slowly to matter inside the bench
+                TenantPolicy(
+                    "bulk_load",
+                    priority="bulk",
+                    rate_tokens_per_s=1e-6,
+                    burst_tokens=(n_bulk // 2) * bulk_est,
+                ),
+                TenantPolicy("interactive", priority="interactive"),
+            ])
+        backend = EngineBackend(engines, plane=plane)
+
+        # warm the prefill/decode jits out of the TTFT measurement
+        for name in engines:
+            backend.submit(
+                ginp(f"{tag}-warm-{name}", prompt_ids(f"{tag}w{name}"), 2),
+                "interactive", "", False,
+            )
+        for _ in range(max_steps):
+            backend.pump_once()
+            if not backend.has_work():
+                break
+        for eng in engines.values():
+            eng.drain_results()
+
+        # the bulk storm claims rows first
+        bulk_admitted = 0
+        bulk_rejects = {}
+        for i in range(n_bulk):
+            dec = backend.admit("bulk_load", bulk_est)
+            if dec["ok"]:
+                bulk_admitted += 1
+                backend.submit(
+                    ginp(f"{tag}-bulk{i}", prompt_ids(f"{tag}b{i}"),
+                         bulk_new),
+                    "bulk_load", dec.get("priority", ""), False,
+                )
+            else:
+                bulk_rejects[dec["reason"]] = (
+                    bulk_rejects.get(dec["reason"], 0) + 1
+                )
+        for _ in range(3):  # storm settles into its cache rows
+            backend.pump_once()
+
+        # the interactive burst: SSE-style streamed requests, TTFT = the
+        # first drained stream chunk
+        handles = {}
+        t_submit = {}
+        for i in range(n_interactive):
+            qid = f"{tag}-int{i}"
+            dec = backend.admit("interactive", inter_est)
+            assert dec["ok"], dec
+            t_submit[qid] = time.perf_counter()
+            handles[qid] = backend.submit(
+                ginp(qid, prompt_ids(f"{tag}i{i}"), inter_new),
+                "interactive", dec.get("priority", ""), True,
+            )
+        ttft_steps = {}
+        ttft_s = {}
+        streams = {qid: [] for qid in handles}
+        done = set()
+        for step in range(1, max_steps + 1):
+            backend.pump_once()
+            for qid, h in handles.items():
+                if qid in done:
+                    continue
+                r = backend.poll(h)
+                toks = r.get("tokens") or []
+                if toks and qid not in ttft_steps:
+                    ttft_steps[qid] = step
+                    ttft_s[qid] = time.perf_counter() - t_submit[qid]
+                streams[qid].extend(toks)
+                if r.get("done"):
+                    done.add(qid)
+                    backend.finish(
+                        h, len(streams[qid]) + prompt_len, inter_est
+                    )
+            if len(done) == n_interactive:
+                break
+        else:
+            raise RuntimeError("interactive burst did not drain")
+        # drain the surviving storm, then audit for leaks
+        for _ in range(max_steps):
+            if not backend.has_work():
+                break
+            backend.pump_once()
+        for eng in engines.values():
+            eng.drain_results()
+        row = {
+            "bulk_admitted": int(bulk_admitted),
+            "bulk_rejects": bulk_rejects,
+            "interactive_ttft_steps": {
+                "p50": _pct(list(ttft_steps.values()), 50),
+                "p99": _pct(list(ttft_steps.values()), 99),
+                "max": max(ttft_steps.values()),
+            },
+            "interactive_ttft_s": {
+                "p50": _pct(list(ttft_s.values()), 50),
+                "p99": _pct(list(ttft_s.values()), 99),
+            },
+            "interactive_tokens": int(sum(len(s) for s in streams.values())),
+            "leak_free": all(pristine(e) for e in engines.values()),
+        }
+        if plane is not None:
+            row["tenants"] = plane.stats()
+        return row
+
+    def parity():
+        """Greedy token identity across the three read paths: the SSE
+        stream's chunk concat, the request's final result, and a plain
+        rollout-style submission of the same prompt."""
+        eng = make_engine(
+            cfg, params, 2, prompt_len, inter_new, chunk=chunk,
+            cache_mode="paged", page_size=page,
+            kv_pool_tokens=4 * bench_gen_cache_len(prompt_len, inter_new),
+            # no prefix cache: a radix hit would prefill only the suffix,
+            # and the changed reduction order can flip near-tied argmax
+            # on tiny models — parity wants bit-identical prefills
+            prefix_cache=False,
+            sampling=SamplingParams(greedy=True),
+        )
+        eng.park_ttl_steps = 0
+        backend = EngineBackend({"srv": eng})
+        ids = prompt_ids("parity")
+        chunks = []
+        out = run_request(
+            backend, ginp("par-gw", ids, inter_new),
+            "interactive", "interactive",
+            stream=True, on_chunk=chunks.append,
+            pump=backend.pump_once,
+        )
+        concat = [t for c in chunks for t in c]
+        eng.submit(ginp("par-rollout", ids, inter_new))
+        while eng.has_work:
+            eng.step()
+        rollout = eng.drain_results()["par-rollout"]
+        return {
+            "stream_concat_matches_result": bool(
+                concat == list(out["result"]["output_ids"])
+            ),
+            "gateway_matches_rollout": bool(
+                list(out["result"]["output_ids"])
+                == list(rollout.output_ids)
+            ),
+            "leak_free": pristine(eng),
+        }
+
+    out = {
+        "n_bulk": n_bulk,
+        "n_interactive": n_interactive,
+        "prompt_len": prompt_len,
+        "bulk_new": bulk_new,
+        "inter_new": inter_new,
+        "max_batch_per_engine": max_batch,
+        "admission_on": arm(True, "on"),
+        "admission_off": arm(False, "off"),
+        "parity": parity(),
+    }
+    on_p99 = out["admission_on"]["interactive_ttft_steps"]["p99"]
+    off_p99 = out["admission_off"]["interactive_ttft_steps"]["p99"]
+    out["p99_ttft_steps_improvement"] = round(off_p99 / max(on_p99, 1), 2)
+    out["interactive_p99_ttft_better_with_admission"] = bool(
+        on_p99 < off_p99
+    )
+    out["leak_free"] = bool(
+        out["admission_on"]["leak_free"]
+        and out["admission_off"]["leak_free"]
+        and out["parity"]["leak_free"]
+    )
+    return out
+
+
 #: per-section outcomes for the machine-parseable summary:
 #: {name: {"status": "ok"|"error"|"timeout", "seconds": wall}}.  A round
 #: that loses sections still reports WHICH ones and why.
@@ -3218,6 +3483,7 @@ SUMMARY_REQUIRED_KEYS = (
     "spec_decode_ab",
     "slo_report",
     "pd_disagg_ab",
+    "gateway_ab",
     "sharded_serving",
     "weight_swap_ab",
     "train_packing_ab",
@@ -3239,6 +3505,7 @@ def build_summary(
     spec_decode_ab=None,
     slo_report=None,
     pd_disagg_ab=None,
+    gateway_ab=None,
     sharded_serving=None,
     weight_swap_ab=None,
     train_packing_ab=None,
@@ -3280,6 +3547,7 @@ def build_summary(
         "spec_decode_ab": spec_decode_ab,
         "slo_report": slo_report,
         "pd_disagg_ab": pd_disagg_ab,
+        "gateway_ab": gateway_ab,
         "sharded_serving": sharded_serving,
         "weight_swap_ab": weight_swap_ab,
         "train_packing_ab": train_packing_ab,
@@ -4174,6 +4442,27 @@ def main():
             bench_pd_disagg_hetero, name="pd_disagg_hetero",
         )
 
+    # serving gateway A/B: interactive SSE burst vs bulk-rollout storm on
+    # a 2-engine fleet, tenant admission on vs off — interactive p99 TTFT
+    # (strictly-better bar), typed bulk rejects, SSE/rollout token
+    # parity, zero-leak audit.  Runs off-TPU too — tiny shapes — so the
+    # summary always carries the acceptance verdict.
+    mark("gateway A/B")
+    gateway_ab = _section(
+        bench_gateway_ab,
+        cfg,
+        gen_params,
+        name="gateway_ab",
+        **(
+            {}
+            if on_tpu
+            else dict(
+                n_bulk=4, n_interactive=4, prompt_len=32, bulk_new=96,
+                inter_new=8, page=16, chunk=8, max_batch=2,
+            )
+        ),
+    )
+
     # self-speculative decoding A/B: n-gram draft + batched paged verify
     # on vs off, on a repetitive-trace workload (decode tok/s + accepted
     # tokens per verify step).  Runs off-TPU too — tiny shapes — so the
@@ -4423,6 +4712,7 @@ def main():
         spec_decode_ab=spec_decode_ab,
         slo_report=slo_report,
         pd_disagg_ab=pd_disagg_ab,
+        gateway_ab=gateway_ab,
         sharded_serving=sharded_serving,
         weight_swap_ab=weight_swap_ab,
         train_packing_ab=train_packing_ab,
@@ -4488,6 +4778,7 @@ def main():
                     "spec_decode_ab": spec_decode_ab,
                     "slo_report": slo_report,
                     "pd_disagg_ab": pd_disagg_ab,
+                    "gateway_ab": gateway_ab,
                     "sharded_serving": sharded_serving,
                 },
             }

@@ -300,6 +300,41 @@ def test_train_packing_ab_smoke(tiny_cfg):
     json.dumps(out)  # wire-format safe
 
 
+def test_gateway_ab_cpu_smoke(tiny_cfg):
+    """The gateway A/B at tiny CPU shapes (the acceptance criterion's
+    smoke): interactive p99 TTFT strictly better with admission on
+    under the bulk storm, SSE-concat == non-streaming token parity,
+    greedy gateway output token-identical to the rollout path, and
+    zero leaked blocks across every arm."""
+    import jax
+
+    from areal_tpu.models import transformer
+
+    params = transformer.init_params(tiny_cfg, jax.random.PRNGKey(0))
+    out = bench.bench_gateway_ab(
+        tiny_cfg, params, n_bulk=4, n_interactive=4, prompt_len=32,
+        bulk_new=96, inter_new=8, page=16, chunk=8, max_batch=2,
+    )
+    on, off = out["admission_on"], out["admission_off"]
+    # the bulk storm was genuinely throttled on the on-arm only
+    assert sum(on["bulk_rejects"].values()) > 0
+    assert off["bulk_rejects"] == {}
+    assert off["bulk_admitted"] == 4
+    # every interactive request streamed its full token budget
+    for arm in (on, off):
+        assert arm["interactive_tokens"] == 4 * 8
+        assert arm["leak_free"] is True
+    # THE acceptance bar: interactive p99 TTFT (deterministic,
+    # step-counted) strictly better with admission on
+    assert out["p99_ttft_steps_improvement"] > 1.0
+    assert out["interactive_p99_ttft_better_with_admission"] is True
+    par = out["parity"]
+    assert par["stream_concat_matches_result"] is True
+    assert par["gateway_matches_rollout"] is True
+    assert out["leak_free"] is True
+    json.dumps(out)  # wire-format safe
+
+
 def test_summary_schema_round_trips_with_required_keys(spec_ab):
     """The machine-parseable summary contract: json round-trip + every
     SUMMARY_REQUIRED_KEYS entry present (None for sections that did not
@@ -372,10 +407,24 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
                             "paged_deep_toks_per_sec": 3.0},
             "derived_dispatch_table": {"paged_min_cache_len": 2048},
         },
+        gateway_ab={
+            "admission_on": {"interactive_ttft_steps": {"p99": 3}},
+            "admission_off": {"interactive_ttft_steps": {"p99": 11}},
+            "p99_ttft_steps_improvement": 3.67,
+            "interactive_p99_ttft_better_with_admission": True,
+            "parity": {"stream_concat_matches_result": True,
+                       "gateway_matches_rollout": True},
+            "leak_free": True,
+        },
     )
     blob = json.loads(json.dumps(summary))
     for key in bench.SUMMARY_REQUIRED_KEYS:
         assert key in blob, key
+    assert "gateway_ab" in bench.SUMMARY_REQUIRED_KEYS
+    gw = blob["gateway_ab"]
+    assert gw["interactive_p99_ttft_better_with_admission"] is True
+    assert gw["p99_ttft_steps_improvement"] == 3.67
+    assert gw["parity"]["gateway_matches_rollout"] is True
     assert blob["spec_decode_ab"]["b2"]["spec_on"]["verify_chunks"] > 0
     assert blob["decode"]["b2"]["decode_toks_per_sec"] == 2.0
     assert blob["decode"]["b4"]["decode_toks_per_sec"] is None

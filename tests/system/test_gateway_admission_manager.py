@@ -1,0 +1,98 @@
+"""The manager half of the tenant admission plane: policies wired from
+``GserverManagerConfig.tenants``, rollout traffic charging the default
+bulk tenant through ``_allocate_rollout``, typed reject reasons with
+``retry_after_s`` surfaced to the rollout worker, and the per-tenant
+``workload`` label on the schedule-wait SLO series (hand-built manager,
+no ZMQ — the test_gserver_manager_unit pattern)."""
+
+import pytest
+
+from areal_tpu.gateway.admission import (
+    DEFAULT_BULK_TENANT,
+    REJECT_BUDGET_EXHAUSTED,
+    REJECT_RATE_LIMITED,
+)
+from tests.system.test_gserver_manager_unit import _manager
+
+
+def _open_gate_manager(**cfg_kwargs):
+    """A manager whose staleness/capacity gates never fire, so
+    ``_allocate_rollout`` outcomes are the admission plane's alone."""
+    return _manager(
+        group_size=1, train_batch_size=100, max_head_offpolicyness=100,
+        **cfg_kwargs,
+    )
+
+
+def test_tenant_policies_wire_from_config():
+    m = _manager(tenants=[
+        {"name": "chat", "priority": "interactive"},
+        {"name": DEFAULT_BULK_TENANT, "priority": "bulk",
+         "rate_tokens_per_s": 50.0, "burst_tokens": 100.0},
+    ])
+    assert m._admission.priority_of("chat") == "interactive"
+    assert m._admission.priority_of(DEFAULT_BULK_TENANT) == "bulk"
+    # no tenants configured -> permissive plane, still present
+    m2 = _manager()
+    assert m2._admission.admit("anyone", 1e9, now=0.0).ok
+
+
+def test_rollout_traffic_charges_the_default_bulk_tenant():
+    m = _open_gate_manager(tenants=[
+        {"name": DEFAULT_BULK_TENANT, "priority": "bulk",
+         "rate_tokens_per_s": 1e-6, "burst_tokens": 100.0},
+    ])
+    # the burst covers one 80-token rollout...
+    assert m._allocate_rollout("r1", tokens=80.0)["ok"]
+    # ...then the near-zero refill rate rejects the next, with the
+    # typed reason + retry hint the rollout worker backs off on
+    r = m._allocate_rollout("r2", tokens=80.0)
+    assert not r["ok"]
+    assert r["reason"] == REJECT_RATE_LIMITED
+    assert r["retry_after_s"] > 0
+    # admission accounting landed on the shared plane
+    st = m._admission.stats()[DEFAULT_BULK_TENANT]
+    assert st["admitted_total"] == 1
+    assert st["rejects"] == {REJECT_RATE_LIMITED: 1}
+    # only the admitted rollout entered the running ledger
+    assert m.rollout_stat.running == 1
+
+
+def test_explicit_tenant_budget_is_terminal_until_reset():
+    m = _open_gate_manager(tenants=[
+        {"name": "trial-org", "priority": "bulk", "token_budget": 100.0},
+    ])
+    assert m._allocate_rollout("a", tokens=100.0, tenant="trial-org")["ok"]
+    r = m._allocate_rollout("b", tokens=1.0, tenant="trial-org")
+    assert not r["ok"] and r["reason"] == REJECT_BUDGET_EXHAUSTED
+    # the gateway_reset_budget operator action lifts it
+    m._admission.reset_budget("trial-org")
+    assert m._allocate_rollout("b", tokens=1.0, tenant="trial-org")["ok"]
+
+
+def test_schedule_wait_series_is_labeled_by_tenant():
+    m = _open_gate_manager(tenants=[{"name": "batch-org", "priority": "bulk"}])
+    assert m._allocate_rollout("x", tokens=10.0, tenant="batch-org")["ok"]
+    assert m._allocate_rollout("y", tokens=10.0)["ok"]  # default tenant
+    # per-tenant SLO rows with zero new digest machinery: the existing
+    # schedule-wait histogram, keyed by the workload label
+    _, n_batch = m._m_slo_sched.snapshot(workload="batch-org")
+    _, n_rollout = m._m_slo_sched.snapshot(workload=DEFAULT_BULK_TENANT)
+    assert n_batch == 1
+    assert n_rollout >= 1
+
+
+def test_gateway_finish_settlement_refunds_the_reservation():
+    m = _manager(tenants=[
+        {"name": "capped", "priority": "interactive",
+         "token_budget": 100.0},
+    ])
+    dec = m._admission.admit("capped", 90.0, now=0.0)
+    assert dec.ok
+    assert not m._admission.admit("capped", 90.0, now=0.0).ok
+    # what the gateway_finish command runs: true-up to actual usage
+    m._admission.settle("capped", reserved=90.0, used=20.0)
+    assert m._admission.admit("capped", 75.0, now=0.0).ok
+    assert m._admission.stats()["capped"]["spent_tokens"] == (
+        pytest.approx(95.0)
+    )
