@@ -121,6 +121,10 @@ class RolloutWorkerConfig:
     dataset_seed: int = 1
     rollout_request_timeout: float = 600.0
     new_tokens_per_chunk: int = 1 << 30  # interruptible-generation chunking
+    # schedule all group siblings' first chunks in ONE manager RPC
+    # (affinity co-locates them anyway); falls back per-qid against an
+    # old manager that does not know the batched command
+    batch_schedule: bool = True
     # SLO/tenant label this worker's traffic carries end-to-end: it
     # lands in LatencyRecord.workload (fleet-merged per-workload
     # percentile rows) and charges the matching admission-plane tenant.
@@ -343,6 +347,23 @@ class GserverManagerConfig:
     worker_name: str = "gserver_manager"
     n_servers: int = 1
     schedule_policy: str = "round_robin"
+    # control-plane serve loop: "router" (default) drains a batch of
+    # pending requests per tick off a ZMQ ROUTER socket, processes them
+    # under one lock pass, and replies out of order — a gateway storm
+    # never queues behind rollout traffic, and slow work (weight-update
+    # fan-out) runs off the serve thread.  "rep" restores the legacy
+    # strict-lockstep REP loop.  Wire format is identical either way:
+    # legacy REQ clients speak to both.
+    serve_mode: str = "router"
+    # max requests drained per ROUTER serve tick (bounds the time one
+    # lock pass can hold the scheduling state)
+    serve_batch_max: int = 256
+    # O(log N) routing: per-chip load/token min-heaps maintained
+    # incrementally on the deltas scheduling already applies, plus a
+    # precomputed weighted round-robin cycle rebuilt only when pool
+    # membership or mesh shapes change.  False = the O(N) scans
+    # (pick-for-pick identical; kept for A/B and paranoia).
+    routing_index: bool = True
     max_head_offpolicyness: int = 0
     train_batch_size: int = 1  # in sequences (train_bs_n_seqs)
     group_size: int = 1  # sequences per rollout (staleness unit conversion)
@@ -431,9 +452,15 @@ class GatewayConfig:
     # byte-codec vocab for string prompts (see gateway/sse.py); set to
     # the serving model's vocab size
     vocab_size: int = 256
+    # real tokenizer for string prompts/completions: a HF tokenizer path
+    # loaded via dataset_api.load_hf_tokenizer.  Empty = the byte-level
+    # codec (token-id prompts are native either way).
+    tokenizer_path: str = ""
     max_new_tokens_cap: int = 1024
     request_timeout_s: float = 600.0
     poll_interval_s: float = 0.002
+    # manager RPC timeout for the gateway's admission/schedule calls
+    manager_timeout_s: float = 60.0
     trace: Optional[TraceConfig] = None
 
 
@@ -473,6 +500,7 @@ class ExperimentConfig:
         default_factory=list
     )
     gserver_manager: Optional[GserverManagerConfig] = None
+    gateway: Optional[GatewayConfig] = None
     evaluator: Optional[EvaluatorConfig] = None
     # experiment-wide flight-recorder config, propagated to every worker
     # that does not set its own (None = leave workers on ambient defaults)
@@ -483,12 +511,17 @@ class ExperimentConfig:
         (reference: system_api.py ExperimentConfig.lazy_init :190)."""
         build_graph(self.master.model_rpcs)
         if self.trace is not None:
-            workers = [self.master, self.gserver_manager]
+            workers = [self.master, self.gserver_manager, self.gateway]
             workers += self.model_workers + self.rollout_workers
             workers += self.gen_servers
             for w in workers:
                 if w is not None and w.trace is None:
                     w.trace = self.trace
+        if self.gateway is not None and self.gserver_manager is None:
+            raise ValueError(
+                "gateway worker requires a gserver_manager (it schedules "
+                "and admits through the manager's control plane)"
+            )
         self.master.model_worker_names = [
             w.worker_name for w in self.model_workers
         ]

@@ -155,6 +155,10 @@ def _start_rollout_stack(cfg: system_api.ExperimentConfig, errors):
 
         for rcfg in cfg.rollout_workers:
             aux.append((RolloutWorker(), rcfg))
+    if getattr(cfg, "gateway", None) is not None:
+        from areal_tpu.gateway.worker import GatewayWorker
+
+        aux.append((GatewayWorker(), cfg.gateway))
 
     from areal_tpu.system.worker_base import AsyncWorker
 

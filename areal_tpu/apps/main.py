@@ -50,6 +50,8 @@ def _worker_specs(cfg: system_api.ExperimentConfig) -> List[Tuple[str, int, str]
         specs.append(("gserver_manager", 0, cfg.gserver_manager.worker_name))
     for i, w in enumerate(cfg.rollout_workers):
         specs.append(("rollout_worker", i, w.worker_name))
+    if getattr(cfg, "gateway", None) is not None:
+        specs.append(("gateway", 0, cfg.gateway.worker_name))
     return specs
 
 

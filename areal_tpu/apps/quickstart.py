@@ -60,13 +60,14 @@ def main(argv=None) -> int:
     dump_config(exp, os.path.join(constants.get_log_path(), "config.yaml"))
     logger.info(
         "quickstart %s (%s/%s): %d model worker(s), %d gen server(s), "
-        "%d rollout worker(s)",
+        "%d rollout worker(s)%s",
         cmd,
         cfg.experiment_name,
         cfg.trial_name,
         len(cfg.model_workers),
         len(cfg.gen_servers),
         len(cfg.rollout_workers),
+        ", gateway" if getattr(cfg, "gateway", None) is not None else "",
     )
     if mode == "threads":
         from areal_tpu.apps.local_runner import run_experiment_local

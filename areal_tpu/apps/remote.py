@@ -109,6 +109,10 @@ def run_worker(
         from areal_tpu.system.gserver_manager import GserverManager
 
         cls, wcfg = GserverManager, cfg.gserver_manager
+    elif worker_type == "gateway":
+        from areal_tpu.gateway.worker import GatewayWorker
+
+        cls, wcfg = GatewayWorker, cfg.gateway
     else:
         raise ValueError(f"unknown worker type {worker_type!r}")
 

@@ -94,6 +94,11 @@ def gen_server_manager(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/gen_server_manager"
 
 
+def gateway(experiment_name: str, trial_name: str) -> str:
+    """host:port of the OpenAI-style HTTP/SSE gateway front door."""
+    return f"{trial_root(experiment_name, trial_name)}/gateway"
+
+
 def training_samples(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/training_samples"
 

@@ -93,6 +93,21 @@ class EngineBackend:
             return {"ok": True, "tenant": tenant, "priority": ""}
         return self.plane.admit(tenant, est_tokens, time.monotonic()).as_dict()
 
+    def admit_and_submit(
+        self,
+        inp: model_api.APIGenerateInput,
+        tenant: str,
+        est_tokens: float,
+        stream: bool,
+    ):
+        """Admission + placement in one step (in-process both are local
+        calls, so this is just the protocol's combined form).  Returns
+        ``(decision, handle)``; handle is ``None`` on reject."""
+        dec = self.admit(tenant, est_tokens)
+        if not dec.get("ok"):
+            return dec, None
+        return dec, self.submit(inp, tenant, dec.get("priority", ""), stream)
+
     def submit(
         self,
         inp: model_api.APIGenerateInput,
@@ -198,6 +213,10 @@ class FleetBackend:
             lambda addr: GenServerClient(addr, timeout=request_timeout)
         )
         self._clients: Dict[str, Any] = {}
+        # flipped off (permanently) the first time the manager rejects
+        # the combined gateway_submit command — an older manager speaks
+        # only the two-call admit + schedule protocol
+        self._combined_ok = True
 
     def _client(self, addr: str):
         if addr not in self._clients:
@@ -208,6 +227,34 @@ class FleetBackend:
         return self.manager.call(
             "gateway_admit", {"tenant": tenant, "tokens": est_tokens}
         )
+
+    def _dispatch(
+        self,
+        inp: model_api.APIGenerateInput,
+        tenant: str,
+        priority: str,
+        stream: bool,
+        sched: Dict[str, Any],
+        sched_wait_s: float,
+    ) -> Dict[str, str]:
+        """Stamp routing metadata from a schedule decision and hand the
+        request to the scheduled gen server."""
+        md = dict(inp.metadata or {})
+        md["workload"] = tenant
+        if priority:
+            md["priority_class"] = priority
+        if stream:
+            md["stream"] = True
+        md["slo_schedule_wait_s"] = sched_wait_s
+        for key in ("handoff_to", "pd_shed", "kv_source"):
+            if sched.get(key):
+                md[key] = sched[key]
+        inp.metadata = md
+        self._client(sched["url"]).call(
+            "generate_stream" if stream else "generate", inp,
+            timeout=self._timeout,
+        )
+        return {"url": sched["url"], "qid": inp.qid, "tenant": tenant}
 
     def submit(
         self,
@@ -225,22 +272,65 @@ class FleetBackend:
                 "new_token_budget": inp.gconfig.max_new_tokens,
             },
         )
-        md = dict(inp.metadata or {})
-        md["workload"] = tenant
-        if priority:
-            md["priority_class"] = priority
-        if stream:
-            md["stream"] = True
-        md["slo_schedule_wait_s"] = time.monotonic() - t0
-        for key in ("handoff_to", "pd_shed", "kv_source"):
-            if sched.get(key):
-                md[key] = sched[key]
-        inp.metadata = md
-        self._client(sched["url"]).call(
-            "generate_stream" if stream else "generate", inp,
-            timeout=self._timeout,
+        return self._dispatch(
+            inp, tenant, priority, stream, sched,
+            sched_wait_s=time.monotonic() - t0,
         )
-        return {"url": sched["url"], "qid": inp.qid, "tenant": tenant}
+
+    def admit_and_submit(
+        self,
+        inp: model_api.APIGenerateInput,
+        tenant: str,
+        est_tokens: float,
+        stream: bool,
+    ):
+        """One manager round trip instead of two: ``gateway_submit``
+        returns the admission decision and — when admitted — the
+        schedule for ``inp`` in the same reply.  Falls back (for good)
+        to the legacy admit + schedule_request pair against managers
+        that predate the combined command.  Returns ``(decision,
+        handle)``; handle is ``None`` on reject."""
+        if self._combined_ok:
+            t0 = time.monotonic()
+            try:
+                dec = self.manager.call(
+                    "gateway_submit",
+                    {
+                        "tenant": tenant,
+                        "tokens": est_tokens,
+                        "qid": inp.qid,
+                        "prompt_len": len(inp.input_ids or inp.prompt_ids),
+                        "new_token_budget": inp.gconfig.max_new_tokens,
+                    },
+                )
+            except RuntimeError:
+                # the manager replied {"error": "unknown command ..."}:
+                # an older control plane — use the two-call protocol
+                # from here on
+                self._combined_ok = False
+                logger.warning(
+                    "manager does not speak gateway_submit; falling "
+                    "back to admit + schedule round trips"
+                )
+            else:
+                if not dec.get("ok"):
+                    return dec, None
+                sched = dec.get("schedule")
+                if sched is not None:
+                    handle = self._dispatch(
+                        inp, tenant, dec.get("priority", ""), stream,
+                        sched, sched_wait_s=time.monotonic() - t0,
+                    )
+                    return dec, handle
+                # admitted but no schedule attached (defensive):
+                # schedule separately below
+                return dec, self.submit(
+                    inp, tenant, dec.get("priority", ""), stream
+                )
+        dec = self.admit(tenant, est_tokens)
+        if not dec.get("ok"):
+            return dec, None
+        return dec, self.submit(inp, tenant, dec.get("priority", ""), stream)
 
     def poll(self, handle: Dict[str, str]) -> Dict[str, Any]:
         return self._client(handle["url"]).call(
@@ -279,16 +369,20 @@ def run_request(
     poll_interval_s: float = 0.002,
     timeout_s: float = 600.0,
     pump: Optional[Callable[[], Any]] = None,
+    handle: Optional[Dict[str, str]] = None,
 ) -> Dict[str, Any]:
     """Submit one admitted request and drive it to completion, invoking
     ``on_chunk`` with each incremental token batch (streaming mode).
     ``pump`` lets a single-threaded caller (bench, dryrun) step the
-    in-process engines between polls.  A ``ClientDisconnected`` raised
-    by ``on_chunk`` cancels the engine row and settles the tenant's
-    budget for the tokens actually produced."""
+    in-process engines between polls.  A pre-made ``handle`` (from
+    ``admit_and_submit``'s combined round trip) skips the submit.  A
+    ``ClientDisconnected`` raised by ``on_chunk`` cancels the engine
+    row and settles the tenant's budget for the tokens actually
+    produced."""
     prompt_len = len(inp.input_ids or inp.prompt_ids)
     reserved = estimate_tokens(prompt_len, inp.gconfig.max_new_tokens)
-    handle = backend.submit(inp, tenant, priority, stream)
+    if handle is None:
+        handle = backend.submit(inp, tenant, priority, stream)
     collected: List[int] = []
     deadline = time.monotonic() + timeout_s
     try:
@@ -346,10 +440,14 @@ class GatewayServer:
         model_name: str = "areal-tpu",
         poll_interval_s: float = 0.002,
         request_timeout_s: float = 600.0,
+        tokenizer: Optional[Any] = None,
     ):
         self.backend = backend
         self.default_tenant = default_tenant
         self.vocab_size = vocab_size
+        # a real (HF-style) tokenizer makes string prompts first-class;
+        # without one the byte-level codec in ``sse`` round-trips text
+        self.tokenizer = tokenizer
         self.max_new_tokens_cap = max_new_tokens_cap
         self.model_name = model_name
         self.poll_interval_s = poll_interval_s
@@ -429,6 +527,16 @@ class GatewayServer:
             self._seq += 1
             return f"{self._seq}"
 
+    def _encode_text(self, text: str) -> List[int]:
+        if self.tokenizer is not None:
+            return [int(t) for t in self.tokenizer.encode(text)]
+        return sse.encode_text(text, self.vocab_size)
+
+    def _decode_tokens(self, toks: List[int]) -> str:
+        if self.tokenizer is not None:
+            return self.tokenizer.decode(toks)
+        return sse.decode_tokens(toks)
+
     def _parse_prompt(self, body: Dict[str, Any], chat: bool) -> List[int]:
         if chat:
             ids: List[int] = []
@@ -437,13 +545,11 @@ class GatewayServer:
                 if isinstance(content, list):
                     ids.extend(int(t) for t in content)
                 else:
-                    ids.extend(
-                        sse.encode_text(str(content), self.vocab_size)
-                    )
+                    ids.extend(self._encode_text(str(content)))
             return ids
         prompt = body.get("prompt", [])
         if isinstance(prompt, str):
-            return sse.encode_text(prompt, self.vocab_size)
+            return self._encode_text(prompt)
         return [int(t) for t in prompt]
 
     def _send_json(self, handler, status: int, obj: Dict[str, Any],
@@ -488,9 +594,38 @@ class GatewayServer:
         stream = bool(body.get("stream"))
         temperature = body.get("temperature")
         greedy = temperature is None or float(temperature) <= 0.0
-        dec = self.backend.admit(
-            tenant, estimate_tokens(len(prompt), max_new)
+        # request object built BEFORE admission: a backend with the
+        # combined admit_and_submit entry point collapses the admit and
+        # schedule round trips into one manager call
+        qid = str(body.get("qid") or f"gw-{self._next_id()}")
+        gconfig = model_api.GenerationHyperparameters(
+            max_new_tokens=max_new,
+            greedy=greedy,
+            temperature=float(temperature) if not greedy else 1.0,
+            n=1,
         )
+        inp = model_api.APIGenerateInput(
+            qid=qid, prompt_ids=prompt, input_ids=prompt, gconfig=gconfig
+        )
+        handle = None
+        try:
+            if hasattr(self.backend, "admit_and_submit"):
+                dec, handle = self.backend.admit_and_submit(
+                    inp, tenant, estimate_tokens(len(prompt), max_new),
+                    stream,
+                )
+            else:
+                # stub/minimal backends speak the five-call protocol only
+                dec = self.backend.admit(
+                    tenant, estimate_tokens(len(prompt), max_new)
+                )
+        except Exception as e:  # noqa: BLE001 - manager/gen-server down
+            logger.exception("admit/submit for %s failed", qid)
+            self._send_json(
+                handler, 502,
+                {"error": {"message": repr(e), "type": "bad_gateway"}},
+            )
+            return
         if not dec.get("ok"):
             reason = dec.get("reason", "rejected")
             self._m_rejects.inc(reason=reason)
@@ -513,32 +648,23 @@ class GatewayServer:
                 headers,
             )
             return
-        qid = str(body.get("qid") or f"gw-{self._next_id()}")
-        gconfig = model_api.GenerationHyperparameters(
-            max_new_tokens=max_new,
-            greedy=greedy,
-            temperature=float(temperature) if not greedy else 1.0,
-            n=1,
-        )
-        inp = model_api.APIGenerateInput(
-            qid=qid, prompt_ids=prompt, input_ids=prompt, gconfig=gconfig
-        )
         rid = f"cmpl-{qid}"
         obj = "chat.completion.chunk" if chat else "text_completion"
         if stream:
             self._m_streams.inc()
             self._stream_response(
                 handler, inp, tenant, dec.get("priority", ""), rid, obj,
-                chat,
+                chat, handle=handle,
             )
         else:
             self._sync_response(
-                handler, inp, tenant, dec.get("priority", ""), rid, chat
+                handler, inp, tenant, dec.get("priority", ""), rid, chat,
+                handle=handle,
             )
 
     def _choice(self, toks: List[int], chat: bool,
                 finish_reason: Optional[str]) -> Dict[str, Any]:
-        text = sse.decode_tokens(toks)
+        text = self._decode_tokens(toks)
         if chat:
             delta = {"role": "assistant", "content": text}
             return {"index": 0, "delta": delta, "token_ids": toks,
@@ -547,7 +673,7 @@ class GatewayServer:
                 "finish_reason": finish_reason}
 
     def _stream_response(self, handler, inp, tenant, priority, rid, obj,
-                         chat):
+                         chat, handle=None):
         handler.send_response(200)
         handler.send_header("Content-Type", "text/event-stream")
         handler.send_header("Cache-Control", "no-cache")
@@ -575,6 +701,7 @@ class GatewayServer:
                 stream=True, on_chunk=on_chunk,
                 poll_interval_s=self.poll_interval_s,
                 timeout_s=self.request_timeout_s,
+                handle=handle,
             )
             result = out["result"]
             finish = "length" if result.get("no_eos") else "stop"
@@ -599,12 +726,14 @@ class GatewayServer:
                 self._active_streams -= 1
                 self._m_active.set(self._active_streams)
 
-    def _sync_response(self, handler, inp, tenant, priority, rid, chat):
+    def _sync_response(self, handler, inp, tenant, priority, rid, chat,
+                       handle=None):
         try:
             out = run_request(
                 self.backend, inp, tenant, priority, stream=False,
                 poll_interval_s=self.poll_interval_s,
                 timeout_s=self.request_timeout_s,
+                handle=handle,
             )
         except TimeoutError as e:
             self._send_json(
@@ -621,7 +750,7 @@ class GatewayServer:
                 "index": 0,
                 "message": {
                     "role": "assistant",
-                    "content": sse.decode_tokens(toks),
+                    "content": self._decode_tokens(toks),
                 },
                 "token_ids": toks,
                 "finish_reason": finish,

@@ -1,0 +1,89 @@
+"""Gateway deployment shell: runs the HTTP/SSE front door as a fleet
+worker.
+
+``GatewayServer``/``FleetBackend`` are libraries; this module is the
+launcher-facing wrapper that makes the gateway a first-class worker
+(ROADMAP item 1a): it discovers the ``GserverManager`` through
+name_resolve, builds the fleet backend (manager-scheduled, gen-server
+streamed), optionally loads a real HF tokenizer for string prompts
+(ROADMAP item 1b), serves until told to exit, and publishes its
+``host:port`` under ``names.gateway`` so clients and ops tooling can
+find the front door.
+
+The HTTP server owns its own thread pool (``ThreadingHTTPServer``), so
+``_poll`` only has to keep the worker lifecycle alive — all request
+work happens on handler threads against the manager's control plane.
+"""
+
+from __future__ import annotations
+
+import time
+
+from areal_tpu.api import system_api
+from areal_tpu.base import constants, logging_, name_resolve, names
+from areal_tpu.system import worker_base
+
+logger = logging_.getLogger("gateway_worker")
+
+
+class GatewayWorker(worker_base.Worker):
+    def _configure(self, config: system_api.GatewayConfig):
+        from areal_tpu.gateway.server import FleetBackend, GatewayServer
+        from areal_tpu.system.gserver_manager import GserverManagerClient
+
+        self.config = config
+        self.worker_name = config.worker_name
+        self.logger = logging_.getLogger(self.worker_name)
+        self._expr = constants.experiment_name()
+        self._trial = constants.trial_name()
+
+        tokenizer = None
+        if config.tokenizer_path:
+            from areal_tpu.api import dataset_api
+
+            tokenizer = dataset_api.load_hf_tokenizer(config.tokenizer_path)
+
+        self.manager_client = GserverManagerClient(
+            self._expr, self._trial, timeout=config.manager_timeout_s
+        )
+        self.backend = FleetBackend(
+            self.manager_client,
+            request_timeout=config.request_timeout_s,
+        )
+        self.server = GatewayServer(
+            self.backend,
+            host=config.host,
+            port=config.port,
+            default_tenant=config.default_tenant,
+            vocab_size=config.vocab_size,
+            max_new_tokens_cap=config.max_new_tokens_cap,
+            poll_interval_s=config.poll_interval_s,
+            request_timeout_s=config.request_timeout_s,
+            tokenizer=tokenizer,
+        )
+        self.server.start()
+        name_resolve.add(
+            names.gateway(self._expr, self._trial),
+            self.server.address,
+            replace=True,
+        )
+        from areal_tpu.observability import tracing
+
+        self._tracer = tracing.configure(config.trace, worker=self.worker_name)
+        self.logger.info(
+            "gateway worker serving on %s (tokenizer=%s)",
+            self.server.address,
+            config.tokenizer_path or "byte-codec",
+        )
+
+    def _poll(self) -> worker_base.PollResult:
+        # the HTTP server's handler threads do all the work; the poll
+        # loop just keeps the worker responsive to lifecycle commands
+        time.sleep(0.05)
+        return worker_base.PollResult(sample_count=0)
+
+    def _exit_hook(self):
+        if hasattr(self, "server"):
+            self.server.shutdown()
+        if hasattr(self, "manager_client"):
+            self.manager_client.close()

@@ -532,6 +532,34 @@ METRIC_TABLE = [
         "(staged | full)",
         ("mode",),
     ),
+    MetricSpec(
+        "areal_gserver_control_serve_batch_size",
+        "histogram",
+        "Requests drained per ROUTER serve tick (batch size; the "
+        "strict-lockstep rep mode never batches, so this family only "
+        "moves under serve_mode=router)",
+    ),
+    MetricSpec(
+        "areal_gserver_control_queue_depth",
+        "gauge",
+        "Control-plane requests pending at the start of the most "
+        "recent serve tick (drained backlog on the ROUTER socket)",
+    ),
+    MetricSpec(
+        "areal_gserver_control_requests_total",
+        "counter",
+        "Control-plane commands handled, by command name "
+        "(schedule_request | schedule_batch | gateway_submit | ...)",
+        ("cmd",),
+    ),
+    MetricSpec(
+        "areal_gserver_control_handler_seconds_total",
+        "counter",
+        "Cumulative seconds spent inside control-plane command "
+        "handlers, by command name — divide by requests_total for "
+        "mean handler latency",
+        ("cmd",),
+    ),
     # -- serving gateway (gateway/server.py + admission plane) ---------------
     MetricSpec(
         "areal_gateway_requests_total",

@@ -27,9 +27,11 @@ one accounting surface.
 
 from __future__ import annotations
 
+import heapq
 import pickle
+import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import zmq
 
@@ -45,6 +47,108 @@ logger = logging_.getLogger("gserver_manager")
 #: declared dead and its fleet-prefix directory entries are dropped (a
 #: dead owner must never be advertised as a pull source)
 _FABRIC_DEATH_MISSES = 3
+
+#: serve-batch-size histogram buckets (requests drained per ROUTER tick)
+_SERVE_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
+
+class _ObservedDict(dict):
+    """A dict that notifies ``on_set(key)`` on every key write.
+
+    The routing indexes are maintained incrementally off the deltas
+    scheduling applies to ``_server_load``/``_server_tokens`` — but
+    tests, dryrun harnesses, and operators mutate those maps DIRECTLY
+    (``m._server_load.update({...})``).  Observing writes at the dict
+    keeps the index honest against every writer without a second code
+    path.  Only write paths the load/token/device maps actually use are
+    observed (``d[k] = v`` and ``update``); reads are plain dict."""
+
+    __slots__ = ("_on_set",)
+
+    def __init__(self, data, on_set: Callable[[str], None]):
+        super().__init__(data)
+        self._on_set = on_set
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self._on_set(key)
+
+    def update(self, *args, **kwargs):
+        for k, v in dict(*args, **kwargs).items():
+            self[k] = v
+
+
+class _MinHeapIndex:
+    """Lazy-deletion min-heap over a fixed server pool.
+
+    Entries are ``(value(addr), pool_index, addr)`` — the pool-index
+    tie-break reproduces a linear ``min()`` scan's first-in-pool-order
+    winner exactly, so indexed picks are byte-identical to scan picks.
+    A write to the underlying map pushes a fresh entry (``touch``);
+    stale entries heal at pick time by re-pushing the addr at its
+    CURRENT value until the top entry is live.  Membership or device
+    changes rebuild the whole index (rare; see
+    ``GserverManager._route_index``)."""
+
+    __slots__ = ("_order", "_value", "_heap")
+
+    def __init__(self, pool: List[str], value: Callable[[str], float]):
+        self._order = {a: i for i, a in enumerate(pool)}
+        self._value = value
+        self._heap = [(value(a), i, a) for a, i in self._order.items()]
+        heapq.heapify(self._heap)
+
+    def touch(self, addr: str):
+        i = self._order.get(addr)
+        if i is None:
+            return
+        heap = self._heap
+        heapq.heappush(heap, (self._value(addr), i, addr))
+        if len(heap) > 64 + 8 * len(self._order):
+            # duplicate entries accumulate one per write; compact before
+            # the heap outgrows the pool by an order of magnitude
+            self._heap = [
+                (self._value(a), j, a) for a, j in self._order.items()
+            ]
+            heapq.heapify(self._heap)
+
+    def _settle(self):
+        """Replace stale top entries with the addr's current value until
+        the top is live.  Terminates: each pass converts one stale entry
+        and creates none."""
+        heap = self._heap
+        while heap:
+            v, i, a = heap[0]
+            cur = self._value(a)
+            if v == cur:
+                return
+            heapq.heapreplace(heap, (cur, i, a))
+
+    def min_value(self) -> float:
+        self._settle()
+        return self._heap[0][0]
+
+    def pick(self, avoid: Optional[str] = None) -> Optional[str]:
+        """The least-valued addr, excluding ``avoid`` — unless ``avoid``
+        is the only member, mirroring the scan path's
+        ``[a for a in pool if a != avoid] or list(pool)`` fallback."""
+        heap = self._heap
+        shelved = []
+        res = None
+        while heap:
+            v, i, a = heap[0]
+            if a == avoid:
+                shelved.append(heapq.heappop(heap))
+                continue
+            cur = self._value(a)
+            if v != cur:
+                heapq.heapreplace(heap, (cur, i, a))
+                continue
+            res = a
+            break
+        for e in shelved:
+            heapq.heappush(heap, e)
+        return res if res is not None else avoid
 
 
 class GserverManager(worker_base.Worker):
@@ -190,9 +294,20 @@ class GserverManager(worker_base.Worker):
         self.rollout_stat = RolloutStat()
         self._model_version = 0
 
-        # service socket
+        # service socket: ROUTER (default) drains and replies out of
+        # order — legacy REQ clients speak to it unchanged (their
+        # [identity, empty, body] envelope is echoed back per reply);
+        # "rep" restores the strict-lockstep loop
+        mode = getattr(config, "serve_mode", "router") or "router"
+        if mode not in ("router", "rep"):
+            raise ValueError(
+                f"unknown serve_mode {mode!r}; expected router | rep"
+            )
+        self._serve_mode = mode
         self._ctx = zmq.Context.instance()
-        self._sock = self._ctx.socket(zmq.REP)
+        self._sock = self._ctx.socket(
+            zmq.ROUTER if mode == "router" else zmq.REP
+        )
         port = self._sock.bind_to_random_port("tcp://*")
         self.addr = f"{network.gethostip()}:{port}"
         name_resolve.add(
@@ -285,6 +400,23 @@ class GserverManager(worker_base.Worker):
         # in-process gateway backend)
         self._m_gw_rejects = reg.counter(
             "areal_gateway_admission_rejects_total"
+        )
+        # control plane: requests drained per ROUTER serve tick, the
+        # queue depth observed at drain time, and per-command handler
+        # cost (count + seconds) — the series that say whether the
+        # serve loop itself is the bottleneck
+        self._m_ctl_batch = reg.histogram(
+            "areal_gserver_control_serve_batch_size",
+            buckets=_SERVE_BATCH_BUCKETS,
+        )
+        self._m_ctl_queue = reg.gauge(
+            "areal_gserver_control_queue_depth"
+        )
+        self._m_ctl_requests = reg.counter(
+            "areal_gserver_control_requests_total"
+        )
+        self._m_ctl_handler_s = reg.counter(
+            "areal_gserver_control_handler_seconds_total"
         )
         self._update_pool = None
 
@@ -391,6 +523,114 @@ class GserverManager(worker_base.Worker):
             self._admission = AdmissionPlane.from_config(
                 getattr(getattr(self, "config", None), "tenants", ())
             )
+        if not hasattr(self, "_state_lock"):
+            # guards scheduling state between the serve loop and the
+            # async weight-update thread (reentrant: handlers nest)
+            self._state_lock = threading.RLock()
+        if not hasattr(self, "_route_idx"):
+            # O(log N) routing indexes, built lazily on first indexed
+            # pick (the load/token maps may not exist yet when
+            # _configure first calls here)
+            self._route_idx = None
+        # observe direct writes to the load/token/device maps so the
+        # routing indexes stay honest against every writer (tests and
+        # dryrun harnesses mutate these dicts directly)
+        if hasattr(self, "_server_load") and not isinstance(
+            self._server_load, _ObservedDict
+        ):
+            self._server_load = _ObservedDict(
+                self._server_load, self._touch_load_index
+            )
+        if hasattr(self, "_server_tokens") and not isinstance(
+            self._server_tokens, _ObservedDict
+        ):
+            self._server_tokens = _ObservedDict(
+                self._server_tokens, self._touch_token_index
+            )
+        if hasattr(self, "_server_devices") and not isinstance(
+            self._server_devices, _ObservedDict
+        ):
+            self._server_devices = _ObservedDict(
+                self._server_devices, self._on_devices_write
+            )
+
+    # -- O(log N) routing indexes -------------------------------------------
+
+    def _touch_load_index(self, addr: str):
+        idx = getattr(self, "_route_idx", None)
+        if idx is not None:
+            idx["load"].touch(addr)
+
+    def _touch_token_index(self, addr: str):
+        idx = getattr(self, "_route_idx", None)
+        if idx is not None:
+            idx["tokens"].touch(addr)
+
+    def _on_devices_write(self, addr: str):
+        # a mesh-shape change moves every per-chip value AND the
+        # weighted RR cycle: rebuild wholesale (registration-time rare)
+        self._invalidate_route_index()
+
+    def _invalidate_route_index(self):
+        self._route_idx = None
+
+    def _route_index(self) -> Dict:
+        """The incremental routing indexes over the CURRENT route pool:
+        per-chip load and token min-heaps plus the precomputed weighted
+        round-robin cycle.  Rebuilt only when the pool object or its
+        membership count changes (in-place membership edits must call
+        ``_invalidate_route_index``), or when a mesh shape changes (the
+        device map is observed).  The heaps self-heal against direct
+        writes to the load/token maps via the observed-dict hooks."""
+        self._init_runtime_state()
+        pool = self._route_pool()
+        idx = self._route_idx
+        if (
+            idx is not None
+            and idx["pool"] is pool
+            and idx["n"] == len(pool)
+        ):
+            return idx
+        idx = {
+            "pool": pool,
+            "n": len(pool),
+            "load": _MinHeapIndex(
+                pool,
+                lambda a: self._server_load[a] / self._devices(a),
+            ),
+            "tokens": _MinHeapIndex(
+                pool,
+                lambda a: self._server_tokens[a] / self._devices(a),
+            ),
+            # each server appears once per chip, grouped in pool order,
+            # so slicing out an avoided server preserves the exact
+            # sequence the per-call rebuild produced
+            "cycle": [
+                a for a in pool for _ in range(self._devices(a))
+            ],
+        }
+        self._route_idx = idx
+        return idx
+
+    def _use_route_index(self) -> bool:
+        return bool(getattr(self.config, "routing_index", True))
+
+    def _ensure_update_pool(self):
+        """The shared background thread pool (weight-update fan-out,
+        backlog/fabric scrapes, the async update driver).  Sized one
+        past the client count so the async ``_flush_and_update`` job can
+        occupy a worker while its own fan-out subtasks still make
+        progress."""
+        import concurrent.futures as cf
+
+        if getattr(self, "_update_pool", None) is None:
+            self._update_pool = cf.ThreadPoolExecutor(
+                max_workers=min(
+                    33, max(2, len(getattr(self, "_clients", ())) + 1)
+                ),
+                thread_name_prefix="weight-update",
+            )
+        return self._update_pool
 
     def _refresh_prefill_backlog(self):
         """Keep the prefill-backlog estimates fresh WITHOUT ever
@@ -451,14 +691,7 @@ class GserverManager(worker_base.Worker):
         def _scrape_all(addrs):
             return {a: _scrape_one(a) for a in addrs}
 
-        import concurrent.futures as cf
-
-        if getattr(self, "_update_pool", None) is None:
-            self._update_pool = cf.ThreadPoolExecutor(
-                max_workers=min(32, max(1, len(self._clients))),
-                thread_name_prefix="weight-update",
-            )
-        self._backlog_fut = self._update_pool.submit(
+        self._backlog_fut = self._ensure_update_pool().submit(
             _scrape_all, list(self._prefill_addrs)
         )
 
@@ -567,14 +800,7 @@ class GserverManager(worker_base.Worker):
         def _scrape_all(addrs):
             return {a: _scrape_one(a) for a in addrs}
 
-        import concurrent.futures as cf
-
-        if getattr(self, "_update_pool", None) is None:
-            self._update_pool = cf.ThreadPoolExecutor(
-                max_workers=min(32, max(1, len(self._clients))),
-                thread_name_prefix="weight-update",
-            )
-        self._fabric_scrape_fut = self._update_pool.submit(
+        self._fabric_scrape_fut = self._ensure_update_pool().submit(
             _scrape_all, list(self._route_pool())
         )
 
@@ -787,32 +1013,12 @@ class GserverManager(worker_base.Worker):
         # whose signal differs from the imbalance signal (least_requests
         # on a few-huge-conversations server) re-picks the very server
         # the escape meant to leave
-        route_pool = self._route_pool()
-        pool = [a for a in route_pool if a != avoid] or list(route_pool)
         if sibling is not None:
             addr = sibling
-        elif self.config.schedule_policy == "least_requests":
-            # PER-CHIP load: a 4-chip mesh server should carry 4x the
-            # requests of a single-chip one before looking "busier"
-            addr = min(
-                pool, key=lambda a: self._server_load[a] / self._devices(a)
-            )
-        elif self.config.schedule_policy == "least_token_usage":
-            # route by estimated resident tokens PER CHIP: prompt + 0.4x
-            # budget (the reference's expected-completion discount,
-            # gserver_manager :400-405) — a far better KV-pressure signal
-            # than request count, normalized by the mesh's capacity
-            addr = min(
-                pool,
-                key=lambda a: self._server_tokens[a] / self._devices(a),
-            )
-        else:  # round_robin (policy validated at _configure)
-            # weighted cycle: each server appears once per chip, so the
-            # rotation hands a 4-chip mesh 4 of every (4+1) requests in
-            # a {4-chip, 1-chip} fleet
-            wpool = [a for a in pool for _ in range(self._devices(a))]
-            addr = wpool[self._round_robin % len(wpool)]
-            self._round_robin += 1
+        elif self._use_route_index():
+            addr = self._pick_indexed(avoid)
+        else:
+            addr = self._pick_scan(avoid)
         self._qid_server[qid] = addr
         self._group_server[group] = addr
         if self.config.cache_aware_routing:
@@ -835,6 +1041,55 @@ class GserverManager(worker_base.Worker):
         self._server_tokens[addr] += est
         gt = self._group_tokens.setdefault(group, {})
         gt[addr] = gt.get(addr, 0.0) + est
+        return addr
+
+    def _pick_scan(self, avoid: Optional[str]) -> str:
+        """The original O(N)-over-pool policy picks — kept callable for
+        the scan-vs-indexed parity tests and ``routing_index=False``."""
+        route_pool = self._route_pool()
+        pool = [a for a in route_pool if a != avoid] or list(route_pool)
+        if self.config.schedule_policy == "least_requests":
+            # PER-CHIP load: a 4-chip mesh server should carry 4x the
+            # requests of a single-chip one before looking "busier"
+            return min(
+                pool, key=lambda a: self._server_load[a] / self._devices(a)
+            )
+        if self.config.schedule_policy == "least_token_usage":
+            # route by estimated resident tokens PER CHIP: prompt + 0.4x
+            # budget (the reference's expected-completion discount,
+            # gserver_manager :400-405) — a far better KV-pressure signal
+            # than request count, normalized by the mesh's capacity
+            return min(
+                pool,
+                key=lambda a: self._server_tokens[a] / self._devices(a),
+            )
+        # round_robin (policy validated at _configure): weighted cycle —
+        # each server appears once per chip, so the rotation hands a
+        # 4-chip mesh 4 of every (4+1) requests in a {4-chip, 1-chip}
+        # fleet
+        wpool = [a for a in pool for _ in range(self._devices(a))]
+        addr = wpool[self._round_robin % len(wpool)]
+        self._round_robin += 1
+        return addr
+
+    def _pick_indexed(self, avoid: Optional[str]) -> str:
+        """Index-backed policy picks, pick-for-pick identical to
+        ``_pick_scan``: the heaps' pool-index tie-break reproduces the
+        scan ``min()``'s first-in-pool-order winner, and the RR cycle is
+        grouped per server in pool order so excluding the avoided server
+        yields exactly the per-call rebuild's sequence."""
+        idx = self._route_index()
+        if self.config.schedule_policy == "least_requests":
+            return idx["load"].pick(avoid)
+        if self.config.schedule_policy == "least_token_usage":
+            return idx["tokens"].pick(avoid)
+        cycle = idx["cycle"]
+        if avoid is not None:
+            # escape-hatch path only (rare): materialize the reduced
+            # cycle; the common no-avoid pick stays O(1)
+            cycle = [a for a in cycle if a != avoid] or cycle
+        addr = cycle[self._round_robin % len(cycle)]
+        self._round_robin += 1
         return addr
 
     def _affine_server(
@@ -870,9 +1125,12 @@ class GserverManager(worker_base.Worker):
         # and would otherwise trip the escape on every long session).
         own = self._group_tokens.get(group, {}).get(cand, 0.0)
         foreign = (self._server_tokens[cand] - own) / self._devices(cand)
-        least = min(
-            self._server_tokens[a] / self._devices(a) for a in pool
-        )
+        if self._use_route_index():
+            least = self._route_index()["tokens"].min_value()
+        else:
+            least = min(
+                self._server_tokens[a] / self._devices(a) for a in pool
+            )
         if foreign > (
             self.config.affinity_imbalance_factor * least
             + self.config.affinity_imbalance_slack_tokens
@@ -1093,13 +1351,9 @@ class GserverManager(worker_base.Worker):
             return {addr: fn(addr, client) for addr, client in items}
         import concurrent.futures as cf
 
-        if getattr(self, "_update_pool", None) is None:
-            self._update_pool = cf.ThreadPoolExecutor(
-                max_workers=min(32, len(self._clients)),
-                thread_name_prefix="weight-update",
-            )
+        pool = self._ensure_update_pool()
         futs = {
-            self._update_pool.submit(fn, addr, client): addr
+            pool.submit(fn, addr, client): addr
             for addr, client in items
         }
         return {futs[f]: f.result() for f in cf.as_completed(futs)}
@@ -1123,7 +1377,13 @@ class GserverManager(worker_base.Worker):
              bump exactly like the legacy path.
 
         Legacy protocol (flag off, or an HF-format cross-job swap):
-        pause, concurrent full ``update_weights``, resume."""
+        pause, concurrent full ``update_weights``, resume.
+
+        Under the ROUTER serve loop this runs OFF the serve thread (see
+        ``_start_weight_update``): only the final version-bump +
+        directory-invalidation step touches scheduling state, under the
+        state lock — the slow RPC fan-out never blocks scheduling."""
+        self._init_runtime_state()
         version = info["version"]
         payload = {
             "path": info["path"],
@@ -1229,14 +1489,15 @@ class GserverManager(worker_base.Worker):
                 failed[:2],
             )
             return
-        self._model_version = version
-        # the fleet-wide flush that just happened emptied every cache
-        # tier: drop the prefix directory AND the hot-prefix affinity
-        # sums (leaving them would pin sessions to servers whose caches
-        # are empty — the stale-affinity bug — and would let the
-        # directory advertise flushed prefixes until the next epoch
-        # scrape noticed)
-        self._invalidate_fabric_all("weight_update")
+        with self._state_lock:
+            self._model_version = version
+            # the fleet-wide flush that just happened emptied every
+            # cache tier: drop the prefix directory AND the hot-prefix
+            # affinity sums (leaving them would pin sessions to servers
+            # whose caches are empty — the stale-affinity bug — and
+            # would let the directory advertise flushed prefixes until
+            # the next epoch scrape noticed)
+            self._invalidate_fabric_all("weight_update")
         self.logger.info(
             "weights updated to v%d on %d servers (%d interrupted, "
             "%s, fleet paused %.3fs)",
@@ -1249,99 +1510,227 @@ class GserverManager(worker_base.Worker):
 
     # -- poll ---------------------------------------------------------------
 
+    def _gateway_admit(self, payload: Dict) -> Dict:
+        """The tenant admission decision for one gateway request."""
+        self._init_runtime_state()
+        tenant = str(payload["tenant"])
+        dec = self._admission.admit(
+            tenant,
+            float(payload.get("tokens", 0.0)),
+            time.monotonic(),
+        )
+        if not dec.ok:
+            self._m_gw_rejects.inc(reason=dec.reason)
+        root = str(payload.get("qid") or tenant)
+        self._tracer.event(
+            root, "gserver.gateway_admit", root=root,
+            tenant=tenant, ok=dec.ok, reason=dec.reason,
+        )
+        return dec.as_dict()
+
+    def _gateway_submit(self, payload: Dict) -> Dict:
+        """Admission AND schedule in ONE round trip: the gateway's
+        per-request ``gateway_admit`` + ``schedule_request`` pair
+        collapsed into a single manager call.  An admitted decision
+        carries the schedule response under ``"schedule"``; a rejected
+        one is exactly the ``gateway_admit`` reject (no placement is
+        registered, so there is nothing to release on reject)."""
+        resp = self._gateway_admit(payload)
+        if resp.get("ok") and payload.get("qid"):
+            resp["schedule"] = self._schedule_request(
+                str(payload["qid"]),
+                int(payload.get("prompt_len", 0)),
+                int(payload.get("new_token_budget", 0)),
+            )
+        return resp
+
+    def _handle_request(self, cmd: str, payload: Dict):
+        """One command's response — shared by the REP and ROUTER serve
+        loops (and callable directly by tests/bench without a socket).
+        Raises on malformed payloads; the serve loops turn exceptions
+        into ``{"error": ...}`` replies."""
+        if cmd == "schedule_request":
+            return self._schedule_request(
+                payload["qid"],
+                payload.get("prompt_len", 0),
+                payload.get("new_token_budget", 0),
+            )
+        if cmd == "schedule_batch":
+            # group siblings' first chunks in one RPC: one lock pass,
+            # one round trip (affinity co-locates them anyway).
+            # Payload: {"qids": [...], "prompt_len", "new_token_budget"}
+            # (siblings share one prompt), responses in qid order.
+            return {
+                "responses": [
+                    self._schedule_request(
+                        str(q),
+                        payload.get("prompt_len", 0),
+                        payload.get("new_token_budget", 0),
+                    )
+                    for q in payload.get("qids", ())
+                ]
+            }
+        if cmd == "allocate_rollout":
+            return self._allocate_rollout(
+                payload["qid"],
+                float(payload.get("tokens", 0.0)),
+                payload.get("tenant"),
+            )
+        if cmd == "gateway_admit":
+            return self._gateway_admit(payload)
+        if cmd == "gateway_submit":
+            return self._gateway_submit(payload)
+        if cmd == "gateway_finish":
+            self._init_runtime_state()
+            self._admission.settle(
+                str(payload["tenant"]),
+                float(payload.get("reserved_tokens", 0.0)),
+                float(payload.get("used_tokens", 0.0)),
+            )
+            if payload.get("qid"):
+                self._release_scheduled(str(payload["qid"]))
+            return "ok"
+        if cmd == "gateway_reset_budget":
+            self._init_runtime_state()
+            self._admission.reset_budget(str(payload["tenant"]))
+            return "ok"
+        if cmd == "finish_rollout":
+            self._finish_rollout(
+                payload["qid"], payload.get("accepted", True)
+            )
+            return "ok"
+        if cmd == "get_status":
+            self._init_runtime_state()
+            return {
+                "version": self._model_version,
+                "n_running_rollouts": self.rollout_stat.running,
+                "accepted_rollouts": self.rollout_stat.accepted,
+                **{
+                    f"rollout_stat/{k}": v
+                    for k, v in self.rollout_stat.as_dict().items()
+                },
+                "server_load": dict(self._server_load),
+                "server_tokens": dict(self._server_tokens),
+                "server_mesh_devices": {
+                    a: self._devices(a) for a in self.server_addrs
+                },
+                "server_roles": dict(
+                    getattr(self, "_server_role", {})
+                ),
+                "pd_enabled": getattr(self, "_pd_enabled", False),
+                "prefill_backlog_tokens": {
+                    a: self._prefill_backlog.get(a, 0.0)
+                    + self._prefill_backlog_local.get(a, 0.0)
+                    for a in getattr(self, "_prefill_addrs", ())
+                },
+                "kv_fabric_directory_entries": len(
+                    self._fabric_stamp
+                ),
+                "server_transports": dict(
+                    getattr(self, "_server_transport", {})
+                ),
+                "tenants": self._admission.stats(),
+            }
+        return {"error": f"unknown command {cmd}"}
+
+    def _dispatch(self, body: bytes):
+        """Decode one wire message, run its handler, meter it.  Never
+        raises: failures become the ``{"error": ...}`` response the
+        client raises RuntimeError on."""
+        t0 = time.monotonic()
+        cmd = "?"
+        try:
+            cmd, payload = pickle.loads(body)
+            resp = self._handle_request(cmd, payload)
+        except Exception as e:  # noqa: BLE001
+            self.logger.exception("request failed")
+            resp = {"error": repr(e)}
+        self._m_ctl_requests.inc(cmd=str(cmd))
+        self._m_ctl_handler_s.inc(time.monotonic() - t0, cmd=str(cmd))
+        return resp
+
     def _serve(self):
+        if getattr(self, "_serve_mode", "rep") == "router":
+            return self._serve_router()
+        return self._serve_rep()
+
+    def _serve_rep(self):
+        """Legacy strict-lockstep REP loop (serve_mode="rep")."""
         for _ in range(64):
             try:
                 msg = self._sock.recv(flags=zmq.NOBLOCK)
             except zmq.ZMQError:
                 return
-            try:
-                cmd, payload = pickle.loads(msg)
-                if cmd == "schedule_request":
-                    resp = self._schedule_request(
-                        payload["qid"],
-                        payload.get("prompt_len", 0),
-                        payload.get("new_token_budget", 0),
-                    )
-                elif cmd == "allocate_rollout":
-                    resp = self._allocate_rollout(
-                        payload["qid"],
-                        float(payload.get("tokens", 0.0)),
-                        payload.get("tenant"),
-                    )
-                elif cmd == "gateway_admit":
-                    self._init_runtime_state()
-                    tenant = str(payload["tenant"])
-                    dec = self._admission.admit(
-                        tenant,
-                        float(payload.get("tokens", 0.0)),
-                        time.monotonic(),
-                    )
-                    if not dec.ok:
-                        self._m_gw_rejects.inc(reason=dec.reason)
-                    root = str(payload.get("qid") or tenant)
-                    self._tracer.event(
-                        root, "gserver.gateway_admit", root=root,
-                        tenant=tenant, ok=dec.ok, reason=dec.reason,
-                    )
-                    resp = dec.as_dict()
-                elif cmd == "gateway_finish":
-                    self._init_runtime_state()
-                    self._admission.settle(
-                        str(payload["tenant"]),
-                        float(payload.get("reserved_tokens", 0.0)),
-                        float(payload.get("used_tokens", 0.0)),
-                    )
-                    if payload.get("qid"):
-                        self._release_scheduled(str(payload["qid"]))
-                    resp = "ok"
-                elif cmd == "gateway_reset_budget":
-                    self._init_runtime_state()
-                    self._admission.reset_budget(str(payload["tenant"]))
-                    resp = "ok"
-                elif cmd == "finish_rollout":
-                    self._finish_rollout(
-                        payload["qid"], payload.get("accepted", True)
-                    )
-                    resp = "ok"
-                elif cmd == "get_status":
-                    self._init_runtime_state()
-                    resp = {
-                        "version": self._model_version,
-                        "n_running_rollouts": self.rollout_stat.running,
-                        "accepted_rollouts": self.rollout_stat.accepted,
-                        **{
-                            f"rollout_stat/{k}": v
-                            for k, v in self.rollout_stat.as_dict().items()
-                        },
-                        "server_load": dict(self._server_load),
-                        "server_tokens": dict(self._server_tokens),
-                        "server_mesh_devices": {
-                            a: self._devices(a) for a in self.server_addrs
-                        },
-                        "server_roles": dict(
-                            getattr(self, "_server_role", {})
-                        ),
-                        "pd_enabled": getattr(self, "_pd_enabled", False),
-                        "prefill_backlog_tokens": {
-                            a: self._prefill_backlog.get(a, 0.0)
-                            + self._prefill_backlog_local.get(a, 0.0)
-                            for a in getattr(self, "_prefill_addrs", ())
-                        },
-                        "kv_fabric_directory_entries": len(
-                            self._fabric_stamp
-                        ),
-                        "server_transports": dict(
-                            getattr(self, "_server_transport", {})
-                        ),
-                        "tenants": self._admission.stats(),
-                    }
-                else:
-                    resp = {"error": f"unknown command {cmd}"}
-            except Exception as e:  # noqa: BLE001
-                self.logger.exception("request failed")
-                resp = {"error": repr(e)}
+            with self._state_lock:
+                resp = self._dispatch(msg)
             self._sock.send(pickle.dumps(resp))
+
+    def _serve_router(self):
+        """Concurrent batched serve loop: drain every pending request
+        (up to ``serve_batch_max``) off the ROUTER socket, process the
+        whole batch under ONE lock pass, and reply per request as
+        computed — replies go out in arrival order here, but the socket
+        is free to interleave clients, so a storm of slow-to-drain
+        peers never wedges the strict REP lockstep.  Each request's
+        [identity, ...] envelope frames are echoed back verbatim, which
+        is exactly what a legacy REQ client expects."""
+        sock = self._sock
+        cap = max(1, int(getattr(self.config, "serve_batch_max", 256)))
+        batch = []
+        while len(batch) < cap:
+            try:
+                batch.append(sock.recv_multipart(flags=zmq.NOBLOCK))
+            except zmq.ZMQError:
+                break
+        self._m_ctl_queue.set(float(len(batch)))
+        if not batch:
+            return
+        self._m_ctl_batch.observe(float(len(batch)))
+        with self._state_lock:
+            for parts in batch:
+                *envelope, body = parts
+                resp = self._dispatch(body)
+                try:
+                    sock.send_multipart(
+                        envelope + [pickle.dumps(resp)],
+                        flags=zmq.NOBLOCK,
+                    )
+                except zmq.ZMQError:
+                    # unroutable identity (client vanished) or a full
+                    # send queue: drop the reply — the client's timeout
+                    # path discards its socket and retries
+                    self.logger.warning(
+                        "dropped reply to a vanished/stalled client"
+                    )
+
+    def _harvest_weight_update(self):
+        """Reap a finished async weight-update job (surfacing its
+        exception to the log); leaves an unfinished one running."""
+        fut = getattr(self, "_weight_update_fut", None)
+        if fut is None or not fut.done():
+            return
+        self._weight_update_fut = None
+        try:
+            fut.result()
+        except Exception:  # noqa: BLE001 - next poll retries the version
+            self.logger.exception("async weight update crashed")
+
+    def _start_weight_update(self, info: Dict):
+        """Run the weight-update fan-out OFF the serve thread (ROUTER
+        mode): the minutes-long stage/pause/commit RPC round must never
+        stall scheduling.  One update in flight at a time — while it
+        runs, ``_check_new_params`` keeps returning the pending (or a
+        newer) version and the next poll picks it up after harvest.
+        REP mode keeps the legacy inline call (hand-built managers and
+        the A/B baseline depend on its synchronous semantics)."""
+        if getattr(self, "_serve_mode", "rep") != "router":
+            self._flush_and_update(info)
+            return
+        if getattr(self, "_weight_update_fut", None) is not None:
+            return
+        self._weight_update_fut = self._ensure_update_pool().submit(
+            self._flush_and_update, info
+        )
 
     def _poll(self) -> worker_base.PollResult:
         self._serve()
@@ -1352,9 +1741,10 @@ class GserverManager(worker_base.Worker):
         self._refresh_fabric_epochs()
         if time.monotonic() - self._last_version_check > 0.5:
             self._last_version_check = time.monotonic()
+            self._harvest_weight_update()
             info = self._check_new_params()
             if info is not None:
-                self._flush_and_update(info)
+                self._start_weight_update(info)
             self._export_metrics()
         return worker_base.PollResult(sample_count=1)
 
@@ -1367,12 +1757,25 @@ class GserverManager(worker_base.Worker):
 
 
 class GserverManagerClient:
-    """Blocking REQ client used by rollout workers."""
+    """Blocking REQ client used by rollout workers and the gateway.
 
-    def __init__(self, experiment_name: str, trial_name: str, timeout=60.0):
-        addr = name_resolve.wait(
-            names.gen_server_manager(experiment_name, trial_name), timeout=120
-        )
+    REQ speaks to BOTH manager serve modes: the ROUTER loop echoes the
+    REQ envelope back per reply, so this client never changed when the
+    serve loop did.  ``addr`` skips name_resolve discovery (bench
+    harnesses and tests that bind their own manager socket)."""
+
+    def __init__(
+        self,
+        experiment_name: Optional[str] = None,
+        trial_name: Optional[str] = None,
+        timeout=60.0,
+        addr: Optional[str] = None,
+    ):
+        if addr is None:
+            addr = name_resolve.wait(
+                names.gen_server_manager(experiment_name, trial_name),
+                timeout=120,
+            )
         self._ctx = zmq.Context.instance()
         import threading
 

@@ -38,9 +38,15 @@ class PartialRolloutManager:
         max_rpc_retries: int = 3,
         rpc_retry_backoff_s: float = 0.5,
         workload: str = "rollout",
+        batch_schedule: bool = True,
     ):
         self.manager_client = manager_client
         self.gconfig = gconfig
+        # schedule all group siblings' first chunks in ONE manager RPC
+        # (schedule_batch); flips off permanently on the first manager
+        # that answers "unknown command" (wire compat with old managers)
+        self.batch_schedule = bool(batch_schedule)
+        self._batch_ok = True
         # SLO/tenant label every chunk of this manager's traffic carries
         # (RolloutWorkerConfig.workload): it segments the fleet-merged
         # latency percentiles AND marks the rows as bulk-priority so the
@@ -64,6 +70,7 @@ class PartialRolloutManager:
     async def _gen_chunk(
         self, qid: str, tag: int, prompt_ids: List[int], cur: List[int],
         chunk: int, root: Optional[str] = None,
+        presched: Optional[Dict] = None,
     ) -> Tuple[model_api.APIGenerateOutput, int]:
         """Schedule + generate ONE chunk, retrying transient RPC failures
         with capped exponential backoff.  A timed-out schedule or a
@@ -99,37 +106,46 @@ class PartialRolloutManager:
                 attempt=attempt, gen_qid=gen_qid,
             )
             t_sched = time.monotonic()
-            try:
-                sched = await asyncio.to_thread(
-                    self.manager_client.call,
-                    "schedule_request",
-                    {
-                        "qid": qid,
-                        # load signal for cache-aware / token-usage routing
-                        "prompt_len": len(cur),
-                        "new_token_budget": chunk,
-                    },
-                )
-            except self.TRANSIENT_ERRORS as e:
-                # scheduling never reached a generation server: no orphan
-                # row can exist, so the id is NOT retired (retiring it
-                # here would abandon a parked row the next chunk could
-                # have resumed prefill-free)
-                last_exc = e
-                self._trace_retry(qid, root, "schedule", attempt, e)
-                logger.warning(
-                    "transient RPC failure scheduling %s (attempt %d/%d): "
-                    "%r",
-                    qid, attempt + 1, self.max_rpc_retries, e,
-                )
-                continue
+            if attempt == 0 and presched is not None:
+                # this member's first chunk was already placed by the
+                # group's one schedule_batch RPC; retries (and every
+                # later chunk) re-schedule per-qid as before
+                sched = presched["sched"]
+                sched_wait = presched["wait_s"]
+            else:
+                try:
+                    sched = await asyncio.to_thread(
+                        self.manager_client.call,
+                        "schedule_request",
+                        {
+                            "qid": qid,
+                            # load signal for cache-aware / token-usage
+                            # routing
+                            "prompt_len": len(cur),
+                            "new_token_budget": chunk,
+                        },
+                    )
+                except self.TRANSIENT_ERRORS as e:
+                    # scheduling never reached a generation server: no
+                    # orphan row can exist, so the id is NOT retired
+                    # (retiring it here would abandon a parked row the
+                    # next chunk could have resumed prefill-free)
+                    last_exc = e
+                    self._trace_retry(qid, root, "schedule", attempt, e)
+                    logger.warning(
+                        "transient RPC failure scheduling %s "
+                        "(attempt %d/%d): %r",
+                        qid, attempt + 1, self.max_rpc_retries, e,
+                    )
+                    continue
+                sched_wait = time.monotonic() - t_sched
             try:
                 client = self._client(sched["url"])
                 metadata = {
                     # SLO plane: client-observed routing latency, stamped
                     # on THIS clock (no cross-host skew) — the engine
                     # folds it into the request's LatencyRecord
-                    "slo_schedule_wait_s": time.monotonic() - t_sched,
+                    "slo_schedule_wait_s": sched_wait,
                     # tenant/workload label (per-workload SLO rows) +
                     # bulk priority class: rollout rows yield to
                     # interactive gateway rows under pool pressure
@@ -196,7 +212,11 @@ class PartialRolloutManager:
         )
 
     async def _gen_one(
-        self, qid: str, prompt_ids: List[int], root: Optional[str] = None
+        self,
+        qid: str,
+        prompt_ids: List[int],
+        root: Optional[str] = None,
+        presched: Optional[Dict] = None,
     ) -> model_api.APIGenerateOutput:
         remaining = self.gconfig.max_new_tokens
         cur = list(prompt_ids)
@@ -211,8 +231,10 @@ class PartialRolloutManager:
         while remaining > 0:
             chunk = min(self.new_tokens_per_chunk, remaining)
             out, tag = await self._gen_chunk(
-                qid, tag, prompt_ids, cur, chunk, root=root
+                qid, tag, prompt_ids, cur, chunk, root=root,
+                presched=presched,
             )
+            presched = None  # only the first chunk was batch-placed
             n_chunks += 1
             if version_start is None:
                 version_start = out.version_start
@@ -241,16 +263,79 @@ class PartialRolloutManager:
             version_end=version_end,
         )
 
+    async def _schedule_siblings(
+        self, member_qids: List[str], prompt_len: int, chunk: int
+    ) -> Optional[List[Dict]]:
+        """Place every group member's FIRST chunk with one
+        ``schedule_batch`` RPC (affinity co-locates siblings anyway, so
+        batching costs nothing and saves group_size-1 round trips).
+        Returns per-member ``{"sched", "wait_s"}`` records, or None to
+        fall back to per-member scheduling — an old manager that does
+        not know the command flips batching off permanently; a
+        transient failure just skips it this once (each member's own
+        retry machinery handles its first chunk)."""
+        if not (
+            self.batch_schedule
+            and self._batch_ok
+            and len(member_qids) > 1
+            and chunk > 0
+        ):
+            return None
+        t0 = time.monotonic()
+        try:
+            resp = await asyncio.to_thread(
+                self.manager_client.call,
+                "schedule_batch",
+                {
+                    "qids": list(member_qids),
+                    "prompt_len": prompt_len,
+                    "new_token_budget": chunk,
+                },
+            )
+            scheds = resp["responses"]
+        except RuntimeError as e:
+            self._batch_ok = False
+            logger.warning(
+                "manager rejected schedule_batch (%r); falling back to "
+                "per-member scheduling for good", e,
+            )
+            return None
+        except self.TRANSIENT_ERRORS as e:
+            logger.warning(
+                "transient RPC failure batch-scheduling %d siblings "
+                "(%r); members schedule individually",
+                len(member_qids), e,
+            )
+            return None
+        if len(scheds) != len(member_qids):
+            self._batch_ok = False
+            logger.warning(
+                "schedule_batch answered %d/%d placements; falling back",
+                len(scheds), len(member_qids),
+            )
+            return None
+        wait = time.monotonic() - t0
+        return [{"sched": s, "wait_s": wait} for s in scheds]
+
     async def generate_group(
         self, qid: str, prompt_ids: List[int], group_size: int
     ) -> model_api.BundledGenerationOutputs:
         # qid is rollout-level ("{rollout}" or "{rollout}@t{j}"): the
         # trace root is the rollout qid, shared by every member/attempt
         root = qid.split("@", 1)[0]
+        members = [f"{qid}-{i}" for i in range(group_size)]
+        presched = await self._schedule_siblings(
+            members,
+            len(prompt_ids),
+            min(self.new_tokens_per_chunk, self.gconfig.max_new_tokens),
+        )
         outs = await asyncio.gather(
             *(
-                self._gen_one(f"{qid}-{i}", prompt_ids, root=root)
-                for i in range(group_size)
+                self._gen_one(
+                    m, prompt_ids, root=root,
+                    presched=presched[i] if presched else None,
+                )
+                for i, m in enumerate(members)
             )
         )
         outs = list(outs)

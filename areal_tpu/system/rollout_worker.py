@@ -61,6 +61,7 @@ class RolloutWorker(worker_base.AsyncWorker):
             new_tokens_per_chunk=config.new_tokens_per_chunk,
             request_timeout=config.rollout_request_timeout,
             workload=getattr(config, "workload", "rollout"),
+            batch_schedule=getattr(config, "batch_schedule", True),
         )
         self.pusher = NameResolvingZmqPusher(
             self._expr, self._trial, pusher_index=dp_rank

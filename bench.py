@@ -3415,6 +3415,330 @@ def bench_gateway_ab(
     return out
 
 
+def bench_control_plane_ab(
+    n_servers=64,
+    n_groups=48,
+    group_size=16,
+    n_gateway=96,
+    n_threads=16,
+    prompt_len=128,
+    new_tokens=64,
+    update_rpc_s=0.05,
+):
+    """Manager control-plane A/B: schedules/sec and p99 schedule wait
+    under a mixed rollout+gateway storm at ``n_servers`` registered
+    fake servers, across the two serve loops (strict-lockstep REP vs
+    batched ROUTER) and the two pick paths (O(N) scan vs O(log N)
+    incremental indexes).  Pure CPU — no engine, no real gen servers:
+    the managers are hand-built with fake addresses but serve over
+    REAL ZMQ sockets with real threaded ``GserverManagerClient``s, so
+    the arms measure the actual wire + serve-loop + scheduling stack.
+
+    Storm shape: ``n_groups`` rollout groups of ``group_size`` siblings
+    plus ``n_gateway`` interactive requests, spread over ``n_threads``
+    client threads.  The baseline arms issue one RPC per sibling and
+    an admit+schedule RPC pair per gateway request (the pre-batching
+    client protocol); the fully-optimized arm issues one
+    ``schedule_batch`` per group and one combined ``gateway_submit``
+    per gateway request.  Every arm also gets the SAME mid-storm
+    weight-update publication (real ``_flush_and_update`` fan-out over
+    fake per-server clients whose RPCs sleep ``update_rpc_s``): the
+    rep arms pay it INLINE on the serve thread — the pre-ROUTER
+    behavior — while the router arms run it on the update pool, so
+    scheduling never stalls.  The acceptance bar is >= 5x
+    schedules/sec for router+indexed+batched vs rep+scan+unbatched;
+    ``parity`` reports scan-vs-indexed pick identity over a
+    deterministic mixed trace for all three policies (the exhaustive
+    version is a tier-1 property test)."""
+    import queue as queue_mod
+    import threading
+
+    from areal_tpu.api.system_api import GserverManagerConfig
+    from areal_tpu.base import logging_
+    from areal_tpu.base.monitor import RolloutStat
+    from areal_tpu.system.gserver_manager import (
+        GserverManager,
+        GserverManagerClient,
+    )
+
+    class _FakeGenClient:
+        """Stands in for a GenServerClient during the weight-update
+        fan-out: every RPC just sleeps the configured latency."""
+
+        def call(self, cmd, payload, timeout=None):
+            time.sleep(update_rpc_s)
+            if cmd == "update_weights":
+                return {"num_interrupted": 0}
+            return {}
+
+    def mk_manager(serve_mode, indexed, policy="least_requests",
+                   bind=True):
+        m = GserverManager.__new__(GserverManager)
+        m.config = GserverManagerConfig(
+            schedule_policy=policy,
+            n_servers=n_servers,
+            serve_mode=serve_mode,
+            routing_index=indexed,
+        )
+        m.server_addrs = [f"fs{i}" for i in range(n_servers)]
+        m.logger = logging_.getLogger("bench-cp")
+        m._round_robin = 0
+        m._qid_server = {}
+        m._server_load = {a: 0 for a in m.server_addrs}
+        m._server_tokens = {a: 0.0 for a in m.server_addrs}
+        m._server_devices = {a: 1 for a in m.server_addrs}
+        m._server_mesh = {a: "" for a in m.server_addrs}
+        m._qid_tokens = {}
+        m._group_server = {}
+        m._group_prefix = {}
+        m._group_tokens = {}
+        m.rollout_stat = RolloutStat()
+        m._model_version = 0
+        m._expr, m._trial = "bench-cp", f"{serve_mode}-{int(indexed)}"
+        m._clients = {a: _FakeGenClient() for a in m.server_addrs}
+        m._init_metrics()
+        if bind:
+            import zmq as _zmq
+
+            m._serve_mode = serve_mode
+            m._ctx = _zmq.Context.instance()
+            m._sock = m._ctx.socket(
+                _zmq.ROUTER if serve_mode == "router" else _zmq.REP
+            )
+            port = m._sock.bind_to_random_port("tcp://127.0.0.1")
+            m.addr = f"127.0.0.1:{port}"
+        return m
+
+    def _pct(vals, q):
+        return round(float(np.percentile(np.asarray(vals, float), q)), 6)
+
+    est_tokens = float(prompt_len + new_tokens)
+    n_schedules = n_groups * group_size + n_gateway
+
+    def run_arm(serve_mode, indexed, batched):
+        m = mk_manager(serve_mode, indexed)
+        stop = threading.Event()
+        fire_update = threading.Event()
+        update_info = {"version": 1, "path": "bench-ckpt-v1",
+                       "format": "hf"}
+
+        def serve():
+            # the worker's _poll loop, minus the scrapes: serve, then
+            # kick a published weight update when one appears.  Blocking
+            # on the socket (instead of NOBLOCK-spinning) keeps the GIL
+            # free for the in-process client threads — in deployment
+            # the manager is its own process and never shares one.
+            fired = False
+            while not stop.is_set():
+                if m._sock.poll(timeout=10):
+                    m._serve()
+                if fire_update.is_set() and not fired:
+                    fired = True
+                    # rep mode: runs INLINE right here, stalling every
+                    # queued schedule; router mode: hops to the update
+                    # pool and this loop keeps serving
+                    m._start_weight_update(update_info)
+
+        st = threading.Thread(target=serve, daemon=True,
+                              name=f"cp-serve-{serve_mode}")
+        st.start()
+
+        jobs = queue_mod.Queue()
+        for g in range(n_groups):
+            jobs.put(("rollout", g))
+        for i in range(n_gateway):
+            jobs.put(("gateway", i))
+        waits = []  # one entry per LOGICAL schedule decision
+        rpcs = [0]
+        lock = threading.Lock()
+        errors = []
+        barrier = threading.Barrier(n_threads + 1)
+
+        def worker():
+            client = GserverManagerClient(addr=m.addr, timeout=60.0)
+            try:
+                barrier.wait()
+                while True:
+                    try:
+                        kind, i = jobs.get_nowait()
+                    except queue_mod.Empty:
+                        return
+                    local, n_rpc = [], 0
+                    if kind == "rollout":
+                        qids = [f"r{i}-{j}" for j in range(group_size)]
+                        if batched:
+                            t0 = time.perf_counter()
+                            out = client.call("schedule_batch", {
+                                "qids": qids,
+                                "prompt_len": prompt_len,
+                                "new_token_budget": new_tokens,
+                            })
+                            dt = time.perf_counter() - t0
+                            n_rpc += 1
+                            assert len(out["responses"]) == group_size
+                            local = [dt] * group_size
+                        else:
+                            for q in qids:
+                                t0 = time.perf_counter()
+                                client.call("schedule_request", {
+                                    "qid": q,
+                                    "prompt_len": prompt_len,
+                                    "new_token_budget": new_tokens,
+                                })
+                                local.append(time.perf_counter() - t0)
+                                n_rpc += 1
+                    else:
+                        qid = f"gw{i}"
+                        t0 = time.perf_counter()
+                        if batched:
+                            resp = client.call("gateway_submit", {
+                                "tenant": "interactive",
+                                "tokens": est_tokens,
+                                "qid": qid,
+                                "prompt_len": prompt_len,
+                                "new_token_budget": new_tokens,
+                            })
+                            n_rpc += 1
+                            assert resp["ok"] and resp["schedule"]["url"]
+                        else:
+                            dec = client.call("gateway_admit", {
+                                "tenant": "interactive",
+                                "tokens": est_tokens,
+                            })
+                            assert dec["ok"]
+                            client.call("schedule_request", {
+                                "qid": qid,
+                                "prompt_len": prompt_len,
+                                "new_token_budget": new_tokens,
+                            })
+                            n_rpc += 2
+                        local = [time.perf_counter() - t0]
+                    with lock:
+                        waits.extend(local)
+                        rpcs[0] += n_rpc
+            except Exception as e:  # noqa: BLE001 - becomes arm data
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}"[:200])
+            finally:
+                client.close()
+
+        threads = [
+            threading.Thread(target=worker, daemon=True,
+                             name=f"cp-client-{t}")
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        batch_sum0, batch_cnt0 = m._m_ctl_batch.snapshot()
+        barrier.wait()
+        t0 = time.perf_counter()
+        fire_update.set()  # the update publishes as the storm lands
+        for t in threads:
+            t.join(timeout=120.0)
+        wall = time.perf_counter() - t0
+        # router arms: let the async update finish before teardown so
+        # both arms end at the bumped version (proves it really ran)
+        deadline = time.monotonic() + 60.0
+        while (
+            getattr(m, "_weight_update_fut", None) is not None
+            and not m._weight_update_fut.done()
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        m._harvest_weight_update()
+        stop.set()
+        st.join(timeout=5.0)
+        batch_sum1, batch_cnt1 = m._m_ctl_batch.snapshot()
+        pool = getattr(m, "_update_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+        m._sock.close(linger=0)
+        row = {
+            "schedules_per_sec": round(n_schedules / max(wall, 1e-9), 1),
+            "wall_s": round(wall, 4),
+            "rpcs": int(rpcs[0]),
+            "schedule_wait_s": {
+                "p50": _pct(waits, 50),
+                "p99": _pct(waits, 99),
+            } if waits else None,
+            "scheduled": len(waits),
+            "model_version_after": int(m._model_version),
+        }
+        if serve_mode == "router" and batch_cnt1 > batch_cnt0:
+            row["mean_serve_batch"] = round(
+                (batch_sum1 - batch_sum0) / (batch_cnt1 - batch_cnt0), 2
+            )
+        if errors:
+            row["errors"] = errors[:3]
+        return row
+
+    def parity():
+        """Scan-vs-indexed pick identity over one deterministic mixed
+        trace (schedules with group collisions, releases, direct
+        load/token writes) per policy."""
+        import random
+
+        out = {}
+        for policy in ("least_requests", "least_token_usage",
+                       "round_robin"):
+            seqs = []
+            for indexed in (False, True):
+                m = mk_manager("rep", indexed, policy=policy, bind=False)
+                rng = random.Random(1234)
+                seq, live = [], []
+                for step in range(400):
+                    op = rng.random()
+                    if op < 0.6 or not live:
+                        g = rng.randrange(120)
+                        qid = f"g{g}-{rng.randrange(group_size)}"
+                        r = m._schedule_request(
+                            qid, rng.randrange(1, 256),
+                            rng.randrange(1, 128),
+                        )
+                        seq.append(r["url"])
+                        live.append(qid)
+                    elif op < 0.85:
+                        m._release_scheduled(
+                            live.pop(rng.randrange(len(live)))
+                        )
+                    else:
+                        # direct operator/test-style map writes: the
+                        # observed dicts must keep the index honest
+                        a = m.server_addrs[
+                            rng.randrange(len(m.server_addrs))
+                        ]
+                        m._server_tokens[a] = (
+                            m._server_tokens[a] + 48.0
+                        )
+                        m._server_load[a] = m._server_load[a] + 1
+                seqs.append(seq)
+            out[policy] = bool(seqs[0] == seqs[1])
+        return out
+
+    arms = {
+        "rep_scan": run_arm("rep", indexed=False, batched=False),
+        "rep_indexed": run_arm("rep", indexed=True, batched=False),
+        "router_scan": run_arm("router", indexed=False, batched=False),
+        "router_indexed": run_arm("router", indexed=True, batched=True),
+    }
+    par = parity()
+    base = arms["rep_scan"]["schedules_per_sec"]
+    opt = arms["router_indexed"]["schedules_per_sec"]
+    return {
+        "n_servers": n_servers,
+        "n_groups": n_groups,
+        "group_size": group_size,
+        "n_gateway": n_gateway,
+        "n_threads": n_threads,
+        "n_schedules": n_schedules,
+        **arms,
+        "speedup": round(opt / max(base, 1e-9), 2),
+        "meets_5x": bool(opt >= 5.0 * base),
+        "parity": par,
+        "routing_parity": bool(all(par.values())),
+    }
+
+
 #: per-section outcomes for the machine-parseable summary:
 #: {name: {"status": "ok"|"error"|"timeout", "seconds": wall}}.  A round
 #: that loses sections still reports WHICH ones and why.
@@ -3484,6 +3808,7 @@ SUMMARY_REQUIRED_KEYS = (
     "slo_report",
     "pd_disagg_ab",
     "gateway_ab",
+    "control_plane_ab",
     "sharded_serving",
     "weight_swap_ab",
     "train_packing_ab",
@@ -3506,6 +3831,7 @@ def build_summary(
     slo_report=None,
     pd_disagg_ab=None,
     gateway_ab=None,
+    control_plane_ab=None,
     sharded_serving=None,
     weight_swap_ab=None,
     train_packing_ab=None,
@@ -3548,6 +3874,7 @@ def build_summary(
         "slo_report": slo_report,
         "pd_disagg_ab": pd_disagg_ab,
         "gateway_ab": gateway_ab,
+        "control_plane_ab": control_plane_ab,
         "sharded_serving": sharded_serving,
         "weight_swap_ab": weight_swap_ab,
         "train_packing_ab": train_packing_ab,
@@ -4463,6 +4790,18 @@ def main():
         ),
     )
 
+    # control-plane A/B: the manager's batched ROUTER serve loop +
+    # O(log N) routing indexes + batched client RPCs vs the strict REP
+    # + O(N)-scan + per-request baseline, at 64 registered fake servers
+    # under a mixed rollout+gateway storm.  Pure CPU (real ZMQ, no
+    # engine), so the summary always carries the >=5x schedules/sec
+    # acceptance verdict and the scan-vs-indexed parity bool.
+    mark("control plane A/B")
+    control_plane_ab = _section(
+        bench_control_plane_ab,
+        name="control_plane_ab",
+    )
+
     # self-speculative decoding A/B: n-gram draft + batched paged verify
     # on vs off, on a repetitive-trace workload (decode tok/s + accepted
     # tokens per verify step).  Runs off-TPU too — tiny shapes — so the
@@ -4713,6 +5052,7 @@ def main():
         slo_report=slo_report,
         pd_disagg_ab=pd_disagg_ab,
         gateway_ab=gateway_ab,
+        control_plane_ab=control_plane_ab,
         sharded_serving=sharded_serving,
         weight_swap_ab=weight_swap_ab,
         train_packing_ab=train_packing_ab,
@@ -4779,6 +5119,7 @@ def main():
                     "slo_report": slo_report,
                     "pd_disagg_ab": pd_disagg_ab,
                     "gateway_ab": gateway_ab,
+                    "control_plane_ab": control_plane_ab,
                     "sharded_serving": sharded_serving,
                 },
             }

@@ -335,6 +335,31 @@ def test_gateway_ab_cpu_smoke(tiny_cfg):
     json.dumps(out)  # wire-format safe
 
 
+def test_control_plane_ab_cpu_smoke():
+    """The control-plane A/B end to end on CPU (the acceptance
+    criterion's smoke): real ZMQ sockets, threaded clients, a mid-storm
+    weight update in every arm — router+indexed+batched must clear 5x
+    schedules/sec over rep+scan+unbatched at 64 fake servers, with
+    scan-vs-indexed pick parity across all three policies.  The update
+    RPC latency is raised above the bench default so the rep arms'
+    inline stall dominates scheduler noise under CI load."""
+    out = bench.bench_control_plane_ab(update_rpc_s=0.1)
+    assert out["meets_5x"] is True, out
+    assert out["routing_parity"] is True, out["parity"]
+    for arm in ("rep_scan", "rep_indexed", "router_scan",
+                "router_indexed"):
+        row = out[arm]
+        assert "errors" not in row, (arm, row)
+        # every logical schedule landed exactly once
+        assert row["scheduled"] == out["n_schedules"], (arm, row)
+        # the mid-storm weight update really completed in every arm
+        assert row["model_version_after"] == 1, (arm, row)
+    # the batched arm collapsed round trips: one RPC per group + one
+    # per gateway request vs one per sibling + two per gateway request
+    assert out["router_indexed"]["rpcs"] < out["rep_scan"]["rpcs"]
+    json.dumps(out)  # wire-format safe
+
+
 def test_summary_schema_round_trips_with_required_keys(spec_ab):
     """The machine-parseable summary contract: json round-trip + every
     SUMMARY_REQUIRED_KEYS entry present (None for sections that did not
@@ -416,11 +441,23 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
                        "gateway_matches_rollout": True},
             "leak_free": True,
         },
+        control_plane_ab={
+            "rep_scan": {"schedules_per_sec": 2000.0},
+            "router_indexed": {"schedules_per_sec": 18000.0},
+            "speedup": 9.0,
+            "meets_5x": True,
+            "routing_parity": True,
+        },
     )
     blob = json.loads(json.dumps(summary))
     for key in bench.SUMMARY_REQUIRED_KEYS:
         assert key in blob, key
     assert "gateway_ab" in bench.SUMMARY_REQUIRED_KEYS
+    assert "control_plane_ab" in bench.SUMMARY_REQUIRED_KEYS
+    cp = blob["control_plane_ab"]
+    assert cp["meets_5x"] is True
+    assert cp["routing_parity"] is True
+    assert cp["speedup"] == 9.0
     gw = blob["gateway_ab"]
     assert gw["interactive_p99_ttft_better_with_admission"] is True
     assert gw["p99_ttft_steps_improvement"] == 3.67

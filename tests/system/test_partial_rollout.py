@@ -18,6 +18,13 @@ class StubManagerClient:
 
     def call(self, cmd, payload):
         self.calls.append((cmd, payload))
+        if cmd == "schedule_batch":
+            return {
+                "responses": [
+                    {"url": "stub:0", "version": 0}
+                    for _ in payload["qids"]
+                ]
+            }
         assert cmd == "schedule_request"
         return {"url": "stub:0", "version": 0}
 
@@ -101,6 +108,12 @@ def test_group_members_get_distinct_qids_and_reassemble():
     assert len(bundle.seqs) == 3
     member_qids = sorted(c.qid for c in gen.calls)
     assert member_qids == ["q9-0", "q9-1", "q9-2"]
+    # the whole group scheduled in ONE batched manager RPC
+    batch_calls = [
+        p for c, p in prm.manager_client.calls if c == "schedule_batch"
+    ]
+    assert len(batch_calls) == 1
+    assert batch_calls[0]["qids"] == ["q9-0", "q9-1", "q9-2"]
     # packed logprob layout: len(seq) - 1 per member
     for seq, lps in zip(bundle.seqs, bundle.logprobs):
         assert len(lps) == len(seq) - 1

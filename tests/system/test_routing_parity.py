@@ -1,0 +1,172 @@
+"""Scan-vs-indexed routing parity: the O(log N) incremental indexes
+(per-chip load/token min-heaps + precomputed weighted RR cycle) must
+pick byte-identically to the legacy O(N) scans over randomized mixed
+traffic — schedules with group collisions (sibling affinity), sticky
+continuations, releases, finishes, direct load/token map writes, and
+mesh-shape changes — for all three policies, including traces where
+the cache-affinity imbalance escape hatch fires."""
+
+import random
+
+import pytest
+
+from areal_tpu.api.system_api import GserverManagerConfig
+from areal_tpu.base import logging_
+from areal_tpu.base.monitor import RolloutStat
+from areal_tpu.system.gserver_manager import GserverManager
+
+N_SERVERS = 8
+GROUPS = 24
+GROUP_SIZE = 4
+
+
+def _manager(policy, indexed, **cfg_kwargs):
+    m = GserverManager.__new__(GserverManager)
+    m.config = GserverManagerConfig(
+        schedule_policy=policy,
+        n_servers=N_SERVERS,
+        routing_index=indexed,
+        **cfg_kwargs,
+    )
+    m.server_addrs = [f"s{i}" for i in range(N_SERVERS)]
+    m.logger = logging_.getLogger("test-parity")
+    m._round_robin = 0
+    m._qid_server = {}
+    m._server_load = {a: 0 for a in m.server_addrs}
+    m._server_tokens = {a: 0.0 for a in m.server_addrs}
+    # heterogeneous meshes: every per-chip normalization must agree
+    # between the scan and the heaps
+    m._server_devices = {
+        a: (1, 2, 4)[i % 3] for i, a in enumerate(m.server_addrs)
+    }
+    m._server_mesh = {a: "" for a in m.server_addrs}
+    m._qid_tokens = {}
+    m._group_server = {}
+    m._group_prefix = {}
+    m._group_tokens = {}
+    m.rollout_stat = RolloutStat()
+    m._model_version = 0
+    m._expr, m._trial = "test-exp", "test-trial"
+    m._init_metrics()
+    return m
+
+
+def _spy_escapes(m):
+    """Count affinity-escape firings per manager (the registry metric is
+    process-global, so a counter delta would alias across managers)."""
+    orig = m._affine_server
+    fired = []
+
+    def spy(group):
+        sibling, avoid = orig(group)
+        if avoid is not None:
+            fired.append(avoid)
+        return sibling, avoid
+
+    m._affine_server = spy
+    return fired
+
+
+def _run_trace(m, seed, steps=600):
+    """One randomized mixed-traffic trace; returns the pick sequence.
+    The rng stream is consumed identically regardless of routing_index,
+    so two managers given the same seed see the same op sequence."""
+    rng = random.Random(seed)
+    seq, live = [], []
+    for _ in range(steps):
+        op = rng.random()
+        if op < 0.45 or not live:
+            # new member qid; group collisions exercise the sibling /
+            # hot-prefix affinity path
+            g = rng.randrange(GROUPS)
+            qid = f"g{g}-m{rng.randrange(GROUP_SIZE)}"
+            r = m._schedule_request(
+                qid, rng.randrange(1, 512), rng.randrange(1, 256)
+            )
+            seq.append(r["url"])
+            if qid not in live:
+                live.append(qid)
+        elif op < 0.60:
+            # sticky continuation: re-schedule a live qid with a grown
+            # context (refreshes the resident-token estimate in place)
+            qid = live[rng.randrange(len(live))]
+            r = m._schedule_request(
+                qid, rng.randrange(64, 1024), rng.randrange(1, 256)
+            )
+            seq.append(r["url"])
+        elif op < 0.72:
+            m._release_scheduled(live.pop(rng.randrange(len(live))))
+        elif op < 0.82:
+            m.rollout_stat.running += 1  # keep the decrement in range
+            m._finish_rollout(
+                live.pop(rng.randrange(len(live))), rng.random() < 0.5
+            )
+        elif op < 0.95:
+            # direct operator/test-style map writes: the observed dicts
+            # must keep the heaps honest
+            a = m.server_addrs[rng.randrange(N_SERVERS)]
+            m._server_tokens[a] = m._server_tokens[a] + 512.0
+            m._server_load[a] = m._server_load[a] + 1
+        else:
+            # mesh-shape change: moves every per-chip value and the RR
+            # cycle weights — full index rebuild
+            a = m.server_addrs[rng.randrange(N_SERVERS)]
+            m._server_devices[a] = rng.choice((1, 2, 4))
+    return seq
+
+
+@pytest.mark.parametrize(
+    "policy", ["least_requests", "least_token_usage", "round_robin"]
+)
+def test_indexed_picks_identical_to_scan(policy):
+    # low escape thresholds so the imbalance hatch genuinely fires
+    # inside the trace (the +512-token direct writes create hot
+    # servers whose foreign load trips it)
+    knobs = dict(
+        affinity_imbalance_factor=1.05,
+        affinity_imbalance_slack_tokens=8.0,
+    )
+    seqs, escapes = [], []
+    for indexed in (False, True):
+        m = _manager(policy, indexed, **knobs)
+        fired = _spy_escapes(m)
+        seqs.append(_run_trace(m, seed=20260806))
+        escapes.append(len(fired))
+    assert seqs[0] == seqs[1]
+    # the trace exercised the escape hatch, and both paths fired it
+    # the same number of times (min_value() == the scan min)
+    assert escapes[0] == escapes[1]
+    assert escapes[0] > 0
+
+
+@pytest.mark.parametrize(
+    "policy", ["least_requests", "least_token_usage", "round_robin"]
+)
+def test_affinity_escape_rereoutes_off_hot_server_both_paths(policy):
+    """Targeted escape check: once the hot server's foreign per-chip
+    tokens exceed factor*least + slack, a new sibling must leave it —
+    and scan and indexed must agree on where it lands."""
+    picks = []
+    for indexed in (False, True):
+        m = _manager(
+            policy,
+            indexed,
+            affinity_imbalance_factor=1.5,
+            affinity_imbalance_slack_tokens=16.0,
+        )
+        first = m._schedule("grp-m0", prompt_len=32, new_token_budget=8)
+        # pile FOREIGN tokens onto the hot server (another session's)
+        m._server_tokens[first] = m._server_tokens[first] + 4096.0
+        fired = _spy_escapes(m)
+        second = m._schedule("grp-m1", prompt_len=32, new_token_budget=8)
+        assert len(fired) == 1
+        assert second != first  # escaped the overloaded hot server
+        picks.append((first, second))
+    assert picks[0] == picks[1]
+
+
+def test_route_index_flag_defaults_on():
+    m = _manager("least_requests", indexed=True)
+    assert m._use_route_index() is True
+    m2 = _manager("least_requests", indexed=False)
+    assert m2._use_route_index() is False
