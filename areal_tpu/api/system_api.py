@@ -319,6 +319,14 @@ class GenServerConfig:
     # deep DMA-ring variant once the batch's longest context crosses it
     paged_min_cache_len: Optional[int] = None
     deep_kernel_min_context: Optional[int] = None
+    # recompile sentinel (observability/compile_watch.py): engine steps
+    # after which the serving loop is declared steady-state — any
+    # decode/fill-path XLA compile from then on fires
+    # areal_trace_stall_total{kind="recompile"} once per episode and
+    # force-samples the in-flight trace roots.  0 disables the sentinel
+    # (compile COUNTING always runs); size it past the bucket-ladder
+    # warm-up for the deployment's longest prompts.
+    compile_quiet_after_steps: int = 0
     # staged weight sync: transient HBM headroom knob for the staged
     # restore (update_weights mode="stage").  The snapshot restores in
     # layer chunks of at most this many bytes, placed directly at the

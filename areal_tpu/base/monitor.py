@@ -240,6 +240,20 @@ class UtilizationMonitor:
                     reg.gauge("areal_device_hbm_peak_gb").set(v, device=dev)
                 elif field == "hbm_limit_gb":
                     reg.gauge("areal_device_hbm_limit_gb").set(v, device=dev)
+            # HBM-ledger reconciliation: the subsystem attributions must
+            # sum to <= the allocator's own in-use bytes; the excess
+            # publishes as areal_hbm_ledger_drift_gb (0 when honest).
+            # Backends without memory_stats (CPU) reconcile vacuously.
+            from areal_tpu.observability.hbm_ledger import get_ledger
+
+            in_use_gb = [
+                v for k, v in snap.items()
+                if k.startswith("device") and k.endswith("/hbm_in_use_gb")
+            ]
+            get_ledger().reconcile(
+                reg,
+                int(sum(in_use_gb) * 1e9) if in_use_gb else None,
+            )
         except Exception:  # noqa: BLE001 - monitoring must not kill work
             logger.exception("metric registry publish failed")
 

@@ -126,6 +126,21 @@ def metric_server_root(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/metric_server/"
 
 
+def profiler_capture(
+    experiment_name: str, trial_name: str, worker_name: str
+) -> str:
+    """Latest on-demand profiler capture dir of one worker (written by
+    the metric server's ``/profile`` route, harvested by ops tooling)."""
+    return (
+        f"{trial_root(experiment_name, trial_name)}"
+        f"/profiler_capture/{worker_name}"
+    )
+
+
+def profiler_capture_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/profiler_capture/"
+
+
 def stream_pullers(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/stream_pullers/"
 

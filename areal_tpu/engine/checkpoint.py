@@ -266,6 +266,7 @@ def load_params_staged(
     template,
     path: str,
     chunk_bytes: Optional[int] = None,
+    ledger_handle=None,
 ):
     """Restore a published raw-param tree onto ``template``'s shardings in
     layer-sized CHUNKS — the staged half of the zero-downtime weight swap.
@@ -284,9 +285,23 @@ def load_params_staged(
     The returned tree is fully device-resident but NOT yet blocked-on;
     callers that need the swap pause to exclude transfer time should
     ``jax.block_until_ready`` it before pausing (the engine's
-    ``stage_weights`` does)."""
+    ``stage_weights`` does).
+
+    ``ledger_handle`` (an HBM-ledger ``staged_weights`` handle) is
+    resized as each chunk lands, so the attribution tracks the staging
+    tree WHILE it grows — the mid-restore footprint is exactly what the
+    knob exists to bound.  Zeroed on a failed restore (no tree survives
+    a raise); the engine's ``stage_weights`` re-syncs it on success."""
     if chunk_bytes is None or chunk_bytes <= 0 or not _only_dicts(template):
-        return load_params_like(template, path)
+        out = load_params_like(template, path)
+        if ledger_handle is not None:
+            ledger_handle.set(
+                sum(
+                    int(getattr(leaf, "nbytes", 0) or 0)
+                    for _, leaf in _flatten_dict(out)
+                ) if isinstance(out, dict) else 0
+            )
+        return out
     path = os.path.abspath(path)
     import orbax.checkpoint as ocp
     from orbax.checkpoint import checkpoint_utils
@@ -308,24 +323,36 @@ def load_params_staged(
 
     restorer = ocp.PyTreeCheckpointer()
     out: Dict = {}
-    for chunk in chunks:
-        item: Dict = {}
-        for keypath, leaf in chunk:
-            _insert_path(item, keypath, _abstract_leaf(leaf))
-        restored = restorer.restore(
-            path,
-            item=item,
-            # transforms={} switches orbax to partial-restore semantics:
-            # leaves absent from ``item`` are skipped entirely (their
-            # bytes are never read), which is what bounds the chunk
-            transforms={},
-            restore_args=checkpoint_utils.construct_restore_args(item),
-        )
-        for keypath, _ in chunk:
-            node = restored
-            for k in keypath:
-                node = node[k]
-            _insert_path(out, keypath, node)
+    staged_bytes = 0
+    try:
+        for chunk in chunks:
+            item: Dict = {}
+            for keypath, leaf in chunk:
+                _insert_path(item, keypath, _abstract_leaf(leaf))
+            restored = restorer.restore(
+                path,
+                item=item,
+                # transforms={} switches orbax to partial-restore
+                # semantics: leaves absent from ``item`` are skipped
+                # entirely (their bytes are never read), which is what
+                # bounds the chunk
+                transforms={},
+                restore_args=checkpoint_utils.construct_restore_args(item),
+            )
+            for keypath, _ in chunk:
+                node = restored
+                for k in keypath:
+                    node = node[k]
+                _insert_path(out, keypath, node)
+                staged_bytes += int(getattr(node, "nbytes", 0) or 0)
+            if ledger_handle is not None:
+                ledger_handle.set(staged_bytes)
+    except BaseException:
+        # a failed restore leaves NO staged tree behind — the partial
+        # chunks are garbage the moment this frame unwinds
+        if ledger_handle is not None:
+            ledger_handle.set(0)
+        raise
     return out
 
 

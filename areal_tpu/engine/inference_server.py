@@ -78,6 +78,11 @@ from areal_tpu.engine.sampling import SamplingParams, sample_logits_keyed
 from areal_tpu.models import paged, quantize
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import KVCache, decode_step, prefill
+from areal_tpu.observability.hbm_ledger import (
+    HbmLedger,
+    get_ledger,
+    tree_nbytes,
+)
 from areal_tpu.observability.latency import LatencyDigest, LatencyRecord
 from areal_tpu.observability.tracing import get_tracer
 
@@ -408,6 +413,7 @@ class ContinuousBatchingEngine:
         server_name: str = "",
         handoff_streaming: bool = False,
         prefix_pull_min_tokens: int = 256,
+        hbm_ledger: Optional[HbmLedger] = None,
     ):
         """``mesh``: a (small) jax Mesh for tensor-parallel serving — params
         shard via ``transformer.param_pspecs`` (TP over ``model``), the KV
@@ -662,6 +668,31 @@ class ContinuousBatchingEngine:
         #: manager scales capacity/routing weights by it
         self.mesh_devices = int(mesh.devices.size) if mesh is not None else 1
         self.params = params
+        # HBM ledger (observability/hbm_ledger.py): per-subsystem byte
+        # attribution.  Every seam below holds one handle; close()
+        # leak-audits the set and releases them.  Handles no-op on a
+        # disabled ledger, so the hot paths never need a guard.
+        self.hbm_ledger = hbm_ledger if hbm_ledger is not None else get_ledger()
+        led = self.hbm_ledger
+        self._led_weights = led.register(
+            "weights", tree_nbytes(params), name="engine.params"
+        )
+        self._led_staged = led.register(
+            "staged_weights", name="engine.staged_params"
+        )
+        self._led_kv_pool = led.register("kv_pool", name="engine.kv_pool")
+        self._led_kv_scales = led.register(
+            "kv_scales", name="engine.kv_scales"
+        )
+        self._led_spill = led.register(
+            "prefix_spill_host", name="engine.prefix_spill"
+        )
+        self._led_streams = led.register(
+            "stream_buffers", name="engine.streams"
+        )
+        self._led_handoff = led.register(
+            "handoff_staging", name="engine.handoff"
+        )
         self.tokenizer = tokenizer
         self.max_batch = max_batch
         self.kv_cache_len = kv_cache_len
@@ -724,6 +755,11 @@ class ContinuousBatchingEngine:
                 )()
             else:
                 self.cache = KVCache.zeros(cfg, max_batch, kv_cache_len)
+            if not self.paged:
+                # dense KV cache bytes land under the same kv_pool tag —
+                # the attribution question ("who owns the bytes") does
+                # not care which cache layout answered it
+                self._led_kv_pool.set(tree_nbytes(self.cache))
             self.cur_tokens = jnp.zeros((max_batch,), jnp.int32)
             self.active = jnp.zeros((max_batch,), bool)
             self.budgets = jnp.zeros((max_batch,), jnp.int32)
@@ -934,6 +970,14 @@ class ContinuousBatchingEngine:
                     cfg, self.n_blocks, BS, kv_cache_dtype=kv_dtype
                 )
             )
+        # ledger attribution: the alloc itself may run under jit (sharded
+        # path), so sizes come from the pure layout math, which matches
+        # the allocated arrays' nbytes exactly
+        pool_b, scale_b = paged.kv_pool_layout_bytes(
+            cfg, self.n_blocks, BS, kv_cache_dtype=kv_dtype
+        )
+        self._led_kv_pool.set(pool_b)
+        self._led_kv_scales.set(scale_b)
         self.kv_lengths = jnp.zeros((max_batch,), jnp.int32)
         self._tables_np = np.zeros(
             (max_batch, self.blocks_per_row), np.int32
@@ -980,6 +1024,7 @@ class ContinuousBatchingEngine:
                 host_bytes_budget=host_bytes,
                 block_bytes=block_bytes,
                 spill_fetch=self._spill_gather if host_bytes > 0 else None,
+                ledger_handle=self._led_spill,
             )
             # the effective knobs, logged once: the config default for
             # min_match_tokens (64) and the engine default (1) differ,
@@ -2518,12 +2563,45 @@ class ContinuousBatchingEngine:
                 return version
             self._staged_params = params
             self._staged_version = version
+            self._ledger_sync_staged_locked()
         self.swap_stage_s += time.perf_counter() - tik
         logger.info(
             "staged weights v%d in %.3fs (decode uninterrupted)",
             version, time.perf_counter() - tik,
         )
         return version
+
+    def _ledger_sync_staged_locked(self):
+        """Re-derive the ``staged_weights`` attribution from the two
+        slots that can hold a device-resident swap tree: the staged slot
+        and a committed-but-unapplied PRE-SHARDED pending tree (a
+        non-pre-sharded pending tree is a host tree — not device bytes
+        yet).  Caller holds ``self._lock``."""
+        nbytes = tree_nbytes(self._staged_params)
+        if self._new_params is not None and self._new_params[2]:
+            nbytes += tree_nbytes(self._new_params[0])
+        self._led_staged.set(nbytes)
+
+    def _ledger_sync_host_buffers(self):
+        """Recompute the ``stream_buffers`` / ``handoff_staging``
+        host-byte attributions from the actual queues, once per engine
+        step — these queues mutate at a dozen sites, and a recomputed
+        total can never drift the way incremental deltas would."""
+        if not self.hbm_ledger.enabled:
+            return
+        with self._lock:
+            # undrained gateway tokens: int32 ids (logical bytes — the
+            # wire/payload size, not CPython object overhead)
+            stream_b = 4 * sum(
+                len(st["toks"]) for st in self._streams.values()
+            )
+        self._led_streams.set(stream_b)
+        handoff_b = sum(
+            int(a.nbytes)
+            for seg in self._handoff_segments
+            for a in seg.get("payload", ())
+        )
+        self._led_handoff.set(handoff_b)
 
     @property
     def staged_version(self) -> Optional[int]:
@@ -2562,6 +2640,7 @@ class ContinuousBatchingEngine:
             )
             self._staged_params = None
             self._staged_version = None
+            self._ledger_sync_staged_locked()
             return self.n_inflight
 
     def discard_staged(self):
@@ -2569,6 +2648,7 @@ class ContinuousBatchingEngine:
         with self._lock:
             self._staged_params = None
             self._staged_version = None
+            self._ledger_sync_staged_locked()
 
     def swap_stats(self) -> Dict[str, float]:
         """Cumulative weight-swap counters (worker scrape + bench)."""
@@ -2584,6 +2664,38 @@ class ContinuousBatchingEngine:
 
     def resume(self):
         self._paused.clear()
+
+    def close(self) -> Dict[str, int]:
+        """Tear down this engine's ledger attributions and return the
+        LEAK AUDIT: the host/staging tags that were still non-zero —
+        ``staged_weights`` (an undiscarded swap tree), ``prefix_spill_host``
+        (an unflushed spill tier), ``stream_buffers`` (undrained gateway
+        streams), ``handoff_staging`` (unexported segments).  A quiesced
+        engine returns ``{}``.  The by-design resident tags (weights,
+        kv_pool, kv_scales) release silently — holding them WAS the
+        engine's job.  After close the process ledger is back to its
+        pre-construction baseline.  Idempotent."""
+        # refresh the accounting-derived tags so the audit reads actuals,
+        # not a stale per-step snapshot
+        self._ledger_sync_host_buffers()
+        with self._lock:
+            self._ledger_sync_staged_locked()
+        leaked: Dict[str, int] = {}
+        for h in (
+            self._led_staged, self._led_spill,
+            self._led_streams, self._led_handoff,
+        ):
+            if h.bytes:
+                leaked[h.subsystem] = leaked.get(h.subsystem, 0) + h.bytes
+        if leaked:
+            logger.warning("engine close leak audit: %s", leaked)
+        for h in (
+            self._led_weights, self._led_staged,
+            self._led_kv_pool, self._led_kv_scales,
+            self._led_spill, self._led_streams, self._led_handoff,
+        ):
+            h.release()
+        return leaked
 
     @property
     def n_inflight(self) -> int:
@@ -2667,6 +2779,7 @@ class ContinuousBatchingEngine:
             elif self.device is not None:
                 new_params = jax.device_put(new_params, self.device)
         self.params = new_params
+        self._led_weights.set(tree_nbytes(new_params))
         self.version = (
             target_version if target_version is not None else self.version + 1
         )
@@ -2684,6 +2797,7 @@ class ContinuousBatchingEngine:
                 )
                 self._staged_params = None
                 self._staged_version = None
+            self._ledger_sync_staged_locked()
         # parked rows hold KV computed under the OLD weights; resuming over
         # it would mix weight versions in attention.  Evict them — their
         # continuation re-prefills under the new weights, which is exactly
@@ -4148,6 +4262,7 @@ class ContinuousBatchingEngine:
                 self._harvest_oldest()
             return self._tokens_harvested_total - h0
         finally:
+            self._ledger_sync_host_buffers()
             dt = time.perf_counter() - tik
             self.time_host_s += max(
                 0.0,

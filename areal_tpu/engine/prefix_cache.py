@@ -182,6 +182,7 @@ class RadixPrefixCache:
         host_bytes_budget: int = 0,
         block_bytes: int = 0,
         spill_fetch: Optional[Callable[[List[int]], Tuple[Any, Any]]] = None,
+        ledger_handle=None,
     ):
         assert page_size >= 1
         self.page_size = page_size
@@ -196,7 +197,10 @@ class RadixPrefixCache:
         self._seq = 0
         self.version = 0
         self.blocks_held = 0
-        self.host_bytes_held = 0
+        #: HBM-ledger handle (``prefix_spill_host`` tag) tracking the
+        #: spill tier's host bytes; None = unledgered (standalone use)
+        self.ledger_handle = ledger_handle
+        self._host_bytes_held = 0
         self.host_blocks_held = 0
         # stats (cumulative; the engine mirrors them into the registry)
         self.hits_total = 0
@@ -208,6 +212,18 @@ class RadixPrefixCache:
         self.spilled_blocks_total = 0
         self.restored_blocks_total = 0
         self.host_dropped_blocks_total = 0
+
+    @property
+    def host_bytes_held(self) -> int:
+        return self._host_bytes_held
+
+    @host_bytes_held.setter
+    def host_bytes_held(self, nbytes: int) -> None:
+        # every mutation flows through here, so the ledger attribution
+        # can never drift from the cache's own accounting
+        self._host_bytes_held = nbytes
+        if self.ledger_handle is not None:
+            self.ledger_handle.set(nbytes)
 
     @property
     def _host_enabled(self) -> bool:

@@ -125,6 +125,31 @@ def alloc_kv_pool(
     )
 
 
+def kv_pool_layout_bytes(
+    cfg: TransformerConfig,
+    n_blocks: int,
+    block_size: int,
+    kv_cache_dtype: str = "auto",
+    dtype=None,
+) -> Tuple[int, int]:
+    """``(pool_bytes, scale_bytes)`` that :func:`alloc_kv_pool` with the
+    same arguments will allocate — pure arithmetic, no device memory.
+    The HBM ledger sizes its ``kv_pool``/``kv_scales`` attributions from
+    this (the allocation itself runs under jit, where a host-side ledger
+    call cannot live); ``scale_bytes`` is 0 for fp pools."""
+    shape = (
+        cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim
+    )
+    n = 1
+    for d in shape:
+        n *= int(d)
+    if kv_cache_dtype == "int8":
+        # k + v int8 data, k + v float32 scale pools [L, NB, Hkv, BS]
+        return 2 * n, 2 * (n // cfg.head_dim) * 4
+    itemsize = jnp.dtype(dtype or cfg.dtype).itemsize
+    return 2 * n * itemsize, 0
+
+
 def quantize_kv(vals: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Symmetric absmax int8 quantization over the trailing head_dim
     axis: returns ``(int8 values, float32 scales)`` with scales shaped

@@ -26,6 +26,16 @@ that plane as a real subsystem:
   swap/preempt stall) and mergeable fixed-bucket percentile digests,
   exported as the ``areal_slo_*`` families and fleet-merged by the
   aggregator (see ``docs/observability.md`` § Request-level SLOs).
+* :mod:`hbm_ledger` — per-subsystem device-memory attribution: tagged
+  byte handles at every allocation seam, exported as
+  ``areal_hbm_ledger_bytes{subsystem=}`` + peak watermarks, reconciled
+  against the allocator's own in-use bytes, and leak-audited at
+  quiesce points (see ``docs/observability.md`` § Device memory &
+  compiles).
+* :mod:`compile_watch` — per-entry XLA compile counting
+  (``areal_xla_compiles_total{fn=}`` + compile-seconds histogram +
+  ``xla.compile`` trace spans) with the steady-state recompile
+  sentinel firing ``areal_trace_stall_total{kind="recompile"}``.
 """
 
 from areal_tpu.observability.registry import (  # noqa: F401
@@ -54,4 +64,15 @@ from areal_tpu.observability.tracing import (  # noqa: F401
     Tracer,
     get_tracer,
     set_tracer,
+)
+from areal_tpu.observability.hbm_ledger import (  # noqa: F401
+    DEVICE_SUBSYSTEMS,
+    SUBSYSTEMS,
+    HbmLedger,
+    get_ledger,
+    set_ledger,
+    tree_nbytes,
+)
+from areal_tpu.observability.compile_watch import (  # noqa: F401
+    CompileWatch,
 )

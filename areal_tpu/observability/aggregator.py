@@ -167,13 +167,54 @@ class ClusterMetricsAggregator:
             logger.exception("fleet SLO digest merge failed")
             return {}
 
+    def merge_hbm(
+        self, scraped: Dict[str, Dict[str, prom_text.Family]]
+    ) -> Dict[str, float]:
+        """Fleet HBM-ledger rows: sum every worker's
+        ``areal_hbm_ledger_bytes{subsystem=}`` gauge into one
+        ``hbm/<subsystem>/bytes`` row per tag (who owns the fleet's
+        bytes — the capacity-planning view), plus the fleet-max
+        ``hbm/<subsystem>/peak_bytes`` watermark and the worst
+        per-worker reconciliation drift ``hbm/drift_gb_max``.  Workers
+        without the family (non-engine workers, older builds) simply
+        contribute nothing."""
+        bytes_by_tag: Dict[str, float] = {}
+        peak_by_tag: Dict[str, float] = {}
+        drift_max = None
+        for fams in scraped.values():
+            fam = fams.get("areal_hbm_ledger_bytes")
+            if fam is not None:
+                for s in fam.samples:
+                    tag = s.labels.get("subsystem", "")
+                    bytes_by_tag[tag] = bytes_by_tag.get(tag, 0.0) + s.value
+            fam = fams.get("areal_hbm_ledger_peak_bytes")
+            if fam is not None:
+                for s in fam.samples:
+                    tag = s.labels.get("subsystem", "")
+                    peak_by_tag[tag] = max(
+                        peak_by_tag.get(tag, 0.0), s.value
+                    )
+            fam = fams.get("areal_hbm_ledger_drift_gb")
+            if fam is not None:
+                for s in fam.samples:
+                    drift_max = max(drift_max or 0.0, s.value)
+        out: Dict[str, float] = {}
+        for tag, v in sorted(bytes_by_tag.items()):
+            out[f"hbm/{tag}/bytes"] = v
+        for tag, v in sorted(peak_by_tag.items()):
+            out[f"hbm/{tag}/peak_bytes"] = v
+        if drift_max is not None:
+            out["hbm/drift_gb_max"] = drift_max
+        return out
+
     def step(self, step: int) -> Dict[str, float]:
         """Scrape the cluster, append one jsonl snapshot (cluster series
-        + fleet-merged SLO percentiles), return the flat dict for the
-        metrics sinks."""
+        + fleet-merged SLO percentiles + per-subsystem HBM rows), return
+        the flat dict for the metrics sinks."""
         scraped = self.scrape()
         flat = self.flatten(scraped)
         flat.update(self.merge_slo(scraped))
+        flat.update(self.merge_hbm(scraped))
         if self._jsonl is not None:
             self._jsonl.write(
                 json.dumps({"step": step, "time": time.time(), **flat})

@@ -17,6 +17,14 @@ Routes:
                 poll loop whenever a poll produced work) — the signal a
                 lease/liveness layer or the aggregator's dead-endpoint
                 triage reads without parsing a whole metrics page
+  ``/profile``  on-demand profiler capture: ``?seconds=N`` starts a
+                bounded ``jax.profiler.trace`` into the worker's capture
+                dir (ONE in flight — a second request gets 409), replies
+                immediately with the capture path, and registers the
+                path in name_resolve so the master/ops tooling can
+                harvest it; ``?status=1`` reports without starting.
+                Replaces the offline-only ``scripts/profile_*.py`` flow
+                for live fleets.
 """
 
 from __future__ import annotations
@@ -60,9 +68,16 @@ class MetricsServer:
         port: int = 0,
         host: str = "0.0.0.0",
         tracer: Optional[Tracer] = None,
+        capture_dir: Optional[str] = None,
     ):
         self.registry = registry or get_registry()
         self.tracer = tracer or get_tracer()
+        # /profile state: ONE bounded capture in flight at a time
+        self.capture_dir = capture_dir
+        self._profile_lock = threading.Lock()
+        self._profile_state = {"state": "idle"}
+        self._profile_seq = 0
+        self._registered_ids: Optional[tuple] = None
         # /healthz state: identity + uptime + last activity.  Activity is
         # stamped by the worker's poll loop (note_activity) whenever a
         # poll produced work, so "alive but wedged" (HTTP up, poll loop
@@ -107,6 +122,24 @@ class MetricsServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif path == "/profile":
+                    qs = urllib.parse.parse_qs(query)
+                    if qs.get("status"):
+                        code, reply = 200, srv.profile_status()
+                    else:
+                        try:
+                            seconds = float(
+                                qs.get("seconds", ["5"])[0]
+                            )
+                        except ValueError:
+                            seconds = 5.0
+                        code, reply = srv.start_profile(seconds)
+                    body = json.dumps(reply).encode("utf-8")
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_error(404)
 
@@ -139,6 +172,90 @@ class MetricsServer:
         loop on productive polls; cheap enough for every poll)."""
         self.last_activity_ts = time.time()
 
+    # -- /profile: on-demand bounded profiler capture ------------------------
+
+    #: hard cap on one capture's duration — an operator typo must never
+    #: leave the profiler (and its overhead) running for an hour
+    PROFILE_MAX_SECONDS = 120.0
+
+    def profile_status(self) -> dict:
+        with self._profile_lock:
+            return dict(self._profile_state)
+
+    def start_profile(self, seconds: float) -> tuple:
+        """Kick off one bounded ``jax.profiler.trace`` capture on a
+        background thread.  Returns ``(http_code, reply_dict)``: 200
+        with the capture path when started, 409 while another capture is
+        in flight (one at a time — captures are heavy), 500 when the
+        profiler cannot start."""
+        seconds = min(max(0.5, float(seconds)), self.PROFILE_MAX_SECONDS)
+        with self._profile_lock:
+            if self._profile_state.get("state") == "running":
+                return 409, {
+                    "status": "busy",
+                    **{k: v for k, v in self._profile_state.items()},
+                }
+            self._profile_seq += 1
+            base = self.capture_dir or os.path.join(
+                os.environ.get("TMPDIR", "/tmp"), "areal_profiles"
+            )
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            path = os.path.join(
+                base,
+                f"{self.worker_name or 'worker'}-{stamp}"
+                f"-{self._profile_seq}",
+            )
+            try:
+                os.makedirs(path, exist_ok=True)
+            except OSError as e:
+                return 500, {"status": "error", "error": str(e)}
+            self._profile_state = {
+                "state": "running",
+                "path": path,
+                "seconds": seconds,
+                "started_ts": time.time(),
+            }
+        threading.Thread(
+            target=self._profile_run,
+            args=(path, seconds),
+            daemon=True,
+            name=f"profile-capture-{self._profile_seq}",
+        ).start()
+        self._register_capture(path)
+        return 200, {"status": "started", "path": path, "seconds": seconds}
+
+    def _profile_run(self, path: str, seconds: float):
+        try:
+            import jax.profiler
+
+            jax.profiler.start_trace(path)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            state = {"state": "done", "path": path, "seconds": seconds}
+        except Exception as e:  # noqa: BLE001 - report, never crash
+            logger.exception("profiler capture into %s failed", path)
+            state = {"state": "error", "path": path, "error": str(e)}
+        with self._profile_lock:
+            self._profile_state = state
+
+    def _register_capture(self, path: str):
+        """Publish the capture dir under the worker's profiler-capture
+        key so the master (and collect_debug_bundle) can harvest it.
+        Best-effort: an unregistered capture is still on disk."""
+        if self._registered_ids is None:
+            return
+        expr, trial, worker = self._registered_ids
+        try:
+            name_resolve.add(
+                names.profiler_capture(expr, trial, worker),
+                path,
+                replace=True,
+            )
+        except Exception:  # noqa: BLE001 - observability never kills work
+            logger.exception("profiler capture registration failed")
+
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
@@ -170,6 +287,7 @@ class MetricsServer:
         )
         name_resolve.add(key, self.address, replace=True)
         self._registered_key = key
+        self._registered_ids = (experiment_name, trial_name, worker_name)
         return key
 
     def stop(self):

@@ -689,6 +689,46 @@ METRIC_TABLE = [
         "Named wall-clock intervals recorded via monitor.time_mark",
         ("mark",),
     ),
+    # -- HBM ledger (observability/hbm_ledger.py) ----------------------------
+    MetricSpec(
+        "areal_hbm_ledger_bytes",
+        "gauge",
+        "Bytes currently attributed to each subsystem by the device-"
+        "memory ledger (see hbm_ledger.SUBSYSTEMS for the tag taxonomy; "
+        "host-side tags carry host bytes)",
+        ("subsystem",),
+    ),
+    MetricSpec(
+        "areal_hbm_ledger_peak_bytes",
+        "gauge",
+        "High-watermark bytes each ledger subsystem ever held (resets "
+        "with the process; the capacity-planning ceiling)",
+        ("subsystem",),
+    ),
+    MetricSpec(
+        "areal_hbm_ledger_drift_gb",
+        "gauge",
+        "Excess of the ledger's device-tag sum over the device's "
+        "reported HBM in-use bytes, in GB (0 while sum(ledger) <= "
+        "in_use + tolerance; nonzero = the ledger double-counts or a "
+        "release was missed)",
+    ),
+    # -- recompile sentinel (observability/compile_watch.py) -----------------
+    MetricSpec(
+        "areal_xla_compiles_total",
+        "counter",
+        "XLA compiles observed per watched entry point (jitted-cache "
+        "growth) plus the process-wide backend_compile events under "
+        "fn=backend",
+        ("fn",),
+    ),
+    MetricSpec(
+        "areal_xla_compile_seconds",
+        "histogram",
+        "Backend-compile durations reported by jax.monitoring "
+        "(process-wide; per-fn attribution rides "
+        "areal_xla_compiles_total)",
+    ),
     # -- master / stats fan-in (system/master_worker.py) ---------------------
     MetricSpec(
         "areal_master_step_seconds",
@@ -712,8 +752,8 @@ METRIC_TABLE = [
     MetricSpec(
         "areal_trace_stall_total",
         "counter",
-        "Open trace spans flagged by the stall watchdog, by kind "
-        "(span_deadline | buffer_age); each stalled span counts once",
+        "Stall-watchdog flags, by kind (the STALL_KINDS vocabulary "
+        "below); each stalled span / breach episode counts once",
         ("kind",),
     ),
     MetricSpec(
@@ -954,7 +994,70 @@ TRACE_TABLE = [
         "event",
         "Sample consumed by a train step (attrs: step, staleness, model)",
     ),
+    # -- recompile sentinel --------------------------------------------------
+    TraceSpec(
+        "xla.compile",
+        "span",
+        "One detected XLA compile of a watched entry point (attrs: fn, "
+        "n new cache entries, the caller-provided shape/dtype "
+        "signature, secs when jax.monitoring reported a duration)",
+    ),
 ]
+
+
+@dataclasses.dataclass(frozen=True)
+class StallKindSpec:
+    """One canonical stall-watchdog ``kind`` label value (the vocabulary
+    of ``areal_trace_stall_total``)."""
+
+    name: str
+    help: str
+
+
+#: every value the ``kind`` label of ``areal_trace_stall_total`` may
+#: carry.  ``scripts/check_metric_names.py`` lints this table against
+#: every emission site BOTH WAYS (an unlisted literal at an emission
+#: site fails, and a listed kind nothing emits is dead vocabulary) —
+#: route every new fire through :func:`stall_kind` or a literal
+#: ``kind="..."`` keyword so the lint can see it.
+STALL_KIND_TABLE = [
+    StallKindSpec(
+        "span_deadline",
+        "An open trace span outlived the per-span wall-clock deadline "
+        "(a wedged rollout/request)",
+    ),
+    StallKindSpec(
+        "buffer_age",
+        "A buffered sample sat unconsumed across too many weight "
+        "versions (train side starving or rollout side flooding)",
+    ),
+    StallKindSpec(
+        "slo",
+        "The fleet TTFT p99 breached its objective for N consecutive "
+        "scrapes (fires once per breach episode, re-arms on recovery)",
+    ),
+    StallKindSpec(
+        "recompile",
+        "An XLA compile landed on a watched decode/fill entry point "
+        "after the engine reached steady state (fires once per compile "
+        "episode, re-arms after a quiet poll)",
+    ),
+]
+
+STALL_KINDS = tuple(s.name for s in STALL_KIND_TABLE)
+
+
+def stall_kind(kind: str) -> str:
+    """Validate-and-return a stall ``kind``.  Emission sites that pick a
+    kind dynamically wrap each candidate literal in this (identity at
+    runtime, plus a membership check), which is exactly the marker the
+    stall-kind lint collects."""
+    if kind not in STALL_KINDS:
+        raise ValueError(
+            f"unknown stall kind {kind!r}; add it to "
+            "table.STALL_KIND_TABLE (and docs) first"
+        )
+    return kind
 
 
 def trace_table_index() -> Dict[str, TraceSpec]:

@@ -33,6 +33,7 @@ import urllib.request
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from areal_tpu.base import logging_, name_resolve, names
+from areal_tpu.observability.table import stall_kind
 from areal_tpu.observability.tracing import (
     TraceConfig,
     to_trace_events,
@@ -93,7 +94,7 @@ class StallWatchdog:
             kind = None
             last = span.get("last_ts", span.get("ts", now))
             if now - last > self.config.stall_span_timeout_s:
-                kind = "span_deadline"
+                kind = stall_kind("span_deadline")
             elif (
                 span.get("name") == "buffer.resident"
                 and current_version is not None
@@ -104,7 +105,7 @@ class StallWatchdog:
                     and v >= 0
                     and current_version - v > self.config.stall_buffer_versions
                 ):
-                    kind = "buffer_age"
+                    kind = stall_kind("buffer_age")
             if kind is None or key in self._flagged:
                 continue
             self._flagged.add(key)

@@ -452,12 +452,71 @@ class GenerationServerWorker(worker_base.Worker):
         self._staging: Optional[Dict] = None
         self._start_time = time.monotonic()
 
+        # recompile sentinel (observability/compile_watch.py): count
+        # compiles per jitted decode/fill entry and — once the loop is
+        # declared steady — alarm on ANY fresh compile, force-sampling
+        # every in-flight row's trace root so the stalled episode is
+        # inspectable end to end
+        from areal_tpu.observability.compile_watch import CompileWatch
+        from areal_tpu.observability.tracing import member_root
+
+        def _force_inflight_roots(fns):
+            trc = tracing.get_tracer()
+            for row in self.engine.rows:
+                if row is not None:
+                    trc.force(member_root(row.req.qid))
+
+        eng = self.engine
+        self._compile_watch = CompileWatch(
+            quiet_after_steps=getattr(
+                config, "compile_quiet_after_steps", 0
+            ),
+            on_steady_compile=_force_inflight_roots,
+        )
+        if eng.paged:
+            from areal_tpu.models import paged as paged_mod
+
+            def _paged_sig():
+                return (
+                    f"page={eng.page_size},chunk={eng.chunk_size},"
+                    f"n_blocks={eng.n_blocks},batch={eng.max_batch}"
+                )
+
+            self._compile_watch.watch(
+                "paged_fill_chunk", paged_mod.paged_fill_chunk,
+                signature=_paged_sig,
+            )
+            self._compile_watch.watch(
+                "paged_decode_chunk", paged_mod.paged_decode_chunk,
+                signature=_paged_sig,
+            )
+        else:
+            from areal_tpu.engine import inference_server as eng_mod
+
+            def _dense_sig():
+                return (
+                    f"cache_len={eng.kv_cache_len},"
+                    f"chunk={eng.chunk_size},batch={eng.max_batch}"
+                )
+
+            self._compile_watch.watch(
+                "decode_chunk", eng_mod._decode_chunk,
+                signature=_dense_sig,
+            )
+            self._compile_watch.watch(
+                "admit_rows", eng_mod._admit_rows, signature=_dense_sig
+            )
+            self._compile_watch.watch(
+                "sample_rows", eng_mod._sample_rows, signature=_dense_sig
+            )
+
         # observability: the engine keeps plain cumulative floats (no
         # registry dependency in the hot loop); the worker mirrors them
         # into the scrape registry as counter deltas + gauges per poll
         from areal_tpu.observability import get_registry
 
         reg = get_registry()
+        self._registry = reg
         self._obs = {
             "chunks": reg.counter("areal_inference_chunks_total"),
             "host": reg.counter("areal_inference_host_seconds_total"),
@@ -729,6 +788,16 @@ class GenerationServerWorker(worker_base.Worker):
         self._obs["weight_quant_bits"].set(wstats["storage_bits"])
         self._obs["weight_quant_leaves"].set(wstats["quantized_leaves"])
         self._obs["mesh_devices"].set(eng.mesh_devices)
+        # HBM ledger: per-subsystem attribution gauges (current + peak)
+        eng.hbm_ledger.publish(self._registry)
+        # recompile sentinel: arm the steady-state guard off the engine's
+        # own step clock, then diff the jitted caches (the poll counts
+        # compiles, records xla.compile spans, and fires the stall
+        # sentinel when armed)
+        watch = getattr(self, "_compile_watch", None)
+        if watch is not None:
+            watch.note_step(eng._step_seq)
+            watch.poll()
 
     # -- API ---------------------------------------------------------------
 
@@ -1266,6 +1335,10 @@ class GenerationServerWorker(worker_base.Worker):
                     chunk_bytes=getattr(
                         self.config, "stage_chunk_bytes", None
                     ),
+                    # staged_weights attribution grows chunk by chunk —
+                    # the mid-restore footprint is visible, not just the
+                    # final stage_weights total
+                    ledger_handle=self.engine._led_staged,
                 )
             else:
                 restored = checkpoint.load_params_like(
@@ -1511,6 +1584,17 @@ class GenerationServerWorker(worker_base.Worker):
             "cancelled_total": self.engine.cancelled_total,
             "preempted_total": self.engine.preempted_total,
             "preempted_by_class": dict(self.engine.preempted_by_class),
+            # HBM ledger: per-subsystem byte attribution + watermarks
+            # (the aggregator's merge_hbm folds these into fleet rows)
+            "hbm_ledger": self.engine.hbm_ledger.snapshot(),
+            "hbm_ledger_peak": self.engine.hbm_ledger.watermarks(),
+            # recompile sentinel: per-entry compile counts + steady-state
+            # fire totals
+            **(
+                self._compile_watch.stats()
+                if getattr(self, "_compile_watch", None) is not None
+                else {}
+            ),
         }
 
     # -- poll ---------------------------------------------------------------
@@ -1568,6 +1652,11 @@ class GenerationServerWorker(worker_base.Worker):
         return worker_base.PollResult(sample_count=n)
 
     def _exit_hook(self):
+        eng = getattr(self, "engine", None)
+        if eng is not None:
+            # releases the ledger attributions (and logs the leak audit:
+            # a quiesced server returns the process ledger to baseline)
+            eng.close()
         for client in getattr(self, "_peer_clients", {}).values():
             client.close()  # aborts any in-flight pooled push promptly
         pool = getattr(self, "_handoff_pool", None)

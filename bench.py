@@ -415,6 +415,135 @@ def bench_trace_overhead_ab(
     return out
 
 
+def bench_obs_ledger_report(
+    cfg, params, n_reqs=32, prompt_len=256, max_new=256, repeats=2,
+):
+    """HBM-ledger + recompile-sentinel report: the observability
+    acceptance numbers in one diffable dict.
+
+    * ledger-on vs ledger-off decode tok/s (same warmup-wave +
+      best-of-repeats protocol as ``bench_trace_overhead_ab``) with the
+      <2% overhead bar tracked as ``overhead_frac_vs_off``;
+    * per-subsystem ledger bytes + peaks under the live decode wave,
+      and the reconciliation verdict against the allocator's own
+      in-use bytes (vacuous on backends without memory_stats — the
+      CPU smoke still proves the plumbing);
+    * steady-state sentinel: the armed guard sees ZERO fresh compiles
+      across the timed steady-shape decode waves, then >=1 attributed
+      fire after a FORCED cache-bucket change (a second engine with a
+      different KV bucket against the same module-level jits);
+    * leak audit: ``engine.close()`` returns no leaks and the ledger
+      reads back to the zero baseline."""
+    from areal_tpu.base.monitor import device_memory_stats
+    from areal_tpu.engine import inference_server as eng_mod
+    from areal_tpu.observability.compile_watch import CompileWatch
+    from areal_tpu.observability.hbm_ledger import HbmLedger
+    from areal_tpu.observability.registry import MetricsRegistry
+
+    out = {"overhead_bar_frac": 0.02}
+    for arm in ("off", "on"):
+        led = HbmLedger(enabled=(arm == "on"))
+        eng = make_engine(
+            cfg, params, n_reqs, prompt_len, max_new, hbm_ledger=led
+        )
+        watch = reg = None
+        if arm == "on":
+            reg = MetricsRegistry()
+            watch = CompileWatch(
+                registry=reg, quiet_after_steps=1, monitoring=False
+            )
+            sig = (
+                f"cache_len={eng.kv_cache_len},chunk={eng.chunk_size},"
+                f"batch={eng.max_batch}"
+            )
+            for fn_name, fn in (
+                ("decode_chunk", eng_mod._decode_chunk),
+                ("admit_rows", eng_mod._admit_rows),
+                ("sample_rows", eng_mod._sample_rows),
+            ):
+                watch.watch(fn_name, fn, signature=lambda s=sig: s)
+        submit_wave(eng, cfg, n_reqs, prompt_len, max_new, f"olw{arm}")
+        drain(eng)  # warm: every bucket this arm will touch is compiled
+        if watch is not None:
+            watch.poll()  # absorb the warmup compiles, then declare
+            watch.note_step(1)  # the loop steady — the guard is armed
+        best = 0.0
+        for r in range(repeats):
+            submit_wave(
+                eng, cfg, n_reqs, prompt_len, max_new, f"olt{arm}{r}"
+            )
+            eng._admit()
+            int(np.asarray(eng.cache.lengths)[0])  # prefill done
+            t0 = time.perf_counter()
+            n = drain(eng)
+            best = max(best, n / (time.perf_counter() - t0))
+        out[arm] = {"decode_toks_per_sec": round(best, 1)}
+        if arm == "on":
+            # steady decode over warmed shapes: the armed sentinel must
+            # stay silent (any count here is an acceptance failure)
+            steady = watch.poll()
+            out[arm]["steady_compiles"] = int(sum(steady.values()))
+            # ledger attribution while the engine is live, + the
+            # reconcile verdict against the allocator's own number
+            snap = led.snapshot()
+            out[arm]["hbm_bytes"] = {
+                k: int(v) for k, v in snap.items() if v
+            }
+            out[arm]["hbm_peak_bytes"] = {
+                k: int(v) for k, v in led.watermarks().items() if v
+            }
+            gauges = device_memory_stats()
+            in_use = [
+                v for k, v in gauges.items()
+                if k.endswith("/hbm_in_use_gb")
+            ]
+            rec = led.reconcile(
+                reg, int(sum(in_use) * 1e9) if in_use else None
+            )
+            out[arm]["reconcile"] = {
+                "ok": rec["ok"],
+                "vacuous": rec["vacuous"],
+                "drift_gb": rec["drift_gb"],
+            }
+            # forced bucket change: a second engine with a DIFFERENT
+            # KV bucket drives fresh compiles of the same module-level
+            # jits -> the armed sentinel must fire (>=1) and attribute
+            forced = make_engine(
+                cfg, params, n_reqs, prompt_len + 128, 8,
+                hbm_ledger=HbmLedger(enabled=False),
+            )
+            submit_wave(forced, cfg, n_reqs, prompt_len + 128, 8, "olf")
+            drain(forced)
+            burst = watch.poll()
+            out[arm]["sentinel"] = {
+                "forced_compiles": int(sum(burst.values())),
+                "fires_total": int(
+                    watch.stats()["xla_sentinel_fires_total"]
+                ),
+                "stall_counter_recompile": float(
+                    reg.counter("areal_trace_stall_total").value(
+                        kind="recompile"
+                    )
+                ),
+            }
+            forced.close()
+            # leak audit: clean shutdown returns the ledger to baseline
+            out[arm]["close_leaks"] = {
+                k: int(v) for k, v in eng.close().items()
+            }
+            out[arm]["ledger_zero_after_close"] = all(
+                v == 0 for v in led.snapshot().values()
+            )
+        else:
+            eng.close()
+        del eng
+    off_tps = out["off"]["decode_toks_per_sec"]
+    out["on"]["overhead_frac_vs_off"] = round(
+        1.0 - out["on"]["decode_toks_per_sec"] / max(off_tps, 1e-9), 4
+    )
+    return out
+
+
 def bench_prefix_reuse(cfg, params, n_reqs=32, group_size=8, prompt_len=512):
     """Group-prompt KV dedup at admission (the radix-cache role of the
     reference's patched SGLang, realhf/impl/model/backend/sglang.py:369):
@@ -3390,6 +3519,176 @@ def bench_gateway_ab(
             "leak_free": pristine(eng),
         }
 
+    def two_gateways(n_requests=12, cap=5):
+        """ROADMAP item 1(c) nibble: TWO gateway front doors (two
+        ``FleetBackend``s, each with its own manager connection — the
+        two-``GatewayWorker`` deployment shape) share ONE real
+        manager's admission plane over the combined ``gateway_submit``
+        RPC.  The capped tenant's bucket holds exactly ``cap``
+        requests up front and refills too slowly to matter inside the
+        bench, so with both gateways racing from their own threads the
+        plane must admit EXACTLY ``cap`` across the pair — one
+        over-admit means a decision escaped the plane's lock.  Pure
+        control plane: no engines; admitted requests dispatch to
+        null gen-server clients."""
+        import threading
+
+        from areal_tpu.api.system_api import GserverManagerConfig
+        from areal_tpu.base import logging_ as logging_mod
+        from areal_tpu.base.monitor import RolloutStat
+        from areal_tpu.gateway.server import FleetBackend
+        from areal_tpu.system.gserver_manager import (
+            GserverManager,
+            GserverManagerClient,
+        )
+
+        est = float(estimate_tokens(prompt_len, inter_new))
+        m = GserverManager.__new__(GserverManager)
+        m.config = GserverManagerConfig(
+            schedule_policy="least_requests",
+            n_servers=4,
+            serve_mode="router",
+            tenants=[
+                dict(
+                    name="capped",
+                    priority="bulk",
+                    rate_tokens_per_s=1e-6,
+                    burst_tokens=cap * est,
+                ),
+                dict(name="interactive", priority="interactive"),
+            ],
+        )
+        m.server_addrs = [f"2gw-fs{i}" for i in range(4)]
+        m.logger = logging_mod.getLogger("bench-2gw")
+        m._round_robin = 0
+        m._qid_server = {}
+        m._server_load = {a: 0 for a in m.server_addrs}
+        m._server_tokens = {a: 0.0 for a in m.server_addrs}
+        m._server_devices = {a: 1 for a in m.server_addrs}
+        m._server_mesh = {a: "" for a in m.server_addrs}
+        m._qid_tokens = {}
+        m._group_server = {}
+        m._group_prefix = {}
+        m._group_tokens = {}
+        m.rollout_stat = RolloutStat()
+        m._model_version = 0
+        m._expr, m._trial = "bench-2gw", "t0"
+        m._clients = {}
+        m._init_metrics()
+        import zmq as _zmq
+
+        m._serve_mode = "router"
+        m._ctx = _zmq.Context.instance()
+        m._sock = m._ctx.socket(_zmq.ROUTER)
+        port = m._sock.bind_to_random_port("tcp://127.0.0.1")
+        m.addr = f"127.0.0.1:{port}"
+
+        stop = threading.Event()
+
+        def serve():
+            while not stop.is_set():
+                if m._sock.poll(timeout=10):
+                    m._serve()
+
+        st = threading.Thread(target=serve, daemon=True,
+                              name="2gw-serve")
+        st.start()
+
+        class _NullGenClient:
+            """Admitted requests have nowhere real to go — the arm
+            measures the admission plane, not generation."""
+
+            def call(self, cmd, payload, timeout=None):
+                return {}
+
+            def close(self):
+                pass
+
+        results = {}
+        errors = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(3)
+
+        def gateway(gname):
+            client = GserverManagerClient(addr=m.addr, timeout=60.0)
+            backend = FleetBackend(
+                client, client_factory=lambda addr: _NullGenClient()
+            )
+            admitted = rejected = inter_ok = 0
+            try:
+                barrier.wait()
+                for i in range(n_requests):
+                    dec, handle = backend.admit_and_submit(
+                        ginp(f"{gname}-cap{i}",
+                             prompt_ids(f"{gname}c{i}"), inter_new),
+                        "capped", est, False,
+                    )
+                    if dec.get("ok"):
+                        admitted += 1
+                        assert handle and handle["url"], handle
+                    else:
+                        rejected += 1
+                        assert dec.get("reason") == "rate_limited", dec
+                    # the uncapped tenant proves this front door stays
+                    # live even after its capped traffic is throttled
+                    dec2, h2 = backend.admit_and_submit(
+                        ginp(f"{gname}-int{i}",
+                             prompt_ids(f"{gname}n{i}"), inter_new),
+                        "interactive", est, False,
+                    )
+                    if dec2.get("ok") and h2:
+                        inter_ok += 1
+            except Exception as e:  # noqa: BLE001 - becomes arm data
+                with lock:
+                    errors.append(
+                        f"{gname}: {type(e).__name__}: {e}"[:200]
+                    )
+            finally:
+                client.close()
+            with lock:
+                results[gname] = {
+                    "capped_admitted": admitted,
+                    "capped_rejected": rejected,
+                    "interactive_admitted": inter_ok,
+                }
+
+        threads = [
+            threading.Thread(target=gateway, args=(g,), daemon=True,
+                             name=f"2gw-{g}")
+            for g in ("gw0", "gw1")
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        for t in threads:
+            t.join(timeout=120.0)
+        stop.set()
+        st.join(timeout=5.0)
+        m._sock.close(linger=0)
+
+        total = sum(r["capped_admitted"] for r in results.values())
+        row = {
+            "n_requests_per_gateway": n_requests,
+            "capped_tenant_slots": cap,
+            "per_gateway": results,
+            "total_capped_admitted": int(total),
+            # THE acceptance bar: admission stayed atomic across the
+            # two front doors — the shared bucket filled exactly, never
+            # over
+            "no_tenant_over_admit": bool(total == cap and not errors),
+            "both_gateways_served": bool(
+                len(results) == 2
+                and all(
+                    r["interactive_admitted"] == n_requests
+                    for r in results.values()
+                )
+            ),
+            "plane_tenants": m._admission.stats(),
+        }
+        if errors:
+            row["errors"] = errors[:3]
+        return row
+
     out = {
         "n_bulk": n_bulk,
         "n_interactive": n_interactive,
@@ -3400,6 +3699,7 @@ def bench_gateway_ab(
         "admission_on": arm(True, "on"),
         "admission_off": arm(False, "off"),
         "parity": parity(),
+        "two_gateways": two_gateways(),
     }
     on_p99 = out["admission_on"]["interactive_ttft_steps"]["p99"]
     off_p99 = out["admission_off"]["interactive_ttft_steps"]["p99"]
@@ -3411,6 +3711,9 @@ def bench_gateway_ab(
         out["admission_on"]["leak_free"]
         and out["admission_off"]["leak_free"]
         and out["parity"]["leak_free"]
+    )
+    out["no_tenant_over_admit"] = bool(
+        out["two_gateways"]["no_tenant_over_admit"]
     )
     return out
 
@@ -3804,6 +4107,7 @@ SUMMARY_REQUIRED_KEYS = (
     "kv_quant_ab",
     "weight_quant_ab",
     "trace_overhead_ab",
+    "obs_ledger_report",
     "spec_decode_ab",
     "slo_report",
     "pd_disagg_ab",
@@ -3827,6 +4131,7 @@ def build_summary(
     kv_quant_ab=None,
     weight_quant_ab=None,
     trace_overhead_ab=None,
+    obs_ledger_report=None,
     spec_decode_ab=None,
     slo_report=None,
     pd_disagg_ab=None,
@@ -3870,6 +4175,7 @@ def build_summary(
         "kv_quant_ab": kv_quant_ab,
         "weight_quant_ab": weight_quant_ab,
         "trace_overhead_ab": trace_overhead_ab,
+        "obs_ledger_report": obs_ledger_report,
         "spec_decode_ab": spec_decode_ab,
         "slo_report": slo_report,
         "pd_disagg_ab": pd_disagg_ab,
@@ -4613,6 +4919,25 @@ def main():
         ),
     )
 
+    # HBM-ledger + recompile-sentinel report: per-subsystem device-byte
+    # attribution, reconciliation verdict, steady-sentinel silence +
+    # forced-recompile fire, leak-free close, ledger-on-vs-off tok/s
+    # with the <2% overhead bar.  Runs off-TPU too — tiny shapes — so
+    # the summary always carries the acceptance numbers (the reconcile
+    # verdict is vacuous without memory_stats, as data).
+    mark("obs ledger report")
+    obs_ledger_report = _section(
+        bench_obs_ledger_report,
+        cfg,
+        gen_params,
+        name="obs_ledger_report",
+        **(
+            {}
+            if on_tpu
+            else dict(n_reqs=2, prompt_len=32, max_new=16, repeats=1)
+        ),
+    )
+
     # cross-request radix prefix cache: multi-turn conversation replay,
     # cache on vs off (cached-token fraction + replay tok/s).  Runs
     # off-TPU too — tiny shapes — so the summary always carries it.
@@ -5048,6 +5373,7 @@ def main():
         kv_quant_ab=kv_quant_ab,
         weight_quant_ab=weight_quant_ab,
         trace_overhead_ab=trace_overhead_ab,
+        obs_ledger_report=obs_ledger_report,
         spec_decode_ab=spec_decode_ab,
         slo_report=slo_report,
         pd_disagg_ab=pd_disagg_ab,

@@ -196,6 +196,131 @@ def slo_vocabulary_problems(families: Dict[str, str], table) -> List[str]:
     return problems
 
 
+def collect_stall_kind_sites() -> Dict[str, List[Tuple[str, int]]]:
+    """{kind: [(rel_path, lineno), ...]} of stall-``kind`` emission
+    sites: a string literal either (a) passed as the ``kind=`` keyword of
+    an ``.inc(...)`` call, or (b) passed as the first argument of a
+    ``stall_kind(...)`` call (the validate-identity marker emission sites
+    wrap computed kinds in).  A non-literal first arg to ``stall_kind``
+    is collected under ``<non-literal>`` — computed ``kind=`` keywords on
+    ``.inc`` are NOT flagged, because routing them through
+    ``stall_kind("literal")`` upstream is exactly the supported pattern
+    (runtime membership check + lintable literal)."""
+    sites: Dict[str, List[Tuple[str, int]]] = {}
+    for path in _iter_source_files():
+        rel = os.path.relpath(path, REPO_ROOT)
+        if rel in _SKIP_FILES:
+            continue
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=rel)
+            except SyntaxError:
+                continue  # already reported by the metric pass
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "inc":
+                for kw in node.keywords:
+                    if kw.arg != "kind":
+                        continue
+                    if isinstance(kw.value, ast.Constant) and isinstance(
+                        kw.value.value, str
+                    ):
+                        sites.setdefault(kw.value.value, []).append(
+                            (rel, node.lineno)
+                        )
+            is_stall_kind = (
+                isinstance(fn, ast.Name) and fn.id == "stall_kind"
+            ) or (isinstance(fn, ast.Attribute) and fn.attr == "stall_kind")
+            if is_stall_kind and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    sites.setdefault(arg.value, []).append(
+                        (rel, node.lineno)
+                    )
+                else:
+                    sites.setdefault("<non-literal>", []).append(
+                        (rel, node.lineno)
+                    )
+    return sites
+
+
+def collect_documented_stall_kinds(path: str = DOCS_TABLE) -> Set[str]:
+    """Stall kinds documented in docs/observability.md: every backticked
+    lowercase identifier (other than the metric name itself) on the
+    ``areal_trace_stall_total`` metric-table row."""
+    out: Set[str] = set()
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            stripped = line.lstrip()
+            if not stripped.startswith("| `areal_trace_stall_total`"):
+                continue
+            for m in re.findall(r"`([a-z][a-z0-9_]*)`", stripped):
+                if m not in ("areal_trace_stall_total", "kind", "counter"):
+                    out.add(m)
+    return out
+
+
+def stall_vocabulary_problems(
+    sites: Dict[str, List[Tuple[str, int]]],
+    kinds: Tuple[str, ...],
+    documented: Set[str],
+) -> List[str]:
+    """The ``areal_trace_stall_total{kind=}`` vocabulary, linted BOTH
+    ways against ``table.STALL_KINDS`` and against the docs row:
+
+    * every literal kind at an emission site must be in STALL_KINDS (an
+      unlisted kind would pass the registry's label check — ``kind`` is a
+      free-form label value — but be invisible to dashboards keyed on the
+      documented vocabulary);
+    * every STALL_KINDS entry must be emitted somewhere (dead vocabulary
+      otherwise);
+    * the docs row for ``areal_trace_stall_total`` must enumerate exactly
+      STALL_KINDS.
+
+    Split out (pure function of its inputs) so the tier-1 test can feed
+    it fabricated mismatches."""
+    problems: List[str] = []
+    for kind, where_list in sorted(sites.items()):
+        where = ", ".join(f"{p}:{ln}" for p, ln in where_list)
+        if kind == "<non-literal>":
+            problems.append(
+                f"non-literal stall_kind(...) argument at {where} — wrap "
+                "each candidate kind literal in stall_kind(\"...\") so "
+                "the vocabulary lint can see it"
+            )
+            continue
+        if kind not in kinds:
+            problems.append(
+                f"stall kind {kind!r} ({where}) is missing from "
+                "areal_tpu/observability/table.py STALL_KIND_TABLE"
+            )
+    emitted = set(sites) - {"<non-literal>"}
+    for kind in sorted(set(kinds) - emitted):
+        problems.append(
+            f"STALL_KIND_TABLE entry {kind!r} is never emitted anywhere "
+            "under areal_tpu/, bench.py, or __graft_entry__.py (dead "
+            "vocabulary — remove it or wire the emission)"
+        )
+    for kind in sorted(set(kinds) - documented):
+        problems.append(
+            f"stall kind {kind!r} is in STALL_KIND_TABLE but missing "
+            "from the docs/observability.md areal_trace_stall_total row"
+        )
+    for kind in sorted(documented - set(kinds)):
+        problems.append(
+            f"docs/observability.md documents stall kind {kind!r}, which "
+            "is not in STALL_KIND_TABLE (stale doc row — remove it or "
+            "add the table entry)"
+        )
+    return problems
+
+
 def run_lint() -> List[str]:
     """Returns a list of violation messages (empty = clean)."""
     sys.path.insert(0, REPO_ROOT)
@@ -257,6 +382,17 @@ def run_lint() -> List[str]:
     from areal_tpu.observability.latency import SLO_FAMILIES
 
     problems.extend(slo_vocabulary_problems(SLO_FAMILIES, METRIC_TABLE))
+
+    # -- stall-kind vocabulary (emission sites <-> STALL_KINDS <-> docs) ----
+    from areal_tpu.observability.table import STALL_KINDS
+
+    problems.extend(
+        stall_vocabulary_problems(
+            collect_stall_kind_sites(),
+            STALL_KINDS,
+            collect_documented_stall_kinds(),
+        )
+    )
 
     # -- trace span/event vocabulary (same discipline, second table) --------
     from areal_tpu.observability.table import TRACE_TABLE

@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""One-shot support bundle for a live (or half-dead) trial.
+
+Discovers every worker's observability endpoint through name-resolve
+(the same ``names.metric_server_root`` subtree the aggregator scrapes),
+snapshots ``/metrics``, ``/healthz``, and ``/trace`` from each into a
+timestamped directory, records every registered on-demand profiler
+capture path (``names.profiler_capture_root``), and writes a
+``manifest.json`` summarizing what was captured and what was dead.
+
+Dead endpoints are skip-and-count, never fatal: the whole point of a
+debug bundle is that some of the fleet is misbehaving, so one wedged
+worker must not block collecting evidence from the others.  Exit code
+is 0 as long as the bundle was written; the manifest carries the error
+tally.
+
+Usage::
+
+    python scripts/collect_debug_bundle.py EXPERIMENT TRIAL \
+        [--output DIR] [--timeout SECONDS] [--profile-seconds N]
+
+``--profile-seconds N`` additionally triggers a bounded
+``/profile?seconds=N`` capture on every live worker before snapshotting
+(workers already profiling answer 409; that is recorded, not fatal).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Dict, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from areal_tpu.base import name_resolve, names  # noqa: E402
+
+
+def _fetch(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def discover_workers(experiment: str, trial: str) -> Dict[str, str]:
+    """{worker_name: host:port} — same subtree the aggregator scrapes."""
+    out: Dict[str, str] = {}
+    root = names.metric_server_root(experiment, trial)
+    for key in name_resolve.find_subtree(root):
+        worker = key.rsplit("/", 1)[-1]
+        try:
+            out[worker] = name_resolve.get(key)
+        except name_resolve.NameEntryNotFoundError:
+            continue  # unregistered between scan and get
+    return out
+
+
+def discover_profiler_captures(experiment: str, trial: str) -> Dict[str, str]:
+    """{worker_name: capture_path} of every registered on-demand
+    profiler capture (the ``/profile`` route registers its latest)."""
+    out: Dict[str, str] = {}
+    root = names.profiler_capture_root(experiment, trial)
+    for key in name_resolve.find_subtree(root):
+        worker = key.rsplit("/", 1)[-1]
+        try:
+            out[worker] = name_resolve.get(key)
+        except name_resolve.NameEntryNotFoundError:
+            continue
+    return out
+
+
+#: endpoint path -> filename inside the per-worker bundle dir
+ENDPOINTS = (
+    ("/metrics", "metrics.prom"),
+    ("/healthz", "healthz.json"),
+    ("/trace", "trace.json"),
+)
+
+
+def collect(
+    experiment: str,
+    trial: str,
+    out_dir: str,
+    timeout: float = 5.0,
+    profile_seconds: Optional[float] = None,
+) -> dict:
+    """Snapshot the fleet into ``out_dir``; returns the manifest dict
+    (also written to ``out_dir/manifest.json``)."""
+    os.makedirs(out_dir, exist_ok=True)
+    workers = discover_workers(experiment, trial)
+    manifest: dict = {
+        "experiment": experiment,
+        "trial": trial,
+        "time": time.time(),
+        "workers": sorted(workers),
+        "fetched": 0,
+        "errors": [],
+        "profile_requests": {},
+        "profiler_captures": {},
+    }
+    if profile_seconds is not None:
+        for worker, addr in sorted(workers.items()):
+            url = f"http://{addr}/profile?seconds={profile_seconds}"
+            try:
+                manifest["profile_requests"][worker] = json.loads(
+                    _fetch(url, timeout)
+                )
+            except Exception as e:  # noqa: BLE001 - skip-and-count
+                manifest["profile_requests"][worker] = {"error": str(e)}
+        # a capture needs its wall-clock window before the snapshot can
+        # include the registered path
+        time.sleep(profile_seconds)
+    for worker, addr in sorted(workers.items()):
+        wdir = os.path.join(out_dir, worker)
+        os.makedirs(wdir, exist_ok=True)
+        for path, fname in ENDPOINTS:
+            try:
+                body = _fetch(f"http://{addr}{path}", timeout)
+            except Exception as e:  # noqa: BLE001 - skip-and-count
+                manifest["errors"].append(
+                    {"worker": worker, "endpoint": path, "error": str(e)}
+                )
+                continue
+            with open(os.path.join(wdir, fname), "wb") as f:
+                f.write(body)
+            manifest["fetched"] += 1
+    for worker, path in sorted(
+        discover_profiler_captures(experiment, trial).items()
+    ):
+        manifest["profiler_captures"][worker] = {
+            "path": path,
+            # captures live on the worker's host; only claim presence
+            # when this process can actually see the directory
+            "present_locally": os.path.isdir(path),
+        }
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    return manifest
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("experiment")
+    ap.add_argument("trial")
+    ap.add_argument(
+        "--output",
+        default=None,
+        help="bundle directory (default: debug_bundle_<expr>_<trial>_<ts>)",
+    )
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument(
+        "--profile-seconds",
+        type=float,
+        default=None,
+        help="also trigger a /profile capture of N seconds on every "
+        "live worker before snapshotting",
+    )
+    args = ap.parse_args(argv)
+    out_dir = args.output or "debug_bundle_{}_{}_{}".format(
+        args.experiment, args.trial, time.strftime("%Y%m%d-%H%M%S")
+    )
+    manifest = collect(
+        args.experiment,
+        args.trial,
+        out_dir,
+        timeout=args.timeout,
+        profile_seconds=args.profile_seconds,
+    )
+    n_workers = len(manifest["workers"])
+    n_errs = len(manifest["errors"])
+    print(
+        f"collect_debug_bundle: {out_dir} — {n_workers} worker(s), "
+        f"{manifest['fetched']} endpoint snapshot(s), {n_errs} error(s), "
+        f"{len(manifest['profiler_captures'])} profiler capture(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -332,6 +332,57 @@ def test_gateway_ab_cpu_smoke(tiny_cfg):
     assert par["stream_concat_matches_result"] is True
     assert par["gateway_matches_rollout"] is True
     assert out["leak_free"] is True
+    # N=2-gateways arm: two front doors racing one manager's admission
+    # plane over gateway_submit — the shared capped bucket filled
+    # EXACTLY (atomic, no over-admit) and both gateways stayed live
+    two = out["two_gateways"]
+    assert two["no_tenant_over_admit"] is True, two
+    assert (
+        two["total_capped_admitted"] == two["capped_tenant_slots"]
+    ), two
+    assert two["both_gateways_served"] is True, two
+    assert "errors" not in two, two
+    assert out["no_tenant_over_admit"] is True
+    json.dumps(out)  # wire-format safe
+
+
+def test_obs_ledger_report_cpu_smoke(tiny_cfg):
+    """The observability acceptance smoke: per-subsystem attribution
+    present under live decode, the reconcile verdict clean (vacuous on
+    backends without memory_stats), ZERO steady sentinel compiles over
+    the timed same-shape waves, >=1 attributed fire after the forced
+    KV-bucket change, and a leak-free close back to the zero ledger
+    baseline."""
+    import jax
+
+    from areal_tpu.models import transformer
+
+    params = transformer.init_params(tiny_cfg, jax.random.PRNGKey(0))
+    out = bench.bench_obs_ledger_report(
+        tiny_cfg, params, n_reqs=2, prompt_len=32, max_new=16, repeats=1,
+    )
+    on = out["on"]
+    assert on["hbm_bytes"]["weights"] > 0
+    assert on["hbm_bytes"]["kv_pool"] > 0
+    assert on["hbm_peak_bytes"]["kv_pool"] >= on["hbm_bytes"]["kv_pool"]
+    assert on["reconcile"]["ok"] is True
+    assert on["reconcile"]["drift_gb"] == 0.0
+    # armed sentinel silent across steady decode, fires on the forced
+    # bucket change with the compile burst attributed
+    assert on["steady_compiles"] == 0
+    assert on["sentinel"]["forced_compiles"] >= 1
+    assert on["sentinel"]["fires_total"] >= 1
+    assert on["sentinel"]["stall_counter_recompile"] >= 1.0
+    # leak audit: clean close returns the ledger to baseline
+    assert on["close_leaks"] == {}
+    assert on["ledger_zero_after_close"] is True
+    # both arms produced a throughput number and the overhead stat +
+    # bar ride along (the <2% assertion itself is a hardware-round bar
+    # — CPU tiny-shape noise swamps it)
+    assert out["off"]["decode_toks_per_sec"] > 0
+    assert on["decode_toks_per_sec"] > 0
+    assert isinstance(on["overhead_frac_vs_off"], float)
+    assert out["overhead_bar_frac"] == 0.02
     json.dumps(out)  # wire-format safe
 
 
@@ -454,6 +505,7 @@ def test_summary_schema_round_trips_with_required_keys(spec_ab):
         assert key in blob, key
     assert "gateway_ab" in bench.SUMMARY_REQUIRED_KEYS
     assert "control_plane_ab" in bench.SUMMARY_REQUIRED_KEYS
+    assert "obs_ledger_report" in bench.SUMMARY_REQUIRED_KEYS
     cp = blob["control_plane_ab"]
     assert cp["meets_5x"] is True
     assert cp["routing_parity"] is True

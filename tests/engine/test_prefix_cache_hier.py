@@ -398,3 +398,40 @@ def test_bench_hier_cpu_smoke():
     assert on["leak_free"] and off["leak_free"], cell
     # strictly less prefill work with the tier on
     assert on["prefill_tokens"] < off["prefill_tokens"], cell
+
+
+# -- HBM ledger attribution of the host spill tier ----------------------------
+
+
+def test_ledger_tracks_host_spill_and_close_after_flush_is_leak_free():
+    """prefix_spill_host mirrors the cache's exact host_bytes_held
+    through the spill/restore churn; a flushed engine closes with an
+    empty leak audit, and an UNflushed spill tier is named by it."""
+    from areal_tpu.observability.hbm_ledger import HbmLedger
+
+    led = HbmLedger()
+    eng, *_ = _pressure_engine(hbm_ledger=led)
+    _replay(eng)
+    st = eng.prefix_cache_stats()
+    assert st["spilled_blocks_total"] > 0
+    # the ledger tag tracks the cache's own byte account exactly
+    assert led.snapshot()["prefix_spill_host"] == st["host_bytes_held"]
+
+    if st["host_bytes_held"] > 0:
+        # closing with spill resident is a reported leak (audit bites)
+        leaked_bytes = st["host_bytes_held"]
+        eng2_leak = eng.close()
+        assert eng2_leak == {"prefix_spill_host": leaked_bytes}
+    else:
+        assert eng.close() == {}
+    assert all(v == 0 for v in led.snapshot().values())
+
+    # a second engine that FLUSHES before close audits clean
+    led2 = HbmLedger()
+    eng2, *_ = _pressure_engine(hbm_ledger=led2)
+    _replay(eng2, n_sessions=2, turns=2)
+    eng2.step()
+    eng2.step()  # TTL-evict parked rows
+    eng2._prefix_cache.flush()
+    assert led2.snapshot()["prefix_spill_host"] == 0
+    assert eng2.close() == {}

@@ -433,3 +433,67 @@ def test_commit_retry_after_lost_reply_is_idempotent():
     # a DIFFERENT version with nothing staged is still an error
     with pytest.raises(RuntimeError, match="no staged weights"):
         srv._commit_staged({"version": 6})
+
+
+# -- HBM ledger attribution across the swap lifecycle -------------------------
+
+
+def test_ledger_attributes_swap_lifecycle_and_close_is_leak_free():
+    """The HBM ledger follows the staged-swap state machine: weights
+    sized from the live tree, staged_weights non-zero exactly while a
+    tree is staged/committed-but-unapplied, and the engine's close()
+    leak audit comes back empty after a full swap cycle."""
+    from areal_tpu.observability.hbm_ledger import HbmLedger, tree_nbytes
+
+    led = HbmLedger()
+    eng = make_engine(mode="dense", hbm_ledger=led)
+    snap = led.snapshot()
+    assert snap["weights"] == tree_nbytes(eng.params)
+    assert snap["kv_pool"] > 0  # the dense KVCache lands under kv_pool
+    assert snap["staged_weights"] == 0
+
+    eng.submit(_req("q0", [7, 8, 9], 30))
+    for _ in range(2):
+        eng.step()
+    eng.stage_weights(_params2, version=1)
+    staged = led.snapshot()["staged_weights"]
+    assert staged == tree_nbytes(_params2)
+    # committed-but-unapplied still holds the device tree
+    eng.commit_staged(expected_version=1)
+    assert led.snapshot()["staged_weights"] == staged
+    run_until_done(eng)
+    # applied: the staged tree became the live one
+    assert led.snapshot()["staged_weights"] == 0
+    assert led.snapshot()["weights"] == tree_nbytes(eng.params)
+
+    assert eng.close() == {}  # quiesce audit: no leaked attributions
+    assert all(v == 0 for v in led.snapshot().values())
+    assert eng.close() == {}  # idempotent
+
+
+def test_ledger_discard_staged_returns_bytes():
+    """discard_staged must hand the staged bytes back — an abandoned
+    stage that kept its attribution would read as a leak forever."""
+    from areal_tpu.observability.hbm_ledger import HbmLedger
+
+    led = HbmLedger()
+    eng = make_engine(mode="dense", hbm_ledger=led)
+    eng.stage_weights(_params2, version=1)
+    assert led.snapshot()["staged_weights"] > 0
+    eng.discard_staged()
+    assert led.snapshot()["staged_weights"] == 0
+    assert eng.close() == {}
+
+
+def test_ledger_undiscarded_stage_is_reported_leaked():
+    """The audit actually bites: closing with a staged tree still
+    resident names staged_weights and its byte count."""
+    from areal_tpu.observability.hbm_ledger import HbmLedger, tree_nbytes
+
+    led = HbmLedger()
+    eng = make_engine(mode="dense", hbm_ledger=led)
+    eng.stage_weights(_params2, version=1)
+    leaked = eng.close()
+    assert leaked == {"staged_weights": tree_nbytes(_params2)}
+    # released regardless: the audit reports, the teardown still cleans
+    assert all(v == 0 for v in led.snapshot().values())

@@ -685,3 +685,184 @@ def test_malformed_page_rejected_by_strict_parser(three_live_workers):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def _hbm_registry(bytes_by_tag, peaks=None, drift=None):
+    """A registry carrying the ledger families a gen server publishes."""
+    reg = MetricsRegistry()
+    g = reg.gauge("areal_hbm_ledger_bytes")
+    gp = reg.gauge("areal_hbm_ledger_peak_bytes")
+    for tag, v in bytes_by_tag.items():
+        g.set(float(v), subsystem=tag)
+        gp.set(float((peaks or bytes_by_tag)[tag]), subsystem=tag)
+    if drift is not None:
+        reg.gauge("areal_hbm_ledger_drift_gb").set(float(drift))
+    return reg
+
+
+def test_merge_hbm_sums_bytes_and_maxes_peaks(three_live_workers, tmp_path):
+    """Two gen servers publishing ledgers -> fleet rows: bytes SUM per
+    subsystem (capacity planning), peaks MAX (worst watermark), drift
+    MAX (worst worker) — and they ride the jsonl snapshot.  The three
+    plain workers (no ledger family) contribute nothing."""
+    servers = []
+    for name, tags, drift in (
+        ("gen_server_a", {"weights": 100, "kv_pool": 1000}, 0.0),
+        ("gen_server_b", {"weights": 50, "kv_pool": 3000}, 1.5),
+    ):
+        srv = MetricsServer(registry=_hbm_registry(tags, drift=drift)).start()
+        srv.register(EXPR, TRIAL, name)
+        servers.append(srv)
+    snap = tmp_path / "cluster_metrics.jsonl"
+    agg = ClusterMetricsAggregator(EXPR, TRIAL, snapshot_path=str(snap))
+    try:
+        flat = agg.step(step=2)
+    finally:
+        agg.close()
+        for s in servers:
+            s.stop()
+    assert flat["hbm/weights/bytes"] == 150.0
+    assert flat["hbm/kv_pool/bytes"] == 4000.0
+    assert flat["hbm/kv_pool/peak_bytes"] == 3000.0
+    assert flat["hbm/drift_gb_max"] == 1.5
+    # the per-worker series also survive the flat view
+    assert (
+        flat["cluster/gen_server_a/areal_hbm_ledger_bytes{subsystem=weights}"]
+        == 100.0
+    )
+    row = json.loads(snap.read_text().splitlines()[0])
+    assert row["hbm/kv_pool/bytes"] == 4000.0
+
+
+def test_hbm_worker_appearing_mid_run(three_live_workers):
+    """A ledger-publishing worker registering mid-run joins the NEXT
+    cycle's fleet HBM rows (same re-discovery as plain metrics)."""
+    agg = ClusterMetricsAggregator(EXPR, TRIAL)
+    assert agg.merge_hbm(agg.scrape()) == {}  # nobody publishes yet
+    srv = MetricsServer(
+        registry=_hbm_registry({"staged_weights": 4096})
+    ).start()
+    srv.register(EXPR, TRIAL, "gen_server_late")
+    try:
+        rows = agg.merge_hbm(agg.scrape())
+        assert rows["hbm/staged_weights/bytes"] == 4096.0
+        assert rows["hbm/staged_weights/peak_bytes"] == 4096.0
+        assert "hbm/drift_gb_max" not in rows  # no drift gauge exported
+    finally:
+        srv.stop()
+
+
+def test_truncated_hbm_page_never_poisons_the_merge(three_live_workers):
+    """A worker whose page dies mid-ledger-sample fails the strict parse
+    and is skip-and-counted; the healthy worker's ledger still merges —
+    half a subsystem breakdown must never halve the fleet rows."""
+    import http.server
+    import threading
+
+    good = MetricsServer(registry=_hbm_registry({"kv_pool": 2048})).start()
+    good.register(EXPR, TRIAL, "gen_server_ok")
+
+    full = _hbm_registry({"kv_pool": 512, "weights": 64}).render()
+    cut = full[: full.index('subsystem="weights"')]
+
+    class Truncated(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(cut.encode())
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Truncated)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        from areal_tpu.base import names
+
+        name_resolve.add(
+            names.metric_server(EXPR, TRIAL, "gen_server", "gen_server_cut"),
+            f"127.0.0.1:{httpd.server_address[1]}",
+            replace=True,
+        )
+        agg = ClusterMetricsAggregator(EXPR, TRIAL, scrape_timeout=2.0)
+        scraped = agg.scrape()
+        assert "gen_server_cut" not in scraped
+        rows = agg.merge_hbm(scraped)
+        assert rows["hbm/kv_pool/bytes"] == 2048.0  # only the healthy one
+        errs = agg._registry.counter("areal_aggregator_scrape_errors_total")
+        assert errs.value(endpoint="gen_server_cut") == 1.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        good.stop()
+
+
+def test_foreign_hbm_page_merges_under_its_own_label(three_live_workers):
+    """A foreign/stale worker exporting the ledger family WITHOUT the
+    subsystem label parses fine and merges under the empty tag — it must
+    not crash the merge or contaminate the canonical tags."""
+    import http.server
+    import threading
+
+    good = MetricsServer(registry=_hbm_registry({"weights": 777})).start()
+    good.register(EXPR, TRIAL, "gen_server_ok")
+
+    class Foreign(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = (
+                b"# HELP areal_hbm_ledger_bytes x\n"
+                b"# TYPE areal_hbm_ledger_bytes gauge\n"
+                b"areal_hbm_ledger_bytes 999\n"  # no subsystem label
+            )
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Foreign)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        from areal_tpu.base import names
+
+        name_resolve.add(
+            names.metric_server(EXPR, TRIAL, "gen_server", "gen_server_old"),
+            f"127.0.0.1:{httpd.server_address[1]}",
+            replace=True,
+        )
+        agg = ClusterMetricsAggregator(EXPR, TRIAL, scrape_timeout=2.0)
+        rows = agg.merge_hbm(agg.scrape())
+        assert rows["hbm/weights/bytes"] == 777.0  # canonical tag clean
+        assert rows["hbm//bytes"] == 999.0  # foreign bytes isolated
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        good.stop()
+
+
+def test_xla_compile_families_survive_the_scrape(three_live_workers):
+    """The compile-sentinel counter/histogram ride the ordinary flat
+    view per worker (no special fleet merge: compiles are attributed,
+    not summed)."""
+    reg = MetricsRegistry()
+    reg.counter("areal_xla_compiles_total").inc(3, fn="paged_decode_chunk")
+    reg.histogram("areal_xla_compile_seconds").observe(2.5)
+    srv = MetricsServer(registry=reg).start()
+    srv.register(EXPR, TRIAL, "gen_server_x")
+    try:
+        agg = ClusterMetricsAggregator(EXPR, TRIAL)
+        flat = agg.flatten(agg.scrape())
+        assert (
+            flat[
+                "cluster/gen_server_x/"
+                "areal_xla_compiles_total{fn=paged_decode_chunk}"
+            ]
+            == 3.0
+        )
+        assert (
+            flat["cluster/gen_server_x/areal_xla_compile_seconds_sum"]
+            == 2.5
+        )
+    finally:
+        srv.stop()

@@ -1,0 +1,115 @@
+"""scripts/collect_debug_bundle.py: fleet discovery, per-worker
+endpoint snapshots, dead-endpoint skip-and-count, profiler-capture
+manifest rows, and the CLI wrapper."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.base import constants, name_resolve, names
+from areal_tpu.observability.registry import MetricsRegistry
+from areal_tpu.observability.server import MetricsServer
+
+EXPR, TRIAL = "bundletest", "t0"
+
+_spec = importlib.util.spec_from_file_location(
+    "collect_debug_bundle",
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "scripts",
+        "collect_debug_bundle.py",
+    ),
+)
+bundle = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bundle)
+
+
+@pytest.fixture(autouse=True)
+def _names():
+    name_resolve.reconfigure("memory")
+    constants.set_experiment_trial_names(EXPR, TRIAL)
+    yield
+
+
+@pytest.fixture
+def two_live_workers():
+    servers = []
+    for wname, g in (("gen_server_0", 12.0), ("model_worker_0", 3.0)):
+        reg = MetricsRegistry()
+        reg.gauge("areal_buffer_size").set(g)
+        srv = MetricsServer(registry=reg).start()
+        srv.worker_name = wname
+        srv.register(EXPR, TRIAL, wname)
+        servers.append(srv)
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def test_bundle_snapshots_every_live_worker(two_live_workers, tmp_path):
+    out = tmp_path / "bundle"
+    manifest = bundle.collect(EXPR, TRIAL, str(out))
+    assert manifest["workers"] == ["gen_server_0", "model_worker_0"]
+    assert manifest["errors"] == []
+    # 3 endpoints x 2 workers all landed on disk
+    assert manifest["fetched"] == 6
+    for w in manifest["workers"]:
+        assert b"areal_buffer_size" in (out / w / "metrics.prom").read_bytes()
+        health = json.loads((out / w / "healthz.json").read_text())
+        assert health["status"] == "ok"
+        assert health["worker"] == w
+        trace = json.loads((out / w / "trace.json").read_text())
+        assert "events" in trace
+    # the manifest itself is on disk and round-trips
+    on_disk = json.loads((out / "manifest.json").read_text())
+    assert on_disk["workers"] == manifest["workers"]
+    assert on_disk["experiment"] == EXPR
+
+
+def test_dead_endpoint_is_counted_not_fatal(two_live_workers, tmp_path):
+    """A worker that died but left its registration behind costs error
+    rows, never an exception — the healthy worker's snapshot still
+    lands."""
+    two_live_workers[0]._registered_key = None  # keep the stale key
+    two_live_workers[0].stop()
+    manifest = bundle.collect(
+        EXPR, TRIAL, str(tmp_path / "b"), timeout=0.5
+    )
+    assert manifest["fetched"] == 3  # the live worker's three endpoints
+    dead = {e["worker"] for e in manifest["errors"]}
+    assert dead == {"gen_server_0"}
+    assert len(manifest["errors"]) == 3  # all three endpoints counted
+    assert (tmp_path / "b" / "model_worker_0" / "metrics.prom").exists()
+
+
+def test_profiler_captures_land_in_manifest(two_live_workers, tmp_path):
+    """Registered capture paths are recorded; presence on the local
+    filesystem is claimed only when the directory actually exists."""
+    local = tmp_path / "cap-local"
+    local.mkdir()
+    name_resolve.add(
+        names.profiler_capture(EXPR, TRIAL, "gen_server_0"),
+        str(local),
+        replace=True,
+    )
+    name_resolve.add(
+        names.profiler_capture(EXPR, TRIAL, "model_worker_0"),
+        "/nonexistent/remote/cap",
+        replace=True,
+    )
+    manifest = bundle.collect(EXPR, TRIAL, str(tmp_path / "b"))
+    caps = manifest["profiler_captures"]
+    assert caps["gen_server_0"] == {
+        "path": str(local),
+        "present_locally": True,
+    }
+    assert caps["model_worker_0"]["present_locally"] is False
+
+
+def test_cli_main_writes_bundle(two_live_workers, tmp_path, capsys):
+    out = tmp_path / "cli_bundle"
+    rc = bundle.main([EXPR, TRIAL, "--output", str(out)])
+    assert rc == 0
+    assert (out / "manifest.json").exists()
+    assert "2 worker(s)" in capsys.readouterr().out

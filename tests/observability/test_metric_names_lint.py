@@ -46,3 +46,64 @@ def test_lint_catches_violations(tmp_path, monkeypatch):
     assert any("non-literal" in p for p in problems)
     # every real table entry is now "never emitted" too
     assert any("dead vocabulary" in p for p in problems)
+
+
+def _lint_module():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import check_metric_names as lint
+    finally:
+        sys.path.pop(0)
+    return lint
+
+
+def test_stall_kind_collector_sees_both_emission_forms(tmp_path, monkeypatch):
+    """Both the ``kind=`` keyword on ``.inc`` and the ``stall_kind``
+    validate-identity wrapper are collected; a computed stall_kind arg
+    lands under the non-literal sentinel; computed ``kind=`` on .inc is
+    NOT collected (routing through stall_kind upstream is the supported
+    pattern)."""
+    lint = _lint_module()
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "c.inc(kind='slo')\n"
+        "k = stall_kind('recompile')\n"
+        "k2 = table.stall_kind('span_deadline')\n"
+        "k3 = stall_kind(computed)\n"
+        "c.inc(kind=k)\n"
+    )
+    monkeypatch.setattr(lint, "_iter_source_files", lambda: [str(src)])
+    sites = lint.collect_stall_kind_sites()
+    assert set(sites) == {
+        "slo", "recompile", "span_deadline", "<non-literal>"
+    }
+
+
+def test_stall_vocabulary_problems_both_directions():
+    """The pure checker flags unlisted emissions, dead table entries,
+    and docs drift — and passes a consistent triple."""
+    lint = _lint_module()
+    kinds = ("a", "b")
+    ok = lint.stall_vocabulary_problems(
+        {"a": [("x.py", 1)], "b": [("x.py", 2)]}, kinds, {"a", "b"}
+    )
+    assert ok == []
+    probs = lint.stall_vocabulary_problems(
+        {"a": [("x.py", 1)], "rogue": [("x.py", 3)]}, kinds, {"a", "c"}
+    )
+    assert any("'rogue'" in p and "STALL_KIND_TABLE" in p for p in probs)
+    assert any("'b'" in p and "dead vocabulary" in p for p in probs)
+    assert any("'b'" in p and "docs" in p for p in probs)  # undocumented
+    assert any("'c'" in p and "stale doc row" in p for p in probs)
+    probs2 = lint.stall_vocabulary_problems(
+        {"<non-literal>": [("x.py", 9)]}, kinds, set(kinds)
+    )
+    assert any("non-literal stall_kind" in p for p in probs2)
+
+
+def test_documented_stall_kinds_parse_from_docs():
+    """The real docs row enumerates exactly the canonical vocabulary."""
+    lint = _lint_module()
+    from areal_tpu.observability.table import STALL_KINDS
+
+    assert lint.collect_documented_stall_kinds() == set(STALL_KINDS)

@@ -3,6 +3,7 @@ parser, name-resolve registration under the ``names.metric_server`` keys,
 and the WorkerServer substrate wiring (every worker type gets one)."""
 
 import json
+import os
 import time
 import urllib.request
 
@@ -144,3 +145,90 @@ def test_every_worker_type_serves_metrics_via_worker_server():
     finally:
         for s in servers:
             s.close()
+
+
+def test_profile_capture_roundtrip(tmp_path):
+    """/profile starts one bounded jax.profiler capture, registers the
+    capture dir under names.profiler_capture, answers 409 while one is
+    in flight, and ?status=1 reports the lifecycle."""
+    srv = MetricsServer(
+        registry=MetricsRegistry(), capture_dir=str(tmp_path)
+    ).start()
+    try:
+        srv.worker_name = "gen_server_0"
+        srv.register(EXPR, TRIAL, "gen_server_0")
+
+        with _scrape(srv.port, "/profile?status=1") as resp:
+            assert json.loads(resp.read()) == {"state": "idle"}
+
+        with _scrape(srv.port, "/profile?seconds=0.5") as resp:
+            started = json.loads(resp.read())
+        assert started["status"] == "started"
+        assert started["seconds"] == 0.5
+        assert started["path"].startswith(str(tmp_path))
+
+        # the capture dir is registered for harvest tooling
+        assert (
+            name_resolve.get(
+                names.profiler_capture(EXPR, TRIAL, "gen_server_0")
+            )
+            == started["path"]
+        )
+
+        # one capture in flight at a time: concurrent request -> 409
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _scrape(srv.port, "/profile?seconds=5")
+        assert exc.value.code == 409
+        assert json.loads(exc.value.read())["status"] == "busy"
+
+        # wait out the capture; the profiler writes into the dir and the
+        # status flips to done (or error if this jax build can't trace —
+        # either way the state machine resolved and a new capture works)
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            with _scrape(srv.port, "/profile?status=1") as resp:
+                st = json.loads(resp.read())
+            if st["state"] != "running":
+                break
+            time.sleep(0.05)
+        assert st["state"] in ("done", "error")
+        if st["state"] == "done":
+            assert os.path.isdir(st["path"])
+
+        with _scrape(srv.port, "/profile?seconds=0.5") as resp:
+            assert json.loads(resp.read())["status"] == "started"
+    finally:
+        srv.stop()
+
+
+def test_profile_seconds_clamped_to_bounds(tmp_path, monkeypatch):
+    """An operator typo (seconds=9999, seconds=0) clamps to the bounded
+    window instead of running the profiler for hours."""
+    srv = MetricsServer(
+        registry=MetricsRegistry(), capture_dir=str(tmp_path)
+    )
+    ran = []
+
+    def fake_run(path, seconds):
+        ran.append(seconds)
+        with srv._profile_lock:
+            srv._profile_state = {"state": "done", "path": path}
+
+    monkeypatch.setattr(srv, "_profile_run", fake_run)
+    code, reply = srv.start_profile(9999.0)
+    assert code == 200
+    assert reply["seconds"] == srv.PROFILE_MAX_SECONDS
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if srv.profile_status()["state"] == "done":
+            break
+        time.sleep(0.05)
+    code, reply = srv.start_profile(0.0)
+    assert code == 200
+    assert reply["seconds"] == 0.5
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if len(ran) == 2:
+            break
+        time.sleep(0.05)
+    assert sorted(ran) == [0.5, srv.PROFILE_MAX_SECONDS]
